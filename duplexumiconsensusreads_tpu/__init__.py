@@ -1,0 +1,31 @@
+"""duplexumiconsensusreads_tpu — TPU-native duplex UMI consensus framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+``paurrodri/DuplexUMIConsensusReads`` (reference mount was empty; the
+contract is BASELINE.json's north-star + five benchmark configs — see
+SURVEY.md). The preserved operator boundary is ``UmiGrouper`` /
+``ConsensusCaller`` with swappable ``cpu`` (NumPy oracle) and ``tpu``
+(JAX) backends.
+
+Layers (bottom-up):
+  utils/      Phred math, packing helpers.
+  simulate/   truth-aware synthetic read generator (ground-truth molecules).
+  oracle/     pure-NumPy reference implementation of every algorithm.
+  kernels/    pure-JAX batched kernels (adjacency, clustering, consensus,
+              duplex merge, per-cycle error model) — jit/vmap, static shapes.
+  bucketing/  host-side (genomic-tile, family-size) bucketing → static shapes.
+  ops/        UmiGrouper, ConsensusCaller, fused pipeline.
+  parallel/   jax.sharding Mesh + shard_map data-parallel sharding of buckets.
+  io/         BGZF/BAM codec (no pysam) + npz interchange.
+  cli/        command-line entry point mapping 1:1 to the benchmark configs.
+"""
+
+__version__ = "0.1.0"
+
+from duplexumiconsensusreads_tpu.types import (  # noqa: F401
+    ReadBatch,
+    FamilyAssignment,
+    ConsensusBatch,
+    ConsensusParams,
+    GroupingParams,
+)

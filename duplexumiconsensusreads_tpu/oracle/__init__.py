@@ -1,0 +1,10 @@
+from duplexumiconsensusreads_tpu.oracle.grouping import group_reads  # noqa: F401
+from duplexumiconsensusreads_tpu.oracle.consensus import (  # noqa: F401
+    call_consensus,
+    single_strand_consensus,
+    duplex_merge,
+)
+from duplexumiconsensusreads_tpu.oracle.error_model import (  # noqa: F401
+    fit_cycle_error_model,
+    apply_cycle_error_model,
+)

@@ -1,0 +1,116 @@
+"""NumPy oracle for UMI-family grouping (exact + directional adjacency).
+
+This is the semantic reference the TPU kernels are tested against. The
+directional adjacency algorithm is the UMI-tools network method
+implemented literally: process unique UMIs in descending-count order,
+BFS over directed edges ``u -> v`` present iff ``hamming(u, v) <=
+max_hamming`` and ``count[u] >= count_ratio*count[v] - 1``, removing
+visited nodes. (The TPU kernel computes the provably-equivalent
+min-rank-reachability via label propagation; see
+kernels/cluster.py for the equivalence argument.)
+
+Determinism: unique UMIs are ranked by (-count, packed_umi); dense
+family/molecule ids are assigned in sorted (pos_key, seed_umi[, strand])
+order so oracle and kernel agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.constants import NO_FAMILY
+from duplexumiconsensusreads_tpu.types import FamilyAssignment, GroupingParams, ReadBatch
+from duplexumiconsensusreads_tpu.utils.phred import pack_umi
+
+
+def _directional_clusters(
+    umis: np.ndarray, counts: np.ndarray, max_hamming: int, count_ratio: int
+) -> np.ndarray:
+    """Cluster unique UMIs (nU, U) with counts (nU,) -> seed index per UMI.
+
+    Returns, for each unique UMI, the index (into ``umis``) of its
+    cluster seed (the highest-count UMI of its cluster).
+    """
+    n = len(umis)
+    packed = pack_umi(umis)
+    order = np.lexsort((packed, -counts))  # rank 0 = highest count, ties by packed
+    # adjacency: ham[u, v] and counts[u] >= ratio*counts[v] - 1 (directed u->v)
+    ham = (umis[:, None, :] != umis[None, :, :]).sum(axis=2)
+    edge = (ham <= max_hamming) & (
+        counts[:, None] >= count_ratio * counts[None, :] - 1
+    )
+    np.fill_diagonal(edge, False)
+
+    seed_of = np.full(n, -1, np.int64)
+    for u in order:
+        if seed_of[u] >= 0:
+            continue
+        seed_of[u] = u
+        q = deque([u])
+        while q:
+            a = q.popleft()
+            for b in np.nonzero(edge[a])[0]:
+                if seed_of[b] < 0:
+                    seed_of[b] = u
+                    q.append(b)
+    return seed_of
+
+
+def group_reads(batch: ReadBatch, params: GroupingParams) -> FamilyAssignment:
+    """Assign family/molecule ids to every valid read in the batch.
+
+    Molecule identity is (pos_key, clustered-UMI); in paired (duplex)
+    mode a molecule has up to two single-strand families distinguished
+    by strand_ab, ordered AB-before-BA in the dense family numbering.
+    In unpaired mode family == molecule and strand is ignored.
+    """
+    n = batch.n_reads
+    valid = np.asarray(batch.valid, bool)
+    pos = np.asarray(batch.pos_key, np.int64)
+    umi = np.asarray(batch.umi, np.uint8)
+    strand = np.asarray(batch.strand_ab, bool)
+
+    # Resolved per-read cluster UMI (packed) after exact/adjacency grouping.
+    cluster_umi = np.full(n, -1, np.int64)
+    idx_valid = np.nonzero(valid)[0]
+    if params.strategy == "exact":
+        cluster_umi[idx_valid] = pack_umi(umi[idx_valid])
+    elif params.strategy == "adjacency":
+        for p in np.unique(pos[idx_valid]):
+            sel = idx_valid[pos[idx_valid] == p]
+            uu, inv, cnt = np.unique(
+                umi[sel], axis=0, return_inverse=True, return_counts=True
+            )
+            seed_of = _directional_clusters(
+                uu, cnt, params.max_hamming, params.count_ratio
+            )
+            cluster_umi[sel] = pack_umi(uu)[seed_of][inv]
+    else:
+        raise ValueError(f"unknown grouping strategy {params.strategy!r}")
+
+    # Dense molecule ids over (pos_key, cluster_umi), sorted.
+    mol_key = np.stack([pos, cluster_umi], axis=1)
+    molecule_id = np.full(n, NO_FAMILY, np.int32)
+    fam_id = np.full(n, NO_FAMILY, np.int32)
+    if len(idx_valid):
+        _, mol_inv = np.unique(mol_key[idx_valid], axis=0, return_inverse=True)
+        molecule_id[idx_valid] = mol_inv.astype(np.int32)
+        if params.paired:
+            fam_key = np.stack(
+                [mol_inv, (~strand[idx_valid]).astype(np.int64)], axis=1
+            )
+            _, fam_inv = np.unique(fam_key, axis=0, return_inverse=True)
+            fam_id[idx_valid] = fam_inv.astype(np.int32)
+        else:
+            fam_id[idx_valid] = mol_inv.astype(np.int32)
+
+    n_mol = int(molecule_id.max() + 1) if len(idx_valid) else 0
+    n_fam = int(fam_id.max() + 1) if len(idx_valid) else 0
+    return FamilyAssignment(
+        family_id=fam_id,
+        molecule_id=molecule_id,
+        n_families=np.int32(n_fam),
+        n_molecules=np.int32(n_mol),
+    )

@@ -1,0 +1,5 @@
+from duplexumiconsensusreads_tpu.simulate.simulator import (  # noqa: F401
+    SimConfig,
+    SimTruth,
+    simulate_batch,
+)

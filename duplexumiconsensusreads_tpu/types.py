@@ -1,0 +1,176 @@
+"""Core data model: padded, static-shape tensors for reads and consensus.
+
+Everything downstream of IO operates on ``ReadBatch`` — an
+HBM-resident struct-of-arrays with fully static shapes, the design
+mandated by the north-star (BASELINE.json: "batched JAX kernels over an
+HBM-resident padded read/quality tensor"). Fields are NumPy arrays on
+the host path and jnp arrays on the device path; every dataclass here
+is registered as a JAX pytree so it can flow through jit/shard_map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+
+from duplexumiconsensusreads_tpu.constants import NO_FAMILY
+
+
+def _register(cls):
+    """Register a dataclass as a JAX pytree (all fields are leaves)."""
+    fields = [f.name for f in dataclasses.fields(cls)]
+    jax.tree_util.register_pytree_node(
+        cls,
+        lambda x: ([getattr(x, n) for n in fields], None),
+        lambda _, leaves: cls(**dict(zip(fields, leaves))),
+    )
+    return cls
+
+
+@_register
+@dataclasses.dataclass
+class ReadBatch:
+    """A padded batch of N aligned reads, each up to L cycles.
+
+    bases:     u8 (N, L)  0..3 real, 4=N, 5=PAD (beyond read length)
+    quals:     u8 (N, L)  Phred; 0 on PAD cycles
+    umi:       u8 (N, U)  2-bit codes; for duplex input this is the
+                          *canonicalised* concatenated UMI pair (see io/)
+    pos_key:   i64 (N,)   packed canonical genomic key (ref, unclipped
+                          start[, mate start]); identical for all reads
+                          of one source molecule
+    strand_ab: bool (N,)  True = top (AB) strand read, False = bottom (BA)
+    valid:     bool (N,)  False marks padding slots in the batch
+    """
+
+    bases: Any
+    quals: Any
+    umi: Any
+    pos_key: Any
+    strand_ab: Any
+    valid: Any
+
+    @property
+    def n_reads(self) -> int:
+        return self.bases.shape[0]
+
+    @property
+    def read_len(self) -> int:
+        return self.bases.shape[1]
+
+    @property
+    def umi_len(self) -> int:
+        return self.umi.shape[1]
+
+    @staticmethod
+    def empty(n: int, l: int, u: int) -> "ReadBatch":
+        from duplexumiconsensusreads_tpu.constants import BASE_PAD
+
+        return ReadBatch(
+            bases=np.full((n, l), BASE_PAD, np.uint8),
+            quals=np.zeros((n, l), np.uint8),
+            umi=np.zeros((n, u), np.uint8),
+            pos_key=np.zeros((n,), np.int64),
+            strand_ab=np.zeros((n,), bool),
+            valid=np.zeros((n,), bool),
+        )
+
+    def take(self, idx) -> "ReadBatch":
+        return ReadBatch(
+            bases=self.bases[idx],
+            quals=self.quals[idx],
+            umi=self.umi[idx],
+            pos_key=self.pos_key[idx],
+            strand_ab=self.strand_ab[idx],
+            valid=self.valid[idx],
+        )
+
+
+@_register
+@dataclasses.dataclass
+class FamilyAssignment:
+    """Output of UmiGrouper: per-read family/molecule labels.
+
+    family_id:   i32 (N,)  dense id of the (molecule, strand) single-strand
+                           family; NO_FAMILY for invalid/unassigned reads
+    molecule_id: i32 (N,)  dense id of the source molecule (duplex: the
+                           AB and BA families of one molecule share it;
+                           single-strand mode: == family_id)
+    n_families:  i32 ()    number of distinct family ids in this batch
+    n_molecules: i32 ()    number of distinct molecule ids
+    """
+
+    family_id: Any
+    molecule_id: Any
+    n_families: Any
+    n_molecules: Any
+
+    @staticmethod
+    def none(n: int) -> "FamilyAssignment":
+        return FamilyAssignment(
+            family_id=np.full((n,), NO_FAMILY, np.int32),
+            molecule_id=np.full((n,), NO_FAMILY, np.int32),
+            n_families=np.int32(0),
+            n_molecules=np.int32(0),
+        )
+
+
+@_register
+@dataclasses.dataclass
+class ConsensusBatch:
+    """Output of ConsensusCaller: F padded consensus reads.
+
+    bases: u8 (F, L)   consensus base codes (4=N)
+    quals: u8 (F, L)   consensus Phred qualities
+    depth: i32 (F, L)  per-cycle read depth that contributed
+    valid: bool (F,)   False marks padding families
+    """
+
+    bases: Any
+    quals: Any
+    depth: Any
+    valid: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupingParams:
+    """UmiGrouper configuration (static / hashable — safe as jit static arg).
+
+    strategy:     "exact" (identical UMI) or "adjacency" (directional
+                  clustering, UMI-tools algorithm, Hamming <= max_hamming)
+    max_hamming:  adjacency edge threshold (reference behaviour: 1)
+    count_ratio:  directional edge condition count(a) >= ratio*count(b)-1
+                  (reference behaviour: 2)
+    paired:       duplex mode — reads carry a canonicalised UMI pair and
+                  strand_ab distinguishes top/bottom families
+    """
+
+    strategy: str = "exact"
+    max_hamming: int = 1
+    count_ratio: int = 2
+    paired: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusParams:
+    """ConsensusCaller configuration (static / hashable).
+
+    mode:            "single_strand" or "duplex"
+    min_reads:       minimum reads per single-strand family; smaller
+                     families emit no consensus
+    min_duplex_reads: minimum reads on EACH strand for a duplex call
+    max_qual:        cap on emitted consensus quality
+    max_input_qual:  cap applied to input qualities before the math
+    error_model:     None, or "cycle" to apply a fitted per-cycle
+                     quality cap before consensus (benchmark config 5)
+    """
+
+    mode: str = "single_strand"
+    min_reads: int = 1
+    min_duplex_reads: int = 1
+    max_qual: int = 90
+    max_input_qual: int = 50
+    error_model: str | None = None

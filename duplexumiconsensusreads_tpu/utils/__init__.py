@@ -1,0 +1,7 @@
+from duplexumiconsensusreads_tpu.utils.phred import (  # noqa: F401
+    phred_to_error,
+    error_to_phred,
+    seq_to_codes,
+    codes_to_seq,
+    pack_umi,
+)

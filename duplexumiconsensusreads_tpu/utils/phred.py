@@ -1,0 +1,58 @@
+"""Phred-scale quality math and base-code helpers (NumPy, host-side).
+
+These are the single source of truth for quality<->probability
+conversions; the oracle and the JAX kernels both follow the same
+conventions (see kernels/consensus.py for the on-device mirror).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.constants import (
+    BASE_CHARS,
+    CHAR_TO_CODE,
+    MAX_PHRED,
+    MIN_ERROR_PROB,
+)
+
+
+def phred_to_error(q: np.ndarray) -> np.ndarray:
+    """Error probability for integer Phred quality q: e = 10**(-q/10)."""
+    return np.maximum(10.0 ** (-np.asarray(q, dtype=np.float64) / 10.0), MIN_ERROR_PROB)
+
+
+def error_to_phred(e: np.ndarray, max_phred: int = MAX_PHRED) -> np.ndarray:
+    """Integer Phred quality for error probability e, clipped to [2, max_phred]."""
+    e = np.maximum(np.asarray(e, dtype=np.float64), MIN_ERROR_PROB)
+    q = np.floor(-10.0 * np.log10(e) + 1e-9)
+    return np.clip(q, 2, max_phred).astype(np.uint8)
+
+
+def seq_to_codes(seq: str) -> np.ndarray:
+    """ACGTN string -> u8 codes (A=0..T=3, N=4)."""
+    return np.array([CHAR_TO_CODE.get(c, 4) for c in seq.upper()], dtype=np.uint8)
+
+
+def codes_to_seq(codes: np.ndarray) -> str:
+    """u8 codes -> ACGTN. string (PAD renders as '.')."""
+    return "".join(BASE_CHARS[min(int(c), 5)] for c in codes)
+
+
+def pack_umi(codes: np.ndarray) -> np.ndarray:
+    """Pack 2-bit UMI codes (..., U) into a single int64 per UMI.
+
+    Only valid for U <= 31 and codes in {0..3}; N in a UMI should be
+    handled upstream (reads with N UMIs are conventionally dropped).
+    """
+    codes = np.asarray(codes, dtype=np.int64)
+    u = codes.shape[-1]
+    if u > 31:
+        raise ValueError(f"UMI length {u} > 31 cannot pack into int64")
+    if codes.size and (codes.min() < 0 or codes.max() >= 4):
+        raise ValueError(
+            "pack_umi requires 2-bit codes in {0..3}; reads with N in the "
+            "UMI must be dropped upstream (io layer)"
+        )
+    shifts = np.arange(u, dtype=np.int64)[::-1] * 2
+    return (codes << shifts).sum(axis=-1)

@@ -1,0 +1,16 @@
+"""Test env: force CPU platform with 8 virtual devices BEFORE jax import.
+
+This mirrors the driver's multi-chip dry-run: all sharding tests run on
+a virtual 8-device CPU mesh; the same code paths hit real TPU chips in
+production (see parallel/mesh.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

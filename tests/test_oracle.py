@@ -1,0 +1,162 @@
+"""Truth-based tests of the NumPy oracle itself.
+
+The simulator knows the true molecule sequences, so we can assert the
+oracle pipeline actually *works* (consensus error far below raw read
+error; grouping recovers true molecules) rather than only testing
+self-consistency.
+"""
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.constants import BASE_N, N_REAL_BASES
+from duplexumiconsensusreads_tpu.oracle import (
+    apply_cycle_error_model,
+    call_consensus,
+    fit_cycle_error_model,
+    group_reads,
+)
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+def test_exact_grouping_recovers_molecules_ss():
+    cfg = SimConfig(n_molecules=40, duplex=False, umi_error=0.0, seed=1)
+    batch, truth = simulate_batch(cfg)
+    fams = group_reads(batch, GroupingParams(strategy="exact", paired=False))
+    # with no UMI errors, families == true (molecule) partition
+    fam = np.asarray(fams.family_id)
+    for f in range(int(fams.n_families)):
+        mols = np.unique(truth.read_mol[fam == f])
+        assert len(mols) == 1, "exact family mixes molecules"
+    # each molecule maps to exactly one family
+    for m in np.unique(truth.read_mol):
+        fs = np.unique(fam[truth.read_mol == m])
+        assert len(fs) == 1, "molecule split across families"
+
+
+def test_adjacency_grouping_heals_umi_errors():
+    cfg = SimConfig(
+        n_molecules=30, duplex=False, umi_error=0.03, mean_family_size=6, seed=2
+    )
+    batch, truth = simulate_batch(cfg)
+    exact = group_reads(batch, GroupingParams(strategy="exact"))
+    adj = group_reads(batch, GroupingParams(strategy="adjacency", max_hamming=1))
+    # adjacency must merge error-UMIs: strictly fewer families than exact
+    assert int(adj.n_families) < int(exact.n_families)
+    # and most reads should land in a family dominated by their true molecule
+    fam = np.asarray(adj.family_id)
+    correct = 0
+    for f in range(int(adj.n_families)):
+        mols, counts = np.unique(truth.read_mol[fam == f], return_counts=True)
+        correct += counts.max()
+    assert correct / batch.n_reads > 0.95
+
+
+def test_ss_consensus_beats_raw_error_rate():
+    cfg = SimConfig(
+        n_molecules=50, duplex=False, base_error=0.02, mean_family_size=6, seed=3
+    )
+    batch, truth = simulate_batch(cfg)
+    fams = group_reads(batch, GroupingParams(strategy="exact"))
+    cons = call_consensus(batch, fams, ConsensusParams(mode="single_strand", min_reads=3))
+    fam = np.asarray(fams.family_id)
+    errs = total = 0
+    for f in range(int(fams.n_families)):
+        if not cons.valid[f]:
+            continue
+        mol = truth.read_mol[fam == f][0]
+        called = cons.bases[f] < N_REAL_BASES
+        total += called.sum()
+        errs += (cons.bases[f][called] != truth.mol_seq[mol][called]).sum()
+    assert total > 0
+    err_rate = errs / total
+    assert err_rate < cfg.base_error / 4, f"consensus err {err_rate} not better than raw"
+
+
+def test_duplex_consensus_better_than_single_strand():
+    cfg = SimConfig(
+        n_molecules=120, duplex=True, base_error=0.08, mean_family_size=5, seed=4
+    )
+    batch, truth = simulate_batch(cfg)
+    fams = group_reads(batch, GroupingParams(strategy="exact", paired=True))
+    ss = call_consensus(batch, fams, ConsensusParams(mode="single_strand", min_reads=2))
+    dx = call_consensus(
+        batch, fams, ConsensusParams(mode="duplex", min_reads=2, min_duplex_reads=2)
+    )
+
+    mol = np.asarray(fams.molecule_id)
+    fam = np.asarray(fams.family_id)
+
+    def err_rate(cons, id_arr):
+        errs = total = 0
+        for f in range(len(cons.valid)):
+            if not cons.valid[f]:
+                continue
+            sel = np.nonzero(id_arr == f)[0]
+            true_mol = truth.read_mol[sel[0]]
+            called = cons.bases[f] < N_REAL_BASES
+            total += called.sum()
+            errs += (cons.bases[f][called] != truth.mol_seq[true_mol][called]).sum()
+        return errs / max(total, 1)
+
+    e_ss = err_rate(ss, fam)
+    e_dx = err_rate(dx, mol)
+    assert e_dx < e_ss, f"duplex {e_dx} not better than ss {e_ss}"
+    assert e_dx < 2e-3
+
+
+def test_duplex_quality_boost_on_agreement():
+    cfg = SimConfig(n_molecules=20, duplex=True, base_error=0.001, seed=5)
+    batch, _ = simulate_batch(cfg)
+    fams = group_reads(batch, GroupingParams(strategy="exact", paired=True))
+    ss = call_consensus(batch, fams, ConsensusParams(mode="single_strand"))
+    dx = call_consensus(batch, fams, ConsensusParams(mode="duplex"))
+    # duplex quals on called cycles should (weakly) exceed either strand's typical qual
+    assert dx.quals[dx.valid].mean() > ss.quals[ss.valid].mean()
+
+
+def test_cycle_error_model_caps_late_cycles():
+    cfg = SimConfig(
+        n_molecules=80,
+        duplex=False,
+        base_error=0.002,
+        cycle_error_slope=0.002,  # error grows with cycle
+        mean_family_size=8,
+        read_len=60,
+        seed=6,
+    )
+    batch, _ = simulate_batch(cfg)
+    fams = group_reads(batch, GroupingParams(strategy="exact"))
+    ss = call_consensus(batch, fams, ConsensusParams(mode="single_strand"))
+    cap = fit_cycle_error_model(batch, fams, ss)
+    # fitted caps must decrease for late cycles (higher true error)
+    assert cap[:10].mean() > cap[-10:].mean() + 3
+    adj = apply_cycle_error_model(np.asarray(batch.quals), cap)
+    assert (adj <= np.asarray(batch.quals)).all()
+    assert (adj[:, -5:] <= cap[-5:][None, :]).all()
+
+
+def test_min_reads_filters_small_families():
+    cfg = SimConfig(n_molecules=30, duplex=False, mean_family_size=2, seed=7)
+    batch, _ = simulate_batch(cfg)
+    fams = group_reads(batch, GroupingParams(strategy="exact"))
+    cons = call_consensus(batch, fams, ConsensusParams(min_reads=3))
+    fam = np.asarray(fams.family_id)
+    sizes = np.bincount(fam[fam >= 0], minlength=int(fams.n_families))
+    np.testing.assert_array_equal(cons.valid, sizes >= 3)
+
+
+def test_n_bases_carry_no_evidence():
+    cfg = SimConfig(n_molecules=20, duplex=False, n_frac=0.2, seed=8)
+    batch, _ = simulate_batch(cfg)
+    fams = group_reads(batch, GroupingParams(strategy="exact"))
+    cons = call_consensus(batch, fams, ConsensusParams())
+    # depth at each cycle == number of non-N contributing reads
+    fam = np.asarray(fams.family_id)
+    f = 0
+    sel = np.nonzero(fam == f)[0]
+    depth_expected = (np.asarray(batch.bases)[sel] < N_REAL_BASES).sum(axis=0)
+    np.testing.assert_array_equal(cons.depth[f], depth_expected)
+    # zero-depth cycles are N
+    assert (cons.bases[f][depth_expected == 0] == BASE_N).all()
