@@ -1,0 +1,108 @@
+"""Benchmark harness: reads/sec consensus-called, TPU vs CPU-oracle baseline.
+
+Prints ONE JSON line:
+  {"metric": "reads_per_sec_duplex_consensus", "value": N,
+   "unit": "reads/s", "vs_baseline": R}
+
+The workload is benchmark config 3/5 (duplex consensus with adjacency
+grouping and the per-cycle error model — the hardest fused path) on a
+synthetic ctDNA-like batch. No published reference numbers exist
+(BASELINE.md): vs_baseline is measured against our own backend="cpu"
+NumPy oracle (the stand-in reference implementation, itself a
+per-family loop like the reference's pysam path), timed on a subsample
+and scaled per-read. Target (BASELINE.json): >=50x.
+
+Env knobs: DUT_BENCH_READS (default 300000), DUT_BENCH_CAPACITY (2048),
+DUT_BENCH_CPU_SAMPLE (3000).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
+    from duplexumiconsensusreads_tpu.ops import ConsensusCaller, PipelineSpec
+    from duplexumiconsensusreads_tpu.oracle import group_reads
+    from duplexumiconsensusreads_tpu.parallel import make_mesh, sharded_pipeline
+    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+    from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+    n_target = int(os.environ.get("DUT_BENCH_READS", 300_000))
+    capacity = int(os.environ.get("DUT_BENCH_CAPACITY", 2048))
+    cpu_sample = int(os.environ.get("DUT_BENCH_CPU_SAMPLE", 3000))
+
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex", error_model="cycle", min_duplex_reads=1)
+    spec = PipelineSpec(grouping=gp, consensus=cp, u_max=None)
+
+    # ~9 reads per molecule (both strands); ~150 bp reads, panel-like tiling
+    n_mol = max(64, n_target // 9)
+    t0 = time.time()
+    batch, _ = simulate_batch(
+        SimConfig(
+            n_molecules=n_mol,
+            read_len=150,
+            n_positions=max(8, n_mol // 48),
+            mean_family_size=4,
+            umi_error=0.01,
+            duplex=True,
+            seed=7,
+        )
+    )
+    n_reads = int(np.asarray(batch.valid).sum())
+    buckets = build_buckets(batch, capacity=capacity, adjacency=True)
+    sim_s = time.time() - t0
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+    stacked = stack_buckets(buckets, multiple_of=n_dev)
+
+    # compile (excluded from timing)
+    t0 = time.time()
+    out = sharded_pipeline(stacked, spec, mesh)
+    jax.block_until_ready(out)
+    compile_s = time.time() - t0
+
+    reps = 3
+    t0 = time.time()
+    for _ in range(reps):
+        out = sharded_pipeline(stacked, spec, mesh)
+        jax.block_until_ready(out)
+    tpu_s = (time.time() - t0) / reps
+    tpu_rps = n_reads / tpu_s
+
+    # CPU-oracle baseline on a subsample, scaled per-read
+    sub_idx = np.nonzero(np.asarray(batch.valid))[0][:cpu_sample]
+    sub = batch.take(sub_idx)
+    t0 = time.time()
+    fams = group_reads(sub, gp)
+    ConsensusCaller(cp, backend="cpu")(sub, fams)
+    cpu_s = time.time() - t0
+    cpu_rps = len(sub_idx) / cpu_s
+
+    result = {
+        "metric": "reads_per_sec_duplex_consensus",
+        "value": round(tpu_rps, 1),
+        "unit": "reads/s",
+        "vs_baseline": round(tpu_rps / cpu_rps, 2),
+    }
+    print(json.dumps(result))
+    print(
+        f"# reads={n_reads} buckets={len(buckets)} devices={n_dev} "
+        f"bucket_capacity={capacity} tpu_step={tpu_s:.3f}s compile={compile_s:.1f}s "
+        f"cpu_oracle={cpu_rps:.0f} reads/s (n={len(sub_idx)}) sim={sim_s:.1f}s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

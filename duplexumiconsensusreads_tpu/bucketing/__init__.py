@@ -1,0 +1,5 @@
+from duplexumiconsensusreads_tpu.bucketing.buckets import (  # noqa: F401
+    Bucket,
+    build_buckets,
+    stack_buckets,
+)

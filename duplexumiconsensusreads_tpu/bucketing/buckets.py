@@ -1,0 +1,179 @@
+"""Host-side bucketing: pack reads into fixed-shape device buckets.
+
+This is the shape-static trick the north-star mandates ("families
+bucketed by (genomic tile, family-size) to keep shapes static"): the
+heavy-tailed family-size distribution never reaches XLA — every bucket
+is a (R, L) padded tensor, compiled once per geometry.
+
+Rules:
+- reads are sorted by (pos_key, packed UMI) so whole position groups
+  (and within them, whole exact families) stay contiguous;
+- buckets are filled greedily with whole position groups (adjacency
+  clustering is position-local, so a split position group would miss
+  cluster merges);
+- a position group larger than the capacity is split at exact-family
+  boundaries (safe for exact grouping; a warning is raised in
+  adjacency mode);
+- each bucket records source read indices so outputs can be scattered
+  back to the caller's order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.constants import BASE_PAD
+from duplexumiconsensusreads_tpu.types import ReadBatch
+from duplexumiconsensusreads_tpu.utils.phred import pack_umi
+
+
+@dataclasses.dataclass
+class Bucket:
+    """One fixed-shape unit of device work (host NumPy arrays)."""
+
+    pos: np.ndarray  # (R,) i32 bucket-local dense position ids
+    umi: np.ndarray  # (R, B) u8
+    strand_ab: np.ndarray  # (R,) bool
+    valid: np.ndarray  # (R,) bool
+    bases: np.ndarray  # (R, L) u8
+    quals: np.ndarray  # (R, L) u8
+    read_index: np.ndarray  # (R,) i64 into the source batch; -1 = padding
+    n_unique_umi: int  # unique (pos, UMI) pairs — must be <= u_max
+
+    @property
+    def capacity(self) -> int:
+        return self.pos.shape[0]
+
+
+def _empty_bucket(r: int, l: int, b: int) -> Bucket:
+    return Bucket(
+        pos=np.zeros(r, np.int32),
+        umi=np.zeros((r, b), np.uint8),
+        strand_ab=np.zeros(r, bool),
+        valid=np.zeros(r, bool),
+        bases=np.full((r, l), BASE_PAD, np.uint8),
+        quals=np.zeros((r, l), np.uint8),
+        read_index=np.full(r, -1, np.int64),
+        n_unique_umi=0,
+    )
+
+
+def _fill_bucket(batch: ReadBatch, idx: np.ndarray, r: int) -> Bucket:
+    l, b = batch.read_len, batch.umi_len
+    bk = _empty_bucket(r, l, b)
+    n = len(idx)
+    bk.pos[:n] = _dense(np.asarray(batch.pos_key)[idx])
+    bk.umi[:n] = np.asarray(batch.umi)[idx]
+    bk.strand_ab[:n] = np.asarray(batch.strand_ab)[idx]
+    bk.valid[:n] = np.asarray(batch.valid)[idx]
+    bk.bases[:n] = np.asarray(batch.bases)[idx]
+    bk.quals[:n] = np.asarray(batch.quals)[idx]
+    bk.read_index[:n] = idx
+    key = np.stack([np.asarray(batch.pos_key)[idx], pack_umi(np.asarray(batch.umi)[idx])], 1)
+    bk.n_unique_umi = len(np.unique(key, axis=0))
+    return bk
+
+
+def _dense(keys: np.ndarray) -> np.ndarray:
+    _, inv = np.unique(keys, return_inverse=True)
+    return inv.astype(np.int32)
+
+
+def build_buckets(
+    batch: ReadBatch,
+    capacity: int,
+    adjacency: bool = False,
+) -> list[Bucket]:
+    """Pack a host ReadBatch into fixed-capacity buckets."""
+    valid = np.asarray(batch.valid, bool)
+    idx_all = np.nonzero(valid)[0]
+    if len(idx_all) == 0:
+        return []
+    pos = np.asarray(batch.pos_key)[idx_all]
+    packed = pack_umi(np.asarray(batch.umi)[idx_all])
+    order = np.lexsort((packed, pos))
+    idx_sorted = idx_all[order]
+    pos_s = pos[order]
+    packed_s = packed[order]
+
+    # position-group and family boundaries in sorted order
+    n = len(idx_sorted)
+    pos_start = np.nonzero(np.r_[True, pos_s[1:] != pos_s[:-1]])[0]
+    fam_start = np.nonzero(
+        np.r_[True, (pos_s[1:] != pos_s[:-1]) | (packed_s[1:] != packed_s[:-1])]
+    )[0]
+
+    buckets: list[np.ndarray] = []
+    cur: list[np.ndarray] = []
+    cur_n = 0
+
+    def flush():
+        nonlocal cur, cur_n
+        if cur:
+            buckets.append(np.concatenate(cur))
+            cur, cur_n = [], 0
+
+    pos_bounds = np.r_[pos_start, n]
+    for gi in range(len(pos_start)):
+        s, e = pos_bounds[gi], pos_bounds[gi + 1]
+        size = e - s
+        if size > capacity:
+            if adjacency:
+                warnings.warn(
+                    f"position group of {size} reads exceeds bucket capacity "
+                    f"{capacity}; adjacency clustering will not merge UMIs "
+                    "across the split"
+                )
+            # split at family boundaries
+            fs = fam_start[(fam_start >= s) & (fam_start < e)]
+            fam_bounds = np.r_[fs, e]
+            flush()
+            chunk_s = s
+            for fi in range(1, len(fam_bounds)):
+                while fam_bounds[fi] - chunk_s > capacity:
+                    cut = fam_bounds[fi - 1]
+                    if cut <= chunk_s:  # single family > capacity: hard cuts
+                        warnings.warn(
+                            f"single UMI family of {fam_bounds[fi]-chunk_s} reads "
+                            f"exceeds capacity {capacity}; splitting the family"
+                        )
+                        cut = chunk_s + capacity
+                    buckets.append(idx_sorted[chunk_s:cut])
+                    chunk_s = cut
+            if e > chunk_s:
+                cur = [idx_sorted[chunk_s:e]]
+                cur_n = e - chunk_s
+            continue
+        if cur_n + size > capacity:
+            flush()
+        cur.append(idx_sorted[s:e])
+        cur_n += size
+    flush()
+
+    return [_fill_bucket(batch, b, capacity) for b in buckets]
+
+
+def stack_buckets(buckets: list[Bucket], multiple_of: int = 1) -> dict:
+    """Stack buckets into (B, R, ...) arrays, padding the bucket count up
+    to a multiple (for even mesh sharding)."""
+    if not buckets:
+        raise ValueError("no buckets to stack")
+    r = buckets[0].capacity
+    l = buckets[0].bases.shape[1]
+    b = buckets[0].umi.shape[1]
+    n = len(buckets)
+    n_pad = (-n) % multiple_of
+    padded = buckets + [_empty_bucket(r, l, b) for _ in range(n_pad)]
+    return {
+        "pos": np.stack([x.pos for x in padded]),
+        "umi": np.stack([x.umi for x in padded]),
+        "strand_ab": np.stack([x.strand_ab for x in padded]),
+        "valid": np.stack([x.valid for x in padded]),
+        "bases": np.stack([x.bases for x in padded]),
+        "quals": np.stack([x.quals for x in padded]),
+        "read_index": np.stack([x.read_index for x in padded]),
+        "n_real_buckets": n,
+    }

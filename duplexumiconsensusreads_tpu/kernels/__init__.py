@@ -1,0 +1,10 @@
+from duplexumiconsensusreads_tpu.kernels.encoding import pack_umi_words  # noqa: F401
+from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel  # noqa: F401
+from duplexumiconsensusreads_tpu.kernels.consensus import (  # noqa: F401
+    ssc_kernel,
+    duplex_kernel,
+)
+from duplexumiconsensusreads_tpu.kernels.error_model import (  # noqa: F401
+    fit_cycle_cap_kernel,
+    apply_cycle_cap,
+)

@@ -1,0 +1,216 @@
+"""Batched consensus kernels: single-strand log-likelihood calling and
+duplex top/bottom-strand reconciliation.
+
+TPU-first design: per-read per-cycle log-likelihood contributions are
+reduced into per-family tensors with ONE one-hot matmul on the MXU
+(``onehot_families.T @ contributions``), fusing the log-likelihood
+accumulation, per-cycle depth counting, and family sizing into a single
+(F+1, R) x (R, 5L+1) GEMM — no scatter, no ragged loops, no
+data-dependent shapes. The alternative ``segment`` method uses
+jax.ops.segment_sum (sorted scatter-add) for comparison/benchmarking.
+
+Numerics mirror oracle/consensus.py exactly (float32 on device):
+  loglik[b] = sum_i [ base_i==b ? log1p(-e_i) : log(e_i/3) ]
+  err       = 1 - p_max = (sum_exp - 1)/sum_exp  after max-shift
+  qual      = floor(-10*log10(err) + 1e-9) clipped to [2, max_qual]
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from duplexumiconsensusreads_tpu.constants import (
+    BASE_N,
+    MIN_ERROR_PROB,
+    N_REAL_BASES,
+    NO_CALL_QUAL,
+)
+
+I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _phred_from_err(err: jnp.ndarray, max_qual: int) -> jnp.ndarray:
+    err = jnp.maximum(err, MIN_ERROR_PROB)
+    q = jnp.floor(-10.0 * jnp.log10(err) + 1e-9)
+    return jnp.clip(q, 2, max_qual).astype(jnp.int32)
+
+
+def _contributions(bases, quals, valid, max_input_qual):
+    """Per-read per-cycle evidence rows, zeroed for N/PAD/invalid.
+
+    Returns (contrib (R, L, 4) f32, real (R, L) f32).
+    """
+    real = (bases < N_REAL_BASES) & valid[:, None]
+    q = jnp.minimum(quals.astype(jnp.float32), float(max_input_qual))
+    e = jnp.power(10.0, -q / 10.0)
+    e = jnp.maximum(e, MIN_ERROR_PROB)
+    log_match = jnp.log1p(-e)
+    log_mis = jnp.log(e / 3.0)
+    onehot = (bases[:, :, None] == jnp.arange(N_REAL_BASES, dtype=bases.dtype)).astype(
+        jnp.float32
+    )
+    contrib = log_mis[:, :, None] + onehot * (log_match - log_mis)[:, :, None]
+    contrib = contrib * real[:, :, None].astype(jnp.float32)
+    return contrib, real.astype(jnp.float32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("f_max", "min_reads", "max_qual", "max_input_qual", "method"),
+)
+def ssc_kernel(
+    bases: jnp.ndarray,  # (R, L) u8
+    quals: jnp.ndarray,  # (R, L) u8
+    family_id: jnp.ndarray,  # (R,) i32, NO_FAMILY for unassigned
+    valid: jnp.ndarray,  # (R,) bool
+    *,
+    f_max: int,
+    min_reads: int = 1,
+    max_qual: int = 90,
+    max_input_qual: int = 50,
+    method: str = "matmul",
+):
+    """Single-strand consensus for all families at once.
+
+    Returns (cons_base (F, L) i32, cons_qual (F, L) i32,
+             depth (F, L) i32, fam_size (F,) i32, fam_valid (F,) bool).
+    Row f corresponds to dense family id f; rows >= actual family count
+    have fam_size 0 and fam_valid False.
+    """
+    r, l = bases.shape
+    ok = valid & (family_id >= 0)
+    fid = jnp.where(ok, family_id, f_max)  # overflow row, sliced off below
+
+    contrib, real = _contributions(bases, quals, ok, max_input_qual)
+
+    if method == "matmul":
+        # (R, 4L | L | 1): loglik contributions, depth indicators, read count
+        big = jnp.concatenate(
+            [
+                contrib.reshape(r, 4 * l),
+                real,
+                ok.astype(jnp.float32)[:, None],
+            ],
+            axis=1,
+        )
+        onehot_f = (fid[:, None] == jnp.arange(f_max + 1, dtype=jnp.int32)).astype(
+            jnp.float32
+        )
+        out = jnp.dot(onehot_f.T, big, preferred_element_type=jnp.float32)[:f_max]
+        loglik = out[:, : 4 * l].reshape(f_max, l, 4)
+        depth = out[:, 4 * l : 5 * l].astype(jnp.int32)
+        fam_size = out[:, 5 * l].astype(jnp.int32)
+    elif method == "segment":
+        loglik = jax.ops.segment_sum(
+            contrib.reshape(r, 4 * l), fid, num_segments=f_max + 1
+        )[:f_max].reshape(f_max, l, 4)
+        depth = jax.ops.segment_sum(real, fid, num_segments=f_max + 1)[:f_max].astype(
+            jnp.int32
+        )
+        fam_size = jax.ops.segment_sum(
+            ok.astype(jnp.float32), fid, num_segments=f_max + 1
+        )[:f_max].astype(jnp.int32)
+    else:
+        raise ValueError(f"unknown ssc method {method!r}")
+
+    # err = 1 - p_max, computed by summing ONLY the non-argmax
+    # exponentials: with the max term included the f32 sum rounds to 1.0
+    # whenever err < 1e-7 and the subtraction cancels to 0 (capping every
+    # deep family at max_qual). Excluding it keeps the residual exact.
+    maxll = jnp.max(loglik, axis=-1, keepdims=True)
+    base = jnp.argmax(loglik, axis=-1).astype(jnp.int32)
+    not_max = jnp.arange(4, dtype=jnp.int32) != base[..., None]
+    s = jnp.sum(jnp.exp(loglik - maxll) * not_max.astype(jnp.float32), axis=-1)
+    err = s / (1.0 + s)
+    qual = _phred_from_err(err, max_qual)
+
+    called = depth > 0
+    cons_base = jnp.where(called, base, BASE_N)
+    cons_qual = jnp.where(called, qual, NO_CALL_QUAL)
+    fam_valid = fam_size >= min_reads
+    cons_base = jnp.where(fam_valid[:, None], cons_base, BASE_N)
+    cons_qual = jnp.where(fam_valid[:, None], cons_qual, NO_CALL_QUAL)
+    depth = jnp.where(fam_valid[:, None], depth, 0)  # oracle parity: uncalled rows are 0
+    return cons_base, cons_qual, depth, fam_size, fam_valid
+
+
+@partial(jax.jit, static_argnames=("m_max", "min_duplex_reads", "max_qual"))
+def duplex_kernel(
+    cons_base: jnp.ndarray,  # (F, L) i32 single-strand consensus bases
+    cons_qual: jnp.ndarray,  # (F, L) i32
+    depth: jnp.ndarray,  # (F, L) i32
+    fam_valid: jnp.ndarray,  # (F,) bool
+    family_id: jnp.ndarray,  # (R,) i32
+    molecule_id: jnp.ndarray,  # (R,) i32
+    strand_ab: jnp.ndarray,  # (R,) bool
+    valid: jnp.ndarray,  # (R,) bool
+    *,
+    m_max: int,
+    min_duplex_reads: int = 1,
+    max_qual: int = 90,
+):
+    """Duplex merge of AB/BA single-strand consensi per molecule.
+
+    Returns (dx_base (M, L) i32, dx_qual (M, L) i32, dx_depth (M, L) i32,
+             dx_valid (M,) bool).
+    """
+    ok = valid & (molecule_id >= 0) & (family_id >= 0)
+    mid = jnp.where(ok, molecule_id, m_max)
+
+    def strand_tables(is_ab):
+        sel = ok & (strand_ab == is_ab)
+        fam = jnp.where(sel, family_id, I32_MAX)
+        fam_of_mol = jax.ops.segment_min(
+            fam, jnp.where(sel, mid, m_max), num_segments=m_max + 1
+        )[:m_max]
+        size = jax.ops.segment_sum(
+            sel.astype(jnp.int32), mid, num_segments=m_max + 1
+        )[:m_max]
+        return fam_of_mol, size
+
+    fam_ab, size_ab = strand_tables(True)
+    fam_ba, size_ba = strand_tables(False)
+
+    have = (fam_ab < I32_MAX) & (fam_ba < I32_MAX)
+    fam_ab_c = jnp.where(have, fam_ab, 0)
+    fam_ba_c = jnp.where(have, fam_ba, 0)
+
+    b_ab = jnp.take(cons_base, fam_ab_c, axis=0)
+    q_ab = jnp.take(cons_qual, fam_ab_c, axis=0)
+    d_ab = jnp.take(depth, fam_ab_c, axis=0)
+    b_ba = jnp.take(cons_base, fam_ba_c, axis=0)
+    q_ba = jnp.take(cons_qual, fam_ba_c, axis=0)
+    d_ba = jnp.take(depth, fam_ba_c, axis=0)
+
+    both_real = (b_ab < N_REAL_BASES) & (b_ba < N_REAL_BASES)
+    agree = both_real & (b_ab == b_ba)
+    disagree = both_real & (b_ab != b_ba) & (q_ab != q_ba)
+
+    dx_base = jnp.where(
+        agree,
+        b_ab,
+        jnp.where(disagree, jnp.where(q_ab > q_ba, b_ab, b_ba), BASE_N),
+    )
+    dx_qual = jnp.where(
+        agree,
+        jnp.minimum(q_ab + q_ba, max_qual),
+        jnp.where(disagree, jnp.maximum(jnp.abs(q_ab - q_ba), NO_CALL_QUAL), NO_CALL_QUAL),
+    )
+    dx_depth = d_ab + d_ba
+
+    dx_valid = (
+        have
+        & (fam_ab_c != fam_ba_c)  # unpaired grouping: AB==BA would
+        # self-merge a family and double its quality; emit no call instead
+        & (size_ab >= min_duplex_reads)
+        & (size_ba >= min_duplex_reads)
+        & jnp.take(fam_valid, fam_ab_c)
+        & jnp.take(fam_valid, fam_ba_c)
+    )
+    dx_base = jnp.where(dx_valid[:, None], dx_base, BASE_N)
+    dx_qual = jnp.where(dx_valid[:, None], dx_qual, NO_CALL_QUAL)
+    dx_depth = jnp.where(dx_valid[:, None], dx_depth, 0)
+    return dx_base, dx_qual, dx_depth, dx_valid

@@ -1,0 +1,34 @@
+"""Device-side encodings: multi-word 2-bit UMI packing, one-hot helpers.
+
+TPU-first note: everything stays int32/float32 — no int64 on device.
+UMIs of B bases pack big-endian into ceil(B/15) int32 words (15 2-bit
+codes per word keeps the sign bit clear), so lexicographic comparison
+of the word tuple equals comparison of the packed UMI, matching the
+host oracle's single-int64 ``pack_umi`` ordering for B <= 31.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+CODES_PER_WORD = 15
+
+
+def n_umi_words(umi_len: int) -> int:
+    return max(1, -(-umi_len // CODES_PER_WORD))
+
+
+def pack_umi_words(umi_codes: jnp.ndarray) -> jnp.ndarray:
+    """(..., B) u8 codes in {0..3} -> (..., W) i32 big-endian words."""
+    b = umi_codes.shape[-1]
+    w = n_umi_words(b)
+    pad = w * CODES_PER_WORD - b
+    c = jnp.pad(umi_codes.astype(jnp.int32), [(0, 0)] * (umi_codes.ndim - 1) + [(0, pad)])
+    c = c.reshape(*umi_codes.shape[:-1], w, CODES_PER_WORD)
+    shifts = jnp.arange(CODES_PER_WORD - 1, -1, -1, dtype=jnp.int32) * 2
+    return (c << shifts).sum(axis=-1).astype(jnp.int32)
+
+
+def one_hot_bases(codes: jnp.ndarray, n: int = 4, dtype=jnp.float32) -> jnp.ndarray:
+    """(...,) codes -> (..., n) one-hot; codes >= n produce all-zero rows."""
+    return (codes[..., None] == jnp.arange(n, dtype=codes.dtype)).astype(dtype)

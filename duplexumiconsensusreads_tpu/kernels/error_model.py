@@ -1,0 +1,53 @@
+"""Per-cycle base-quality error model, device side (benchmark config 5).
+
+Fit: per-cycle read-vs-family-consensus mismatch rates (Laplace
+smoothed) -> a Phred cap per cycle. Apply: clip input qualities at the
+cap. Both are pure elementwise/reduction math that XLA fuses into the
+surrounding consensus kernels; the fused config-5 pipeline is
+ssc -> fit -> apply -> ssc -> duplex in one jit (ops/pipeline.py).
+
+Mirrors oracle/error_model.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from duplexumiconsensusreads_tpu.constants import MIN_ERROR_PROB, N_REAL_BASES
+
+
+@partial(jax.jit, static_argnames=("max_phred_cap",))
+def fit_cycle_cap_kernel(
+    bases: jnp.ndarray,  # (R, L) u8
+    family_id: jnp.ndarray,  # (R,) i32
+    valid: jnp.ndarray,  # (R,) bool
+    cons_base: jnp.ndarray,  # (F, L) i32 single-strand consensus
+    fam_valid: jnp.ndarray,  # (F,) bool
+    *,
+    max_phred_cap: int = 60,
+) -> jnp.ndarray:
+    """Per-cycle Phred cap (L,) i32."""
+    ok = valid & (family_id >= 0)
+    fid = jnp.where(ok, family_id, 0)
+    cb = jnp.take(cons_base, fid, axis=0)  # (R, L)
+    fv = jnp.take(fam_valid, fid)
+    contrib = (
+        ok[:, None]
+        & fv[:, None]
+        & (bases < N_REAL_BASES)
+        & (cb < N_REAL_BASES)
+    )
+    mism = jnp.sum(contrib & (bases.astype(jnp.int32) != cb), axis=0)
+    total = jnp.sum(contrib, axis=0)
+    rate = (mism + 1.0) / (total + 2.0)
+    rate = jnp.maximum(rate, MIN_ERROR_PROB)
+    q = jnp.floor(-10.0 * jnp.log10(rate) + 1e-9)
+    return jnp.clip(q, 2, max_phred_cap).astype(jnp.int32)
+
+
+def apply_cycle_cap(quals: jnp.ndarray, cycle_cap: jnp.ndarray) -> jnp.ndarray:
+    """Clip qualities (R, L) at the per-cycle cap (L,)."""
+    return jnp.minimum(quals.astype(jnp.int32), cycle_cap[None, :]).astype(quals.dtype)

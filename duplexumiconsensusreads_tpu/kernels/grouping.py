@@ -1,0 +1,216 @@
+"""Fused on-device UMI-family grouping kernel (exact + directional adjacency).
+
+TPU-first design, all static shapes, no data-dependent control flow:
+
+1. Lexsort reads by (pos, UMI words) — XLA sort network on the VPU.
+2. Exact families = run boundaries in the sorted key stream (cumsum).
+3. Adjacency mode additionally:
+   a. compacts the unique (pos, UMI) table into ``u_max`` static slots
+      via a drop-mode scatter,
+   b. computes all-pairs Hamming distance as a one-hot matmul on the
+      MXU (matches = X @ X.T over (U, 4B) one-hots),
+   c. builds the directed UMI-tools edge matrix
+      edge[u,v] = ham<=h AND same pos AND cnt[u] >= r*cnt[v]-1,
+   d. runs transitive closure by repeated boolean matrix squaring
+      (ceil(log2 U) MXU matmuls — closure distance doubles per step),
+   e. assigns each UMI to the minimum-rank node that reaches it
+      (rank = descending count, ties by packed UMI).
+      This is provably identical to the oracle's sequential
+      BFS-with-removal: the minimal-rank node reaching v cannot itself
+      be reached by any lower-rank node (else that node would reach v,
+      contradicting minimality), hence it is a BFS seed, and no earlier
+      seed reaches v — so v lands in exactly that seed's cluster.
+4. Dense molecule ids = run boundaries of a second lexsort over
+   (pos, cluster UMI); paired mode splits families by strand (AB first),
+   matching the oracle's np.unique row ordering bit-for-bit.
+
+Reference parity note: the reference mount was empty (SURVEY.md §0);
+the semantic contract is the oracle in oracle/grouping.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from duplexumiconsensusreads_tpu.constants import NO_FAMILY
+from duplexumiconsensusreads_tpu.kernels.encoding import pack_umi_words
+
+I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _run_ids(keys: list[jnp.ndarray]) -> jnp.ndarray:
+    """Dense ids for runs of equal sorted keys: (R,) i32 via cumsum."""
+    new = jnp.zeros(keys[0].shape[0], bool).at[0].set(True)
+    for k in keys:
+        new = new | jnp.concatenate([jnp.ones((1,), bool), k[1:] != k[:-1]])
+    return jnp.cumsum(new.astype(jnp.int32)) - 1
+
+
+def _directional_cluster(
+    u_words: jnp.ndarray,  # (U, W) i32
+    u_codes: jnp.ndarray,  # (U, B) i32 one-hot-able
+    u_pos: jnp.ndarray,  # (U,) i32
+    u_cnt: jnp.ndarray,  # (U,) i32
+    u_valid: jnp.ndarray,  # (U,) bool
+    max_hamming: int,
+    count_ratio: int,
+) -> jnp.ndarray:
+    """Seed index per unique-UMI slot (directional clustering)."""
+    u, b = u_codes.shape
+    onehot = (u_codes[:, :, None] == jnp.arange(4, dtype=jnp.int32)).astype(jnp.float32)
+    matches = jnp.dot(
+        onehot.reshape(u, 4 * b),
+        onehot.reshape(u, 4 * b).T,
+        preferred_element_type=jnp.float32,
+    )
+    ham = b - matches.astype(jnp.int32)
+    edge = (
+        (ham <= max_hamming)
+        & (u_pos[:, None] == u_pos[None, :])
+        & (u_cnt[:, None] >= count_ratio * u_cnt[None, :] - 1)
+        & u_valid[:, None]
+        & u_valid[None, :]
+        & ~jnp.eye(u, dtype=bool)
+    )
+
+    # rank by (-count, packed UMI words); invalid slots rank last
+    cnt_key = jnp.where(u_valid, -u_cnt, I32_MAX)
+    order = jnp.lexsort((*[u_words[:, i] for i in range(u_words.shape[1] - 1, -1, -1)], cnt_key))
+    rank = jnp.zeros(u, jnp.int32).at[order].set(jnp.arange(u, dtype=jnp.int32))
+
+    # transitive closure by repeated squaring on the MXU
+    reach = (edge | jnp.eye(u, dtype=bool)).astype(jnp.float32)
+    n_iters = max(1, (u - 1).bit_length())
+    for _ in range(n_iters):
+        reach = (jnp.dot(reach, reach, preferred_element_type=jnp.float32) > 0).astype(
+            jnp.float32
+        )
+    reach_b = reach > 0  # reach_b[u, v]: u reaches v
+
+    masked_rank = jnp.where(reach_b, rank[:, None], I32_MAX)
+    return jnp.argmin(masked_rank, axis=0).astype(jnp.int32)  # seed per column v
+
+
+@partial(
+    jax.jit,
+    static_argnames=("strategy", "max_hamming", "count_ratio", "paired", "u_max"),
+)
+def group_kernel(
+    pos: jnp.ndarray,  # (R,) i32 bucket-local dense position key
+    umi_codes: jnp.ndarray,  # (R, B) u8 codes in {0..3} (N-UMI reads pre-dropped)
+    strand_ab: jnp.ndarray,  # (R,) bool
+    valid: jnp.ndarray,  # (R,) bool
+    *,
+    strategy: str = "exact",
+    max_hamming: int = 1,
+    count_ratio: int = 2,
+    paired: bool = False,
+    u_max: int | None = None,
+):
+    """Returns (family_id, molecule_id, n_families, n_molecules, n_overflow).
+
+    family_id / molecule_id are (R,) i32 in original read order with
+    NO_FAMILY on invalid or overflowed reads; ids are dense and ordered
+    exactly like the oracle's (sorted (pos, cluster_umi[, strand])).
+    n_overflow counts reads dropped because the unique-UMI table
+    exceeded u_max slots (adjacency mode only; size buckets so it's 0).
+    """
+    r = pos.shape[0]
+    if u_max is None:
+        u_max = r
+    words = pack_umi_words(umi_codes.astype(jnp.int32))  # (R, W)
+    w = words.shape[1]
+
+    pos_m = jnp.where(valid, pos.astype(jnp.int32), I32_MAX)
+    words_m = jnp.where(valid[:, None], words, I32_MAX)
+
+    order = jnp.lexsort((*[words_m[:, i] for i in range(w - 1, -1, -1)], pos_m))
+    spos = pos_m[order]
+    swords = words_m[order]
+    svalid = valid[order]
+    uid = _run_ids([spos] + [swords[:, i] for i in range(w)])  # exact-group id, sorted order
+
+    if strategy == "exact":
+        cluster_words_sorted = swords
+        overflow_sorted = jnp.zeros(r, bool)
+    elif strategy == "adjacency":
+        first = jnp.concatenate([jnp.ones((1,), bool), uid[1:] != uid[:-1]]) & svalid
+        slot = uid  # unique index; valid iff < u_max
+        scodes = umi_codes.astype(jnp.int32)[order]
+        # first occurrences define the table; non-firsts scatter to the
+        # dropped out-of-range slot u_max
+        u_words = jnp.full((u_max, w), I32_MAX, jnp.int32).at[
+            jnp.where(first, slot, u_max)
+        ].set(swords, mode="drop")
+        u_codes = jnp.zeros((u_max, scodes.shape[1]), jnp.int32).at[
+            jnp.where(first, slot, u_max)
+        ].set(scodes, mode="drop")
+        u_pos = jnp.full((u_max,), I32_MAX, jnp.int32).at[
+            jnp.where(first, slot, u_max)
+        ].set(spos, mode="drop")
+        u_cnt = (
+            jnp.zeros((u_max + 1,), jnp.int32)
+            .at[jnp.minimum(slot, u_max)]
+            .add(svalid.astype(jnp.int32), mode="drop")[:u_max]
+        )
+        u_valid = u_cnt > 0
+        seed = _directional_cluster(
+            u_words, u_codes, u_pos, u_cnt, u_valid, max_hamming, count_ratio
+        )
+        cluster_words_unique = jnp.take(u_words, seed, axis=0)  # (u_max, W)
+        in_table = slot < u_max
+        cluster_words_sorted = jnp.where(
+            (in_table & svalid)[:, None],
+            jnp.take(cluster_words_unique, jnp.minimum(slot, u_max - 1), axis=0),
+            I32_MAX,
+        )
+        overflow_sorted = svalid & ~in_table
+    else:
+        raise ValueError(f"unknown grouping strategy {strategy!r}")
+
+    ok_sorted = svalid & ~overflow_sorted
+    # scatter back to original order
+    inv = jnp.zeros(r, jnp.int32).at[order].set(jnp.arange(r, dtype=jnp.int32))
+    cluster_words = jnp.take(cluster_words_sorted, inv, axis=0)
+    ok = jnp.take(ok_sorted, inv)
+
+    # dense molecule ids over sorted (pos, cluster_words)
+    pos_m2 = jnp.where(ok, pos.astype(jnp.int32), I32_MAX)
+    cw_m = jnp.where(ok[:, None], cluster_words, I32_MAX)
+    order2 = jnp.lexsort((*[cw_m[:, i] for i in range(w - 1, -1, -1)], pos_m2))
+    mid_sorted = _run_ids([pos_m2[order2]] + [cw_m[order2][:, i] for i in range(w)])
+    ok2 = ok[order2]
+    n_mol = jnp.where(ok2.any(), mid_sorted[jnp.sum(ok2) - 1] + 1, 0).astype(jnp.int32)
+    molecule_id = (
+        jnp.full(r, NO_FAMILY, jnp.int32)
+        .at[order2]
+        .set(jnp.where(ok2, mid_sorted, NO_FAMILY))
+    )
+
+    if paired:
+        strand_ba = (~strand_ab).astype(jnp.int32)
+        sb_m = jnp.where(ok, strand_ba, I32_MAX)
+        order3 = jnp.lexsort(
+            (sb_m, *[cw_m[:, i] for i in range(w - 1, -1, -1)], pos_m2)
+        )
+        fid_sorted = _run_ids(
+            [pos_m2[order3]]
+            + [cw_m[order3][:, i] for i in range(w)]
+            + [sb_m[order3]]
+        )
+        ok3 = ok[order3]
+        n_fam = jnp.where(ok3.any(), fid_sorted[jnp.sum(ok3) - 1] + 1, 0).astype(jnp.int32)
+        family_id = (
+            jnp.full(r, NO_FAMILY, jnp.int32)
+            .at[order3]
+            .set(jnp.where(ok3, fid_sorted, NO_FAMILY))
+        )
+    else:
+        family_id = molecule_id
+        n_fam = n_mol
+
+    n_overflow = jnp.sum(valid & ~ok).astype(jnp.int32)
+    return family_id, molecule_id, n_fam, n_mol, n_overflow

@@ -1,0 +1,7 @@
+from duplexumiconsensusreads_tpu.ops.grouper import UmiGrouper  # noqa: F401
+from duplexumiconsensusreads_tpu.ops.caller import ConsensusCaller  # noqa: F401
+from duplexumiconsensusreads_tpu.ops.pipeline import (  # noqa: F401
+    PipelineSpec,
+    fused_pipeline,
+    run_bucket,
+)

@@ -1,0 +1,124 @@
+"""ConsensusCaller — the preserved operator boundary, consensus stage.
+
+backend="cpu": NumPy oracle with the two-pass error-model flow.
+backend="tpu": JAX kernels (ssc one-hot-matmul GEMM, duplex merge,
+per-cycle error model), composed but NOT fused across the operator
+boundary — use ops.pipeline for the fully-fused single-jit path the
+north-star prescribes; this class exists for operator-level parity
+with the reference API.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.kernels.consensus import duplex_kernel, ssc_kernel
+from duplexumiconsensusreads_tpu.kernels.error_model import (
+    apply_cycle_cap,
+    fit_cycle_cap_kernel,
+)
+from duplexumiconsensusreads_tpu.oracle.consensus import call_consensus as _oracle_call
+from duplexumiconsensusreads_tpu.oracle.error_model import (
+    apply_cycle_error_model,
+    fit_cycle_error_model,
+)
+from duplexumiconsensusreads_tpu.types import (
+    ConsensusBatch,
+    ConsensusParams,
+    FamilyAssignment,
+    ReadBatch,
+)
+
+
+class ConsensusCaller:
+    def __init__(
+        self,
+        params: ConsensusParams | None = None,
+        backend: str = "tpu",
+        method: str = "matmul",
+    ):
+        self.params = params or ConsensusParams()
+        if backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+        self.method = method
+
+    def __call__(self, batch: ReadBatch, fams: FamilyAssignment) -> ConsensusBatch:
+        if self.backend == "cpu":
+            return self._call_cpu(batch, fams)
+        return self._call_tpu(batch, fams)
+
+    def _call_cpu(self, batch, fams):
+        p = self.params
+        if p.error_model == "cycle":
+            import dataclasses
+
+            ss = _oracle_call(
+                batch,
+                fams,
+                dataclasses.replace(p, mode="single_strand", error_model=None),
+            )
+            cap = fit_cycle_error_model(batch, fams, ss)
+            q2 = apply_cycle_error_model(np.asarray(batch.quals), cap)
+            return _oracle_call(batch, fams, p, quals_override=q2)
+        return _oracle_call(batch, fams, p)
+
+    def _call_tpu(self, batch, fams):
+        p = self.params
+        bases = np.asarray(batch.bases)
+        quals = np.asarray(batch.quals)
+        valid = np.asarray(batch.valid)
+        fam = np.asarray(fams.family_id)
+        f_max = batch.n_reads
+
+        def ssc(q):
+            return ssc_kernel(
+                bases,
+                q,
+                fam,
+                valid,
+                f_max=f_max,
+                min_reads=p.min_reads,
+                max_qual=p.max_qual,
+                max_input_qual=p.max_input_qual,
+                method=self.method,
+            )
+
+        quals_eff = quals
+        if p.error_model == "cycle":
+            cb0, _, _, _, fv0 = ssc(quals)
+            cap = fit_cycle_cap_kernel(bases, fam, valid, cb0, fv0)
+            quals_eff = apply_cycle_cap(quals, cap)
+        cb, cq, dep, size, fv = ssc(quals_eff)
+
+        if p.mode == "single_strand":
+            n_fam = int(fams.n_families)
+            return ConsensusBatch(
+                bases=np.asarray(cb)[:n_fam].astype(np.uint8),
+                quals=np.asarray(cq)[:n_fam].astype(np.uint8),
+                depth=np.asarray(dep)[:n_fam],
+                valid=np.asarray(fv)[:n_fam],
+            )
+        if p.mode != "duplex":
+            raise ValueError(f"unknown consensus mode {p.mode!r}")
+
+        db, dq, dd, dv = duplex_kernel(
+            cb,
+            cq,
+            dep,
+            fv,
+            fam,
+            np.asarray(fams.molecule_id),
+            np.asarray(batch.strand_ab),
+            valid,
+            m_max=batch.n_reads,
+            min_duplex_reads=p.min_duplex_reads,
+            max_qual=p.max_qual,
+        )
+        n_mol = int(fams.n_molecules)
+        return ConsensusBatch(
+            bases=np.asarray(db)[:n_mol].astype(np.uint8),
+            quals=np.asarray(dq)[:n_mol].astype(np.uint8),
+            depth=np.asarray(dd)[:n_mol],
+            valid=np.asarray(dv)[:n_mol],
+        )

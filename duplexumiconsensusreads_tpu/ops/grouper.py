@@ -1,0 +1,66 @@
+"""UmiGrouper — the preserved operator boundary, grouping stage.
+
+Matches the reference's operator contract (BASELINE.json north_star:
+"the existing UmiGrouper / ConsensusCaller operator boundary stays
+intact; only the backend swaps"): same inputs/outputs on both backends.
+
+backend="cpu": NumPy oracle (also the correctness reference).
+backend="tpu": fused JAX kernel (kernels/grouping.py) — device sort,
+MXU Hamming adjacency, transitive-closure clustering.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.oracle.grouping import group_reads as _oracle_group
+from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel
+from duplexumiconsensusreads_tpu.types import FamilyAssignment, GroupingParams, ReadBatch
+
+
+def dense_pos_ids(pos_key: np.ndarray) -> np.ndarray:
+    """Host int64 genomic keys -> bucket-local dense i32 ids (sorted order
+    preserving, so device grouping emits ids in the same order as the
+    oracle's int64 sort)."""
+    _, inv = np.unique(np.asarray(pos_key), return_inverse=True)
+    return inv.astype(np.int32)
+
+
+class UmiGrouper:
+    def __init__(
+        self,
+        params: GroupingParams | None = None,
+        backend: str = "tpu",
+        u_max: int | None = None,
+    ):
+        self.params = params or GroupingParams()
+        if backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+        self.u_max = u_max
+
+    def __call__(self, batch: ReadBatch) -> FamilyAssignment:
+        if self.backend == "cpu":
+            return _oracle_group(batch, self.params)
+        p = self.params
+        fam, mol, n_fam, n_mol, n_over = group_kernel(
+            dense_pos_ids(batch.pos_key),
+            np.asarray(batch.umi),
+            np.asarray(batch.strand_ab),
+            np.asarray(batch.valid),
+            strategy=p.strategy,
+            max_hamming=p.max_hamming,
+            count_ratio=p.count_ratio,
+            paired=p.paired,
+            u_max=self.u_max,
+        )
+        if int(n_over):
+            import warnings
+
+            warnings.warn(
+                f"UmiGrouper: {int(n_over)} reads overflowed the unique-UMI "
+                f"table (u_max={self.u_max}); size buckets larger or raise u_max"
+            )
+        return FamilyAssignment(
+            family_id=fam, molecule_id=mol, n_families=n_fam, n_molecules=n_mol
+        )

@@ -1,0 +1,147 @@
+"""Fully-fused device pipeline: grouping → ssc → error model → duplex
+in ONE jitted call, as the north-star prescribes (BASELINE.json:
+"grouping + consensus + duplex reconciliation + error model fused into
+one vmap'd call").
+
+The fused function is shape-static over a bucket spec (R reads, L
+cycles, B umi bases, u_max unique-UMI slots) so XLA compiles it once
+per bucket geometry; host bucketing (bucketing/) guarantees every
+bucket fits the spec. The same function is the unit that
+parallel/sharded.py maps over the device mesh (config 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from duplexumiconsensusreads_tpu.kernels.consensus import duplex_kernel, ssc_kernel
+from duplexumiconsensusreads_tpu.kernels.error_model import (
+    apply_cycle_cap,
+    fit_cycle_cap_kernel,
+)
+from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSpec:
+    """Static geometry + algorithm config of one fused pipeline compile.
+
+    Hashable → usable as a jit static argument. f_max/m_max default to
+    the read capacity R (worst case: every read its own family).
+    """
+
+    grouping: GroupingParams = GroupingParams()
+    consensus: ConsensusParams = ConsensusParams()
+    u_max: int | None = None  # unique-UMI table slots (adjacency mode)
+    ssc_method: str = "matmul"
+
+    def __post_init__(self):
+        if self.consensus.mode == "duplex" and not self.grouping.paired:
+            raise ValueError(
+                "duplex consensus requires paired grouping "
+                "(GroupingParams(paired=True))"
+            )
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def fused_pipeline(
+    pos: jnp.ndarray,  # (R,) i32 bucket-local dense position ids
+    umi: jnp.ndarray,  # (R, B) u8
+    strand_ab: jnp.ndarray,  # (R,) bool
+    valid: jnp.ndarray,  # (R,) bool
+    bases: jnp.ndarray,  # (R, L) u8
+    quals: jnp.ndarray,  # (R, L) u8
+    spec: PipelineSpec,
+):
+    """Returns a dict of device arrays:
+
+      family_id, molecule_id (R,) i32; n_families, n_molecules,
+      n_overflow scalars; cons_base/cons_qual/cons_depth (F, L);
+      cons_valid (F,) — F = R rows, dense id order, padding rows invalid.
+      Duplex mode: the cons_* tensors are per-molecule; ss mode: per-family.
+    """
+    g, c = spec.grouping, spec.consensus
+    r = pos.shape[0]
+
+    fam, mol, n_fam, n_mol, n_over = group_kernel(
+        pos,
+        umi,
+        strand_ab,
+        valid,
+        strategy=g.strategy,
+        max_hamming=g.max_hamming,
+        count_ratio=g.count_ratio,
+        paired=g.paired,
+        u_max=spec.u_max,
+    )
+
+    def ssc(q):
+        return ssc_kernel(
+            bases,
+            q,
+            fam,
+            valid,
+            f_max=r,
+            min_reads=c.min_reads,
+            max_qual=c.max_qual,
+            max_input_qual=c.max_input_qual,
+            method=spec.ssc_method,
+        )
+
+    quals_eff = quals
+    if c.error_model == "cycle":
+        cb0, _, _, _, fv0 = ssc(quals)
+        cap = fit_cycle_cap_kernel(bases, fam, valid, cb0, fv0)
+        quals_eff = apply_cycle_cap(quals, cap)
+
+    cb, cq, dep, size, fv = ssc(quals_eff)
+
+    if c.mode == "single_strand":
+        out_b, out_q, out_d, out_v = cb, cq, dep, fv
+    elif c.mode == "duplex":
+        out_b, out_q, out_d, out_v = duplex_kernel(
+            cb,
+            cq,
+            dep,
+            fv,
+            fam,
+            mol,
+            strand_ab,
+            valid,
+            m_max=r,
+            min_duplex_reads=c.min_duplex_reads,
+            max_qual=c.max_qual,
+        )
+    else:
+        raise ValueError(f"unknown consensus mode {c.mode!r}")
+
+    return {
+        "family_id": fam,
+        "molecule_id": mol,
+        "n_families": n_fam,
+        "n_molecules": n_mol,
+        "n_overflow": n_over,
+        "cons_base": out_b,
+        "cons_qual": out_q,
+        "cons_depth": out_d,
+        "cons_valid": out_v,
+    }
+
+
+def run_bucket(bucket, spec: PipelineSpec):
+    """Convenience host entry: run one host-side bucket (from bucketing/)
+    through the fused pipeline. bucket carries i32 dense pos ids."""
+    return fused_pipeline(
+        bucket.pos,
+        bucket.umi,
+        bucket.strand_ab,
+        bucket.valid,
+        bucket.bases,
+        bucket.quals,
+        spec,
+    )

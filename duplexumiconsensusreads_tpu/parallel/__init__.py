@@ -1,0 +1,5 @@
+from duplexumiconsensusreads_tpu.parallel.mesh import make_mesh  # noqa: F401
+from duplexumiconsensusreads_tpu.parallel.sharded import (  # noqa: F401
+    sharded_pipeline,
+    shard_stacked,
+)

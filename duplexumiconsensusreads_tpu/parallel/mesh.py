@@ -1,0 +1,23 @@
+"""Device mesh construction for bucket-parallel execution.
+
+The workload is embarrassingly parallel over buckets (each bucket is a
+closed set of position groups), so the mesh is a single 'data' axis:
+buckets shard across chips over ICI, and the only cross-device traffic
+is the final host gather of consensus tensors. Multi-host meshes work
+unchanged — jax.sharding places bucket shards on each host's local
+chips and XLA rides ICI/DCN as needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))

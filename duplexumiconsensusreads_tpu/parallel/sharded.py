@@ -1,0 +1,51 @@
+"""Mesh-sharded execution of the fused pipeline (benchmark config 4).
+
+Design: stacked buckets (B, R, ...) are sharded over the mesh's 'data'
+axis with jax.sharding.NamedSharding; the fused per-bucket pipeline is
+vmapped over the bucket axis and jitted with those shardings. XLA
+partitions the whole computation with zero collectives (buckets are
+independent); results come back sharded and are gathered host-side
+only for the final write. This is the pjit/GSPMD idiom — no NCCL-style
+explicit communication, per the TPU-first design mandate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from duplexumiconsensusreads_tpu.ops.pipeline import PipelineSpec, fused_pipeline
+
+_ARRAY_KEYS = ("pos", "umi", "strand_ab", "valid", "bases", "quals")
+
+
+def shard_stacked(stacked: dict, mesh: Mesh, axis: str = "data") -> dict:
+    """Device-put the stacked bucket arrays with bucket-axis sharding."""
+    sh = NamedSharding(mesh, P(axis))
+    return {k: jax.device_put(stacked[k], sh) for k in _ARRAY_KEYS}
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _vmapped(pos, umi, strand_ab, valid, bases, quals, spec):
+    return jax.vmap(
+        lambda *a: fused_pipeline(*a, spec)
+    )(pos, umi, strand_ab, valid, bases, quals)
+
+
+def sharded_pipeline(
+    stacked: dict, spec: PipelineSpec, mesh: Mesh, axis: str = "data"
+) -> dict:
+    """Run all buckets across the mesh; returns stacked outputs (B, ...)."""
+    args = shard_stacked(stacked, mesh, axis)
+    with mesh:
+        return _vmapped(
+            args["pos"],
+            args["umi"],
+            args["strand_ab"],
+            args["valid"],
+            args["bases"],
+            args["quals"],
+            spec,
+        )

@@ -1,5 +1,6 @@
 from duplexumiconsensusreads_tpu.simulate.simulator import (  # noqa: F401
     SimConfig,
     SimTruth,
+    pad_batch,
     simulate_batch,
 )

@@ -15,7 +15,7 @@ import dataclasses
 
 import numpy as np
 
-from duplexumiconsensusreads_tpu.constants import BASE_N, BASE_PAD, N_REAL_BASES
+from duplexumiconsensusreads_tpu.constants import BASE_N, N_REAL_BASES
 from duplexumiconsensusreads_tpu.types import ReadBatch
 
 
@@ -151,5 +151,4 @@ def pad_batch(batch: ReadBatch, n_to: int) -> ReadBatch:
     for name in ("bases", "quals", "umi", "pos_key", "strand_ab", "valid"):
         arr = getattr(out, name)
         arr[:n] = getattr(batch, name)
-    out.bases[n:] = BASE_PAD
     return out

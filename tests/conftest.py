@@ -1,16 +1,20 @@
-"""Test env: force CPU platform with 8 virtual devices BEFORE jax import.
+"""Test env: force CPU platform with 8 virtual devices BEFORE backend init.
 
 This mirrors the driver's multi-chip dry-run: all sharding tests run on
 a virtual 8-device CPU mesh; the same code paths hit real TPU chips in
 production (see parallel/mesh.py).
+
+NOTE: this environment pre-imports jax at interpreter startup, so
+setting JAX_PLATFORMS via os.environ here is too late — the config
+default was already captured. jax.config.update still works because the
+backend itself is initialised lazily on first use. Set DUT_TEST_TPU=1
+to run the suite against the real chip instead.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+
+if not os.environ.get("DUT_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)

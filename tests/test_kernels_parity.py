@@ -1,0 +1,222 @@
+"""Parity: JAX device kernels vs the NumPy oracle.
+
+Grouping ids must match bit-for-bit (both implementations define dense
+ids by the same sorted-key order). Consensus bases must match exactly;
+qualities may differ by ±1 on rare float32-vs-float64 rounding
+boundaries at the floor() in the Phred conversion.
+"""
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.constants import NO_FAMILY
+from duplexumiconsensusreads_tpu.kernels import (
+    apply_cycle_cap,
+    duplex_kernel,
+    fit_cycle_cap_kernel,
+    group_kernel,
+    ssc_kernel,
+)
+from duplexumiconsensusreads_tpu.oracle import (
+    call_consensus,
+    fit_cycle_error_model,
+    group_reads,
+)
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch, pad_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+def _dense_pos(batch):
+    """Host int64 pos_key -> dense i32 ids (order-preserving)."""
+    _, inv = np.unique(np.asarray(batch.pos_key), return_inverse=True)
+    return inv.astype(np.int32)
+
+
+def _run_group_kernel(batch, params, u_max=None):
+    fam, mol, n_fam, n_mol, n_over = group_kernel(
+        _dense_pos(batch),
+        np.asarray(batch.umi),
+        np.asarray(batch.strand_ab),
+        np.asarray(batch.valid),
+        strategy=params.strategy,
+        max_hamming=params.max_hamming,
+        count_ratio=params.count_ratio,
+        paired=params.paired,
+        u_max=u_max,
+    )
+    return (
+        np.asarray(fam),
+        np.asarray(mol),
+        int(n_fam),
+        int(n_mol),
+        int(n_over),
+    )
+
+
+CASES = [
+    ("exact_ss", SimConfig(n_molecules=40, duplex=False, seed=10), GroupingParams()),
+    (
+        "exact_paired",
+        SimConfig(n_molecules=30, duplex=True, seed=11),
+        GroupingParams(strategy="exact", paired=True),
+    ),
+    (
+        "adj_ss",
+        SimConfig(n_molecules=25, duplex=False, umi_error=0.04, mean_family_size=6, seed=12),
+        GroupingParams(strategy="adjacency"),
+    ),
+    (
+        "adj_paired",
+        SimConfig(n_molecules=20, duplex=True, umi_error=0.03, mean_family_size=5, seed=13),
+        GroupingParams(strategy="adjacency", paired=True),
+    ),
+]
+
+
+@pytest.mark.parametrize("name,cfg,gp", CASES, ids=[c[0] for c in CASES])
+def test_grouping_parity(name, cfg, gp):
+    batch, _ = simulate_batch(cfg)
+    batch = pad_batch(batch, batch.n_reads + 37)  # exercise padding slots
+    oracle = group_reads(batch, gp)
+    fam, mol, n_fam, n_mol, n_over = _run_group_kernel(batch, gp)
+    assert n_over == 0
+    assert n_fam == int(oracle.n_families)
+    assert n_mol == int(oracle.n_molecules)
+    np.testing.assert_array_equal(fam, np.asarray(oracle.family_id))
+    np.testing.assert_array_equal(mol, np.asarray(oracle.molecule_id))
+
+
+def test_grouping_overflow_flagged():
+    cfg = SimConfig(n_molecules=40, duplex=False, seed=14)
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(strategy="adjacency")
+    fam, mol, n_fam, n_mol, n_over = _run_group_kernel(batch, gp, u_max=8)
+    assert n_over > 0
+    assert (fam[np.asarray(batch.valid)] == NO_FAMILY).sum() == n_over
+
+
+def _qual_close(q_dev, q_orc, where):
+    d = np.abs(q_dev.astype(int) - q_orc.astype(int))[where]
+    assert (d <= 1).all(), f"qual diff >1 at {np.argwhere(d > 1)[:5]}"
+
+
+@pytest.mark.parametrize("method", ["matmul", "segment"])
+def test_ssc_parity(method):
+    cfg = SimConfig(n_molecules=40, duplex=False, base_error=0.02, n_frac=0.05, seed=15)
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams()
+    oracle_f = group_reads(batch, gp)
+    cp = ConsensusParams(mode="single_strand", min_reads=2)
+    oracle_c = call_consensus(batch, oracle_f, cp)
+
+    f_max = batch.n_reads
+    cb, cq, dep, size, fvalid = ssc_kernel(
+        np.asarray(batch.bases),
+        np.asarray(batch.quals),
+        np.asarray(oracle_f.family_id),
+        np.asarray(batch.valid),
+        f_max=f_max,
+        min_reads=cp.min_reads,
+        max_qual=cp.max_qual,
+        max_input_qual=cp.max_input_qual,
+        method=method,
+    )
+    n_fam = int(oracle_f.n_families)
+    cb, cq, dep, fvalid = (
+        np.asarray(cb)[:n_fam],
+        np.asarray(cq)[:n_fam],
+        np.asarray(dep)[:n_fam],
+        np.asarray(fvalid)[:n_fam],
+    )
+    np.testing.assert_array_equal(fvalid, oracle_c.valid)
+    np.testing.assert_array_equal(dep[fvalid], oracle_c.depth[fvalid])
+    np.testing.assert_array_equal(cb[fvalid], oracle_c.bases[fvalid])
+    _qual_close(cq, oracle_c.quals, fvalid[:, None] & np.ones_like(cq, bool))
+
+
+def test_duplex_parity():
+    cfg = SimConfig(n_molecules=50, duplex=True, base_error=0.04, mean_family_size=4, seed=16)
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(strategy="exact", paired=True)
+    fams = group_reads(batch, gp)
+    cp = ConsensusParams(mode="duplex", min_reads=1, min_duplex_reads=2)
+    oracle_dx = call_consensus(batch, fams, cp)
+
+    f_max = m_max = batch.n_reads
+    cb, cq, dep, size, fvalid = ssc_kernel(
+        np.asarray(batch.bases),
+        np.asarray(batch.quals),
+        np.asarray(fams.family_id),
+        np.asarray(batch.valid),
+        f_max=f_max,
+        min_reads=cp.min_reads,
+        max_qual=cp.max_qual,
+        max_input_qual=cp.max_input_qual,
+    )
+    db, dq, dd, dvalid = duplex_kernel(
+        cb,
+        cq,
+        dep,
+        fvalid,
+        np.asarray(fams.family_id),
+        np.asarray(fams.molecule_id),
+        np.asarray(batch.strand_ab),
+        np.asarray(batch.valid),
+        m_max=m_max,
+        min_duplex_reads=cp.min_duplex_reads,
+        max_qual=cp.max_qual,
+    )
+    n_mol = int(fams.n_molecules)
+    db, dq, dd, dvalid = (
+        np.asarray(db)[:n_mol],
+        np.asarray(dq)[:n_mol],
+        np.asarray(dd)[:n_mol],
+        np.asarray(dvalid)[:n_mol],
+    )
+    np.testing.assert_array_equal(dvalid, oracle_dx.valid)
+    np.testing.assert_array_equal(db[dvalid], oracle_dx.bases[dvalid])
+    np.testing.assert_array_equal(dd[dvalid], oracle_dx.depth[dvalid])
+    # duplex quals: sums/differences of ±1-rounded ssc quals → allow ±2
+    d = np.abs(dq.astype(int) - oracle_dx.quals.astype(int))[dvalid]
+    assert (d <= 2).all()
+
+
+def test_error_model_parity():
+    cfg = SimConfig(
+        n_molecules=60,
+        duplex=False,
+        base_error=0.003,
+        cycle_error_slope=0.002,
+        mean_family_size=6,
+        read_len=60,
+        seed=17,
+    )
+    batch, _ = simulate_batch(cfg)
+    fams = group_reads(batch, GroupingParams())
+    cp = ConsensusParams(mode="single_strand")
+    oracle_c = call_consensus(batch, fams, cp)
+    cap_oracle = fit_cycle_error_model(batch, fams, oracle_c)
+
+    f_max = batch.n_reads
+    cb, cq, dep, size, fvalid = ssc_kernel(
+        np.asarray(batch.bases),
+        np.asarray(batch.quals),
+        np.asarray(fams.family_id),
+        np.asarray(batch.valid),
+        f_max=f_max,
+        min_reads=cp.min_reads,
+        max_qual=cp.max_qual,
+        max_input_qual=cp.max_input_qual,
+    )
+    cap_dev = np.asarray(
+        fit_cycle_cap_kernel(
+            np.asarray(batch.bases),
+            np.asarray(fams.family_id),
+            np.asarray(batch.valid),
+            cb,
+            fvalid,
+        )
+    )
+    assert (np.abs(cap_dev.astype(int) - cap_oracle.astype(int)) <= 1).all()
+    q2 = np.asarray(apply_cycle_cap(np.asarray(batch.quals), cap_dev))
+    assert (q2 <= np.asarray(batch.quals)).all()

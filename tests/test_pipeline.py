@@ -1,0 +1,204 @@
+"""End-to-end fused pipeline + bucketing + mesh sharding tests.
+
+All five benchmark configs (BASELINE.json `configs`) are exercised:
+  1. ss consensus, exact grouping
+  2. adjacency grouping (Hamming<=1)
+  3. duplex consensus
+  4. bucketed shards across an 8-device mesh
+  5. per-cycle error model + duplex
+and results are checked against the oracle operator path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
+from duplexumiconsensusreads_tpu.oracle import call_consensus, group_reads
+from duplexumiconsensusreads_tpu.ops import (
+    ConsensusCaller,
+    PipelineSpec,
+    UmiGrouper,
+    fused_pipeline,
+    run_bucket,
+)
+from duplexumiconsensusreads_tpu.parallel import make_mesh, sharded_pipeline
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+def _oracle_pipeline(batch, gp, cp):
+    fams = group_reads(batch, gp)
+    caller = ConsensusCaller(cp, backend="cpu")
+    return fams, caller(batch, fams)
+
+
+def _check_bucket_against_oracle(bucket, out, gp, cp):
+    """Re-run the oracle on exactly the bucket's reads and compare."""
+    from duplexumiconsensusreads_tpu.types import ReadBatch
+
+    sub = ReadBatch(
+        bases=bucket.bases,
+        quals=bucket.quals,
+        umi=bucket.umi,
+        pos_key=bucket.pos.astype(np.int64),
+        strand_ab=bucket.strand_ab,
+        valid=bucket.valid,
+    )
+    fams, cons = _oracle_pipeline(sub, gp, cp)
+    n = len(cons.valid)
+    np.testing.assert_array_equal(np.asarray(out["family_id"]), fams.family_id)
+    np.testing.assert_array_equal(np.asarray(out["molecule_id"]), fams.molecule_id)
+    ov = np.asarray(out["cons_valid"])[:n]
+    np.testing.assert_array_equal(ov, cons.valid)
+    np.testing.assert_array_equal(
+        np.asarray(out["cons_base"])[:n][ov], cons.bases[ov]
+    )
+    dq = np.abs(
+        np.asarray(out["cons_qual"])[:n][ov].astype(int) - cons.quals[ov].astype(int)
+    )
+    # f32-vs-f64 floor rounding: ±1 per strand ssc, ±1 more through the
+    # error-model qual cap; duplex sums two strands → up to 3, and rarely
+    assert (dq <= 3).all()
+    assert (dq <= 1).mean() > 0.97
+
+
+CONFIGS = [
+    (
+        "cfg1_ss_exact",
+        SimConfig(n_molecules=50, duplex=False, seed=20),
+        GroupingParams(strategy="exact"),
+        ConsensusParams(mode="single_strand", min_reads=2),
+    ),
+    (
+        "cfg2_adjacency",
+        SimConfig(n_molecules=30, duplex=False, umi_error=0.04, mean_family_size=6, seed=21),
+        GroupingParams(strategy="adjacency"),
+        ConsensusParams(mode="single_strand"),
+    ),
+    (
+        "cfg3_duplex",
+        SimConfig(n_molecules=40, duplex=True, seed=22),
+        GroupingParams(strategy="exact", paired=True),
+        ConsensusParams(mode="duplex", min_duplex_reads=1),
+    ),
+    (
+        "cfg5_error_model_duplex",
+        SimConfig(
+            n_molecules=40,
+            duplex=True,
+            cycle_error_slope=0.002,
+            mean_family_size=5,
+            seed=23,
+        ),
+        GroupingParams(strategy="adjacency", paired=True),
+        ConsensusParams(mode="duplex", error_model="cycle"),
+    ),
+]
+
+
+@pytest.mark.parametrize("name,cfg,gp,cp", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_fused_pipeline_matches_oracle(name, cfg, gp, cp):
+    batch, _ = simulate_batch(cfg)
+    buckets = build_buckets(batch, capacity=512, adjacency=gp.strategy == "adjacency")
+    spec = PipelineSpec(grouping=gp, consensus=cp)
+    for bucket in buckets:
+        out = run_bucket(bucket, spec)
+        _check_bucket_against_oracle(bucket, out, gp, cp)
+
+
+def test_operator_boundary_backends_agree():
+    """UmiGrouper/ConsensusCaller (the preserved operator API) must give
+    identical results on cpu and tpu backends."""
+    cfg = SimConfig(n_molecules=30, duplex=True, umi_error=0.02, seed=24)
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex", error_model="cycle")
+
+    f_cpu = UmiGrouper(gp, backend="cpu")(batch)
+    f_tpu = UmiGrouper(gp, backend="tpu")(batch)
+    np.testing.assert_array_equal(np.asarray(f_tpu.family_id), f_cpu.family_id)
+    np.testing.assert_array_equal(np.asarray(f_tpu.molecule_id), f_cpu.molecule_id)
+
+    c_cpu = ConsensusCaller(cp, backend="cpu")(batch, f_cpu)
+    c_tpu = ConsensusCaller(cp, backend="tpu")(batch, f_tpu)
+    np.testing.assert_array_equal(c_tpu.valid, c_cpu.valid)
+    v = c_cpu.valid
+    np.testing.assert_array_equal(c_tpu.bases[v], c_cpu.bases[v])
+    assert (np.abs(c_tpu.quals[v].astype(int) - c_cpu.quals[v].astype(int)) <= 2).all()
+
+
+def test_bucketing_preserves_reads_and_groups():
+    cfg = SimConfig(n_molecules=200, n_positions=20, duplex=True, seed=25)
+    batch, _ = simulate_batch(cfg)
+    buckets = build_buckets(batch, capacity=128)
+    # every valid read appears exactly once
+    all_idx = np.concatenate([b.read_index[b.valid] for b in buckets])
+    assert sorted(all_idx) == sorted(np.nonzero(batch.valid)[0])
+    # a position group is only ever split if it exceeds the capacity
+    pos_all = np.asarray(batch.pos_key)
+    group_sizes = {p: (pos_all[batch.valid] == p).sum() for p in np.unique(pos_all)}
+    pos_of: dict = {}
+    for bi, b in enumerate(buckets):
+        for p in np.unique(pos_all[b.read_index[b.valid]]):
+            pos_of.setdefault(p, set()).add(bi)
+    for p, bs in pos_of.items():
+        if len(bs) > 1:
+            assert group_sizes[p] > 128, f"group {p} split though it fits"
+    # and within each bucket, no exact family is torn apart
+    from duplexumiconsensusreads_tpu.utils.phred import pack_umi
+
+    fam_of: dict = {}
+    for bi, b in enumerate(buckets):
+        idx = b.read_index[b.valid]
+        keys = zip(pos_all[idx], pack_umi(np.asarray(batch.umi)[idx]))
+        for k in set(keys):
+            fam_of.setdefault(k, set()).add(bi)
+    torn = [k for k, bs in fam_of.items() if len(bs) > 1]
+    assert not torn, f"families split across buckets: {torn[:3]}"
+
+
+def test_bucketing_giant_family_split():
+    """A single UMI family much larger than capacity must split into
+    multiple full buckets, not crash (deep families are routine in ctDNA)."""
+    from duplexumiconsensusreads_tpu.types import ReadBatch
+
+    n, cap = 100, 32
+    b = ReadBatch.empty(n, 20, 6)
+    b.valid[:] = True
+    b.bases[:] = 0
+    b.pos_key[:] = 1000
+    with pytest.warns(UserWarning, match="exceeds capacity"):
+        buckets = build_buckets(b, capacity=cap)
+    all_idx = np.concatenate([bk.read_index[bk.valid] for bk in buckets])
+    assert sorted(all_idx) == list(range(n))
+    assert all(bk.valid.sum() <= cap for bk in buckets)
+
+
+def test_duplex_requires_paired_grouping():
+    with pytest.raises(ValueError, match="paired"):
+        PipelineSpec(
+            grouping=GroupingParams(paired=False),
+            consensus=ConsensusParams(mode="duplex"),
+        )
+
+
+def test_sharded_pipeline_on_mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    cfg = SimConfig(n_molecules=150, n_positions=24, duplex=True, seed=26)
+    batch, truth = simulate_batch(cfg)
+    gp = GroupingParams(strategy="exact", paired=True)
+    cp = ConsensusParams(mode="duplex")
+    buckets = build_buckets(batch, capacity=256)
+    assert len(buckets) >= 2
+    mesh = make_mesh(8)
+    stacked = stack_buckets(buckets, multiple_of=8)
+    out = sharded_pipeline(stacked, PipelineSpec(grouping=gp, consensus=cp), mesh)
+    # padding buckets produce nothing
+    nb = stacked["n_real_buckets"]
+    assert np.asarray(out["cons_valid"])[nb:].sum() == 0
+    # each real bucket matches the oracle
+    for i, bucket in enumerate(buckets):
+        sub_out = {k: np.asarray(v)[i] for k, v in out.items()}
+        _check_bucket_against_oracle(bucket, sub_out, gp, cp)
