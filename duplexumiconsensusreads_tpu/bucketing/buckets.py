@@ -26,6 +26,7 @@ import warnings
 import numpy as np
 
 from duplexumiconsensusreads_tpu.constants import BASE_PAD
+from duplexumiconsensusreads_tpu.ops.grouper import dense_pos_ids
 from duplexumiconsensusreads_tpu.types import ReadBatch
 from duplexumiconsensusreads_tpu.utils.phred import pack_umi
 
@@ -65,7 +66,7 @@ def _fill_bucket(batch: ReadBatch, idx: np.ndarray, r: int) -> Bucket:
     l, b = batch.read_len, batch.umi_len
     bk = _empty_bucket(r, l, b)
     n = len(idx)
-    bk.pos[:n] = _dense(np.asarray(batch.pos_key)[idx])
+    bk.pos[:n] = dense_pos_ids(np.asarray(batch.pos_key)[idx])
     bk.umi[:n] = np.asarray(batch.umi)[idx]
     bk.strand_ab[:n] = np.asarray(batch.strand_ab)[idx]
     bk.valid[:n] = np.asarray(batch.valid)[idx]
@@ -75,11 +76,6 @@ def _fill_bucket(batch: ReadBatch, idx: np.ndarray, r: int) -> Bucket:
     key = np.stack([np.asarray(batch.pos_key)[idx], pack_umi(np.asarray(batch.umi)[idx])], 1)
     bk.n_unique_umi = len(np.unique(key, axis=0))
     return bk
-
-
-def _dense(keys: np.ndarray) -> np.ndarray:
-    _, inv = np.unique(keys, return_inverse=True)
-    return inv.astype(np.int32)
 
 
 def build_buckets(

@@ -1,0 +1,33 @@
+"""IO layer: BGZF/BAM codec and ReadBatch interchange.
+
+Produces the padded device tensors everything downstream runs on. The
+pure-Python codec here is the portable reference; io/native (C++)
+accelerates the hot decompress/parse path when built.
+"""
+
+from duplexumiconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamRecords,
+    read_bam,
+    write_bam,
+)
+from duplexumiconsensusreads_tpu.io.convert import (
+    consensus_to_records,
+    readbatch_to_records,
+    records_to_readbatch,
+    simulated_bam,
+)
+from duplexumiconsensusreads_tpu.io.npz import load_readbatch, save_readbatch
+
+__all__ = [
+    "BamHeader",
+    "BamRecords",
+    "read_bam",
+    "write_bam",
+    "records_to_readbatch",
+    "readbatch_to_records",
+    "consensus_to_records",
+    "simulated_bam",
+    "save_readbatch",
+    "load_readbatch",
+]

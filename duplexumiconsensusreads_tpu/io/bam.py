@@ -1,0 +1,311 @@
+"""Minimal BAM reader/writer over the BGZF codec.
+
+Implements the BAM binary layout (SAM spec §4) directly — magic,
+header text, reference dictionary, and alignment records — producing a
+struct-of-arrays ``BamRecords`` that converts losslessly into the
+framework's padded ``ReadBatch`` tensors (io/convert.py).
+
+Scope notes (deliberate, documented):
+- CIGAR ops are parsed and preserved round-trip but consensus math
+  operates on raw cycles for same-length family members, the fgbio-style
+  default chosen in SURVEY.md §7 ("Hard parts" item 4 — the reference
+  mount is empty, so cycle-space consensus is the contract default).
+- Aux tags: RX (UMI) is interpreted; all other tags are preserved as
+  raw bytes per record so nothing is lost on passthrough.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.io import bgzf
+
+BAM_MAGIC = b"BAM\x01"
+
+# BAM 4-bit base codes "=ACMGRSVTWYHKDBN" → framework codes (A=0 C=1
+# G=2 T=3, everything ambiguous → N=4).
+_NIBBLE_TO_CODE = np.full(16, 4, np.uint8)
+_NIBBLE_TO_CODE[1] = 0  # A
+_NIBBLE_TO_CODE[2] = 1  # C
+_NIBBLE_TO_CODE[4] = 2  # G
+_NIBBLE_TO_CODE[8] = 3  # T
+_CODE_TO_NIBBLE = np.array([1, 2, 4, 8, 15, 15], np.uint8)  # A C G T N PAD→N
+
+_CHAR_TO_CODE = np.full(256, 4, np.uint8)
+for _i, _c in enumerate("ACGT"):
+    _CHAR_TO_CODE[ord(_c)] = _i
+
+FLAG_PAIRED = 0x1
+FLAG_REVERSE = 0x10
+FLAG_MATE_REVERSE = 0x20
+FLAG_READ1 = 0x40
+FLAG_READ2 = 0x80
+
+
+@dataclasses.dataclass
+class BamHeader:
+    text: str
+    ref_names: list[str]
+    ref_lengths: list[int]
+
+    @staticmethod
+    def synthetic(ref_names=("chr1",), ref_lengths=(10_000_000,), extra: str = ""):
+        lines = ["@HD\tVN:1.6\tSO:unsorted"]
+        for n, l in zip(ref_names, ref_lengths):
+            lines.append(f"@SQ\tSN:{n}\tLN:{l}")
+        lines.append("@PG\tID:duplexumi\tPN:duplexumiconsensusreads_tpu")
+        if extra:
+            lines.append(extra)
+        return BamHeader(
+            text="\n".join(lines) + "\n",
+            ref_names=list(ref_names),
+            ref_lengths=list(ref_lengths),
+        )
+
+
+@dataclasses.dataclass
+class BamRecords:
+    """Struct-of-arrays of N alignment records (host NumPy).
+
+    seq/qual are padded to the max read length; lengths[i] gives the
+    real length. umi holds the RX tag string per record ("" if absent).
+    aux_raw preserves every record's full aux-tag byte blob.
+    """
+
+    names: list[str]
+    flags: np.ndarray      # u16 (N,)
+    ref_id: np.ndarray     # i32 (N,)
+    pos: np.ndarray        # i32 (N,) 0-based
+    mapq: np.ndarray       # u8  (N,)
+    next_ref_id: np.ndarray  # i32 (N,)
+    next_pos: np.ndarray   # i32 (N,)
+    tlen: np.ndarray       # i32 (N,)
+    lengths: np.ndarray    # i32 (N,)
+    seq: np.ndarray        # u8 (N, L) framework base codes, PAD beyond length
+    qual: np.ndarray       # u8 (N, L)
+    cigars: list[list[tuple[int, str]]]
+    umi: list[str]
+    aux_raw: list[bytes]
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+
+_CIGAR_OPS = "MIDNSHP=X"
+
+
+def _parse_aux_rx(aux: bytes) -> str:
+    """Extract the RX:Z tag from an aux blob (empty string if absent)."""
+    pos, n = 0, len(aux)
+    while pos + 3 <= n:
+        tag = aux[pos : pos + 2]
+        typ = aux[pos + 2 : pos + 3]
+        pos += 3
+        if typ in b"AcC":
+            size = 1
+        elif typ in b"sS":
+            size = 2
+        elif typ in b"iIf":
+            size = 4
+        elif typ in b"ZH":
+            end = aux.index(b"\x00", pos)
+            if tag == b"RX" and typ == b"Z":
+                return aux[pos:end].decode("ascii")
+            pos = end + 1
+            continue
+        elif typ == b"B":
+            sub = aux[pos : pos + 1]
+            cnt = struct.unpack_from("<I", aux, pos + 1)[0]
+            sub_size = {b"c": 1, b"C": 1, b"s": 2, b"S": 2, b"i": 4, b"I": 4, b"f": 4}[sub]
+            size = 5 + cnt * sub_size
+        else:
+            raise ValueError(f"unknown aux tag type {typ!r}")
+        pos += size
+    return ""
+
+
+def parse_bam(data: bytes) -> tuple[BamHeader, BamRecords]:
+    """Parse a BAM byte string (BGZF-compressed or raw) fully."""
+    if bgzf.is_bgzf(data):
+        data = bgzf.decompress(data)
+    if data[:4] != BAM_MAGIC:
+        raise ValueError("not a BAM file (bad magic)")
+    off = 4
+    (l_text,) = struct.unpack_from("<i", data, off)
+    off += 4
+    text = data[off : off + l_text].split(b"\x00", 1)[0].decode("utf-8")
+    off += l_text
+    (n_ref,) = struct.unpack_from("<i", data, off)
+    off += 4
+    ref_names, ref_lengths = [], []
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack_from("<i", data, off)
+        off += 4
+        ref_names.append(data[off : off + l_name - 1].decode("ascii"))
+        off += l_name
+        (l_ref,) = struct.unpack_from("<i", data, off)
+        off += 4
+        ref_lengths.append(l_ref)
+    header = BamHeader(text=text, ref_names=ref_names, ref_lengths=ref_lengths)
+
+    names: list[str] = []
+    flags, ref_id, pos_, mapq = [], [], [], []
+    next_ref, next_pos, tlen, lengths = [], [], [], []
+    seqs: list[np.ndarray] = []
+    quals: list[np.ndarray] = []
+    cigars: list[list[tuple[int, str]]] = []
+    umis: list[str] = []
+    aux_raws: list[bytes] = []
+
+    n_total = len(data)
+    while off < n_total:
+        (block_size,) = struct.unpack_from("<i", data, off)
+        off += 4
+        rec_end = off + block_size
+        (rid, p, l_rn, mq, _bin, n_cig, flag, l_seq, nrid, npos, tl) = struct.unpack_from(
+            "<iiBBHHHiiii", data, off
+        )
+        off += 32
+        names.append(data[off : off + l_rn - 1].decode("ascii"))
+        off += l_rn
+        cig = []
+        for _ in range(n_cig):
+            (v,) = struct.unpack_from("<I", data, off)
+            off += 4
+            cig.append((v >> 4, _CIGAR_OPS[v & 0xF]))
+        packed = np.frombuffer(data, np.uint8, (l_seq + 1) // 2, off)
+        off += (l_seq + 1) // 2
+        nib = np.empty(2 * len(packed), np.uint8)
+        nib[0::2] = packed >> 4
+        nib[1::2] = packed & 0xF
+        seqs.append(_NIBBLE_TO_CODE[nib[:l_seq]])
+        q = np.frombuffer(data, np.uint8, l_seq, off).copy()
+        off += l_seq
+        if l_seq and q[0] == 0xFF:
+            q[:] = 0
+        quals.append(q)
+        aux = data[off:rec_end]
+        off = rec_end
+        flags.append(flag)
+        ref_id.append(rid)
+        pos_.append(p)
+        mapq.append(mq)
+        next_ref.append(nrid)
+        next_pos.append(npos)
+        tlen.append(tl)
+        lengths.append(l_seq)
+        cigars.append(cig)
+        umis.append(_parse_aux_rx(aux))
+        aux_raws.append(bytes(aux))
+
+    n = len(names)
+    lmax = int(max(lengths, default=0))
+    from duplexumiconsensusreads_tpu.constants import BASE_PAD
+
+    seq_arr = np.full((n, lmax), BASE_PAD, np.uint8)
+    qual_arr = np.zeros((n, lmax), np.uint8)
+    for i, (s, q) in enumerate(zip(seqs, quals)):
+        seq_arr[i, : len(s)] = s
+        qual_arr[i, : len(q)] = q
+
+    recs = BamRecords(
+        names=names,
+        flags=np.asarray(flags, np.uint16),
+        ref_id=np.asarray(ref_id, np.int32),
+        pos=np.asarray(pos_, np.int32),
+        mapq=np.asarray(mapq, np.uint8),
+        next_ref_id=np.asarray(next_ref, np.int32),
+        next_pos=np.asarray(next_pos, np.int32),
+        tlen=np.asarray(tlen, np.int32),
+        lengths=np.asarray(lengths, np.int32),
+        seq=seq_arr,
+        qual=qual_arr,
+        cigars=cigars,
+        umi=umis,
+        aux_raw=aux_raws,
+    )
+    return header, recs
+
+
+def read_bam(path: str) -> tuple[BamHeader, BamRecords]:
+    with open(path, "rb") as f:
+        return parse_bam(f.read())
+
+
+def _reg2bin(beg: int, end: int) -> int:
+    """SAM spec §5.3 bin computation."""
+    end -= 1
+    if beg >> 14 == end >> 14:
+        return ((1 << 15) - 1) // 7 + (beg >> 14)
+    if beg >> 17 == end >> 17:
+        return ((1 << 12) - 1) // 7 + (beg >> 17)
+    if beg >> 20 == end >> 20:
+        return ((1 << 9) - 1) // 7 + (beg >> 20)
+    if beg >> 23 == end >> 23:
+        return ((1 << 6) - 1) // 7 + (beg >> 23)
+    if beg >> 26 == end >> 26:
+        return ((1 << 3) - 1) // 7 + (beg >> 26)
+    return 0
+
+
+def serialize_bam(header: BamHeader, recs: BamRecords) -> bytes:
+    """Serialize header + records to uncompressed BAM bytes."""
+    out = bytearray()
+    out += BAM_MAGIC
+    text = header.text.encode("utf-8")
+    out += struct.pack("<i", len(text))
+    out += text
+    out += struct.pack("<i", len(header.ref_names))
+    for name, length in zip(header.ref_names, header.ref_lengths):
+        nb = name.encode("ascii") + b"\x00"
+        out += struct.pack("<i", len(nb)) + nb + struct.pack("<i", length)
+
+    op_idx = {c: i for i, c in enumerate(_CIGAR_OPS)}
+    for i in range(len(recs)):
+        name_b = recs.names[i].encode("ascii") + b"\x00"
+        l_seq = int(recs.lengths[i])
+        cig = recs.cigars[i]
+        seq_codes = recs.seq[i, :l_seq]
+        nib = _CODE_TO_NIBBLE[seq_codes]
+        if l_seq % 2:
+            nib = np.append(nib, 0)
+        packed = ((nib[0::2] << 4) | nib[1::2]).astype(np.uint8).tobytes()
+        qual = recs.qual[i, :l_seq].tobytes()
+        aux = recs.aux_raw[i]
+        p = int(recs.pos[i])
+        body = struct.pack(
+            "<iiBBHHHiiii",
+            int(recs.ref_id[i]),
+            p,
+            len(name_b),
+            int(recs.mapq[i]),
+            _reg2bin(max(p, 0), max(p, 0) + max(l_seq, 1)),
+            len(cig),
+            int(recs.flags[i]),
+            l_seq,
+            int(recs.next_ref_id[i]),
+            int(recs.next_pos[i]),
+            int(recs.tlen[i]),
+        )
+        body += name_b
+        for n_op, op in cig:
+            body += struct.pack("<I", (n_op << 4) | op_idx[op])
+        body += packed + qual + aux
+        out += struct.pack("<i", len(body)) + body
+    return bytes(out)
+
+
+def write_bam(path: str, header: BamHeader, recs: BamRecords, level: int = 6) -> None:
+    with open(path, "wb") as f:
+        f.write(bgzf.compress(serialize_bam(header, recs), level=level))
+
+
+def make_aux_z(tag: str, value: str) -> bytes:
+    return tag.encode("ascii") + b"Z" + value.encode("ascii") + b"\x00"
+
+
+def make_aux_i(tag: str, value: int) -> bytes:
+    return tag.encode("ascii") + b"i" + struct.pack("<i", value)

@@ -1,0 +1,123 @@
+"""BGZF block codec (pure Python + zlib).
+
+BGZF is the blocked-gzip container BAM files live in: a series of
+standard gzip members, each carrying an extra "BC" subfield with the
+compressed block size, terminated by a fixed 28-byte empty EOF block.
+Because each member is independently decompressible, the format
+supports random access and parallel decompression — the property the
+native C++ loader (io/native) exploits; this module is the portable
+reference implementation.
+
+No pysam/htslib exists in this environment (SURVEY.md §7 "Hard parts"
+item 4), so the codec is built from the BGZF spec directly.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io as _io
+import struct
+import zlib
+
+# Fixed empty gzip member marking end-of-file (BGZF spec appendix).
+BGZF_EOF = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000"
+)
+
+# Max uncompressed payload per block. The format caps the *compressed*
+# block at 65536; 65280 uncompressed leaves headroom like htslib does.
+MAX_BLOCK_UNCOMPRESSED = 65280
+
+_HEADER = struct.Struct("<BBBBIBBH")  # magic1 magic2 CM FLG MTIME XFL OS XLEN
+
+
+def read_block_size(data: bytes, offset: int) -> int:
+    """Total compressed size of the block starting at ``offset``.
+
+    Parses the gzip FEXTRA subfields looking for BC (SI1=66, SI2=67).
+    """
+    if data[offset : offset + 2] != b"\x1f\x8b":
+        raise ValueError(f"not a gzip member at offset {offset}")
+    flg = data[offset + 3]
+    if not flg & 4:  # FEXTRA
+        raise ValueError("gzip member without FEXTRA: not BGZF")
+    xlen = struct.unpack_from("<H", data, offset + 10)[0]
+    pos = offset + 12
+    end = pos + xlen
+    while pos + 4 <= end:
+        si1, si2, slen = data[pos], data[pos + 1], struct.unpack_from("<H", data, pos + 2)[0]
+        if si1 == 66 and si2 == 67:
+            if slen != 2:
+                raise ValueError("BC subfield with SLEN != 2")
+            return struct.unpack_from("<H", data, pos + 4)[0] + 1
+        pos += 4 + slen
+    raise ValueError("no BC subfield: not BGZF")
+
+
+def iter_block_offsets(data: bytes):
+    """Yield (offset, size) for every BGZF block in ``data``."""
+    off = 0
+    n = len(data)
+    while off < n:
+        size = read_block_size(data, off)
+        yield off, size
+        off += size
+    if off != n:
+        raise ValueError("trailing garbage after last BGZF block")
+
+
+def decompress_block(data: bytes, offset: int, size: int) -> bytes:
+    """Decompress one block given its offset and compressed size."""
+    xlen = struct.unpack_from("<H", data, offset + 10)[0]
+    start = offset + 12 + xlen
+    # last 8 bytes are CRC32 + ISIZE
+    payload = data[start : offset + size - 8]
+    out = zlib.decompress(payload, wbits=-15)
+    crc, isize = struct.unpack_from("<II", data, offset + size - 8)
+    if len(out) != isize or zlib.crc32(out) != crc:
+        raise ValueError(f"BGZF block at {offset}: CRC/size mismatch")
+    return out
+
+
+def decompress(data: bytes) -> bytes:
+    """Decompress a whole BGZF byte string (fast path: C gzip handles
+    concatenated members natively; falls back to per-block on error)."""
+    try:
+        return gzip.decompress(data)
+    except Exception:
+        return b"".join(
+            decompress_block(data, off, size) for off, size in iter_block_offsets(data)
+        )
+
+
+def compress_block(payload: bytes, level: int = 6) -> bytes:
+    """Compress one ≤MAX_BLOCK_UNCOMPRESSED payload into a BGZF block."""
+    if len(payload) > MAX_BLOCK_UNCOMPRESSED:
+        raise ValueError("payload too large for one BGZF block")
+    c = zlib.compressobj(level, zlib.DEFLATED, -15)
+    body = c.compress(payload) + c.flush()
+    bsize = len(body) + 12 + 6 + 8  # header(12) + xtra(6) + body + tail(8)
+    header = _HEADER.pack(0x1F, 0x8B, 8, 4, 0, 0, 0xFF, 6)
+    xtra = struct.pack("<BBHH", 66, 67, 2, bsize - 1)
+    tail = struct.pack("<II", zlib.crc32(payload), len(payload))
+    return header + xtra + body + tail
+
+
+def compress(data: bytes, level: int = 6, eof: bool = True) -> bytes:
+    """Compress bytes into a BGZF stream (with EOF block by default)."""
+    out = _io.BytesIO()
+    for i in range(0, len(data), MAX_BLOCK_UNCOMPRESSED):
+        out.write(compress_block(data[i : i + MAX_BLOCK_UNCOMPRESSED], level))
+    if eof:
+        out.write(BGZF_EOF)
+    return out.getvalue()
+
+
+def is_bgzf(data: bytes) -> bool:
+    if len(data) < 18 or data[:2] != b"\x1f\x8b":
+        return False
+    try:
+        read_block_size(data, 0)
+        return True
+    except ValueError:
+        return False

@@ -1,0 +1,259 @@
+"""BamRecords ↔ ReadBatch conversion: where alignment records become
+the HBM-resident padded tensors the kernels run on.
+
+Conventions (the contract between io and grouping — SURVEY.md §7):
+
+- **UMI**: the RX:Z aux tag, segments joined in read order ("ACG-TTG"
+  → 6 codes). Reads with a missing RX or an N inside the UMI are marked
+  invalid (the conventional fgbio/UMI-tools behaviour of dropping
+  un-groupable reads) and counted in the returned info dict.
+- **Duplex strand** (paired mode): a read observes the *top* (AB)
+  strand iff it is read1-forward or read2-reverse (F1R2); the
+  complementary F2R1 orientation is the bottom (BA) strand. For
+  unpaired records the reverse flag alone decides. BA reads have their
+  two UMI segments swapped so both strands of one source molecule carry
+  the identical canonical UMI pair — molecule identity is then exactly
+  (pos_key, clustered UMI) as oracle/grouping.py defines it.
+- **pos_key**: i64 packing (ref_id << 36) | canonical fragment start,
+  where the canonical start is min(pos, next_pos) for properly-paired
+  records (both mates and both strands of a molecule share it) and pos
+  otherwise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.constants import BASE_PAD, N_REAL_BASES
+from duplexumiconsensusreads_tpu.io.bam import (
+    FLAG_PAIRED,
+    FLAG_READ1,
+    FLAG_READ2,
+    FLAG_REVERSE,
+    BamHeader,
+    BamRecords,
+    make_aux_i,
+    make_aux_z,
+)
+from duplexumiconsensusreads_tpu.types import ReadBatch
+
+UMI_SEP = "-"
+_POS_BITS = 36
+_POS_MASK = (1 << _POS_BITS) - 1
+
+_CHAR_TO_CODE = {c: i for i, c in enumerate("ACGT")}
+_CODE_TO_CHAR = "ACGTN."
+
+
+def pack_pos_key(ref_id: np.ndarray, coord: np.ndarray) -> np.ndarray:
+    return (np.asarray(ref_id, np.int64) << _POS_BITS) | (
+        np.asarray(coord, np.int64) & _POS_MASK
+    )
+
+
+def unpack_pos_key(key: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    key = np.asarray(key, np.int64)
+    return (key >> _POS_BITS).astype(np.int32), (key & _POS_MASK).astype(np.int32)
+
+
+def umi_string_to_codes(rx: str) -> np.ndarray | None:
+    """RX string → u8 codes; None if any base is not ACGT."""
+    s = rx.replace(UMI_SEP, "")
+    codes = np.empty(len(s), np.uint8)
+    for i, c in enumerate(s.upper()):
+        v = _CHAR_TO_CODE.get(c)
+        if v is None:
+            return None
+        codes[i] = v
+    return codes
+
+
+def umi_codes_to_string(codes: np.ndarray, paired: bool) -> str:
+    s = "".join(_CODE_TO_CHAR[int(c)] for c in codes)
+    if paired:
+        h = len(s) // 2
+        return s[:h] + UMI_SEP + s[h:]
+    return s
+
+
+def read_is_top_strand(flag: int) -> bool:
+    if flag & FLAG_PAIRED:
+        r1 = bool(flag & FLAG_READ1)
+        rev = bool(flag & FLAG_REVERSE)
+        return r1 != rev  # F1R2 → top
+    return not flag & FLAG_REVERSE
+
+
+def records_to_readbatch(
+    recs: BamRecords, duplex: bool = True
+) -> tuple[ReadBatch, dict]:
+    """Convert parsed BAM records into a padded ReadBatch.
+
+    Returns (batch, info); info counts reads dropped for missing/N UMIs
+    or inconsistent UMI length. Dropped reads occupy invalid slots so
+    read indices stay aligned with ``recs``.
+    """
+    n = len(recs)
+    l = recs.seq.shape[1] if n else 0
+
+    umi_len = 0
+    umi_codes: list[np.ndarray | None] = []
+    for rx in recs.umi:
+        codes = umi_string_to_codes(rx) if rx else None
+        umi_codes.append(codes)
+        if codes is not None and len(codes) > umi_len:
+            umi_len = len(codes)
+
+    batch = ReadBatch.empty(n, l, umi_len)
+    n_no_umi = n_bad_len = 0
+    flags = np.asarray(recs.flags)
+    paired_ok = (
+        (flags & FLAG_PAIRED).astype(bool)
+        & (recs.next_ref_id == recs.ref_id)
+        & (recs.next_pos >= 0)
+    )
+    coord = np.where(
+        paired_ok, np.minimum(recs.pos, recs.next_pos), recs.pos
+    )
+    pos_key = pack_pos_key(recs.ref_id, coord)
+
+    for i in range(n):
+        codes = umi_codes[i]
+        if codes is None:
+            n_no_umi += 1
+            continue
+        if len(codes) != umi_len:
+            n_bad_len += 1
+            continue
+        top = read_is_top_strand(int(flags[i]))
+        if duplex and not top:
+            h = umi_len // 2
+            codes = np.concatenate([codes[h:], codes[:h]])
+        batch.umi[i] = codes
+        batch.strand_ab[i] = top
+        batch.valid[i] = True
+    batch.bases[:] = recs.seq
+    batch.quals[:] = recs.qual
+    batch.pos_key[:] = pos_key
+
+    info = {
+        "n_records": n,
+        "n_valid": int(batch.valid.sum()),
+        "n_dropped_no_umi": n_no_umi,
+        "n_dropped_umi_len": n_bad_len,
+        "umi_len": umi_len,
+    }
+    return batch, info
+
+
+def readbatch_to_records(
+    batch: ReadBatch,
+    duplex: bool = True,
+    names: list[str] | None = None,
+) -> BamRecords:
+    """Inverse of records_to_readbatch for synthetic data: emit
+    single-end records whose reverse flag encodes the strand and whose
+    RX segments are de-canonicalised (swapped back for BA reads)."""
+    valid = np.asarray(batch.valid, bool)
+    idx = np.nonzero(valid)[0]
+    n = len(idx)
+    l = batch.read_len
+    lengths = np.full(n, l, np.int32)
+    ref_id, pos = unpack_pos_key(np.asarray(batch.pos_key)[idx])
+    strand = np.asarray(batch.strand_ab, bool)[idx]
+    flags = np.where(strand, 0, FLAG_REVERSE).astype(np.uint16)
+
+    umis = []
+    for j, i in enumerate(idx):
+        codes = np.asarray(batch.umi)[i]
+        if duplex and not strand[j]:
+            h = len(codes) // 2
+            codes = np.concatenate([codes[h:], codes[:h]])
+        umis.append(umi_codes_to_string(codes, paired=duplex))
+
+    seq = np.asarray(batch.bases)[idx]
+    # PAD cycles inside a record are not representable; render as N
+    seq = np.where(seq == BASE_PAD, 4, seq).astype(np.uint8)
+
+    return BamRecords(
+        names=(names or [f"read{i}" for i in idx]),
+        flags=flags,
+        ref_id=ref_id,
+        pos=pos,
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.full(n, -1, np.int32),
+        next_pos=np.full(n, -1, np.int32),
+        tlen=np.zeros(n, np.int32),
+        lengths=lengths,
+        seq=seq,
+        qual=np.asarray(batch.quals)[idx],
+        cigars=[[(l, "M")] for _ in range(n)],
+        umi=umis,
+        aux_raw=[make_aux_z("RX", u) for u in umis],
+    )
+
+
+def consensus_to_records(
+    cons_base: np.ndarray,  # (F, L) u8
+    cons_qual: np.ndarray,  # (F, L) u8
+    cons_depth: np.ndarray,  # (F, L) i32
+    cons_valid: np.ndarray,  # (F,) bool
+    fam_pos_key: np.ndarray,  # (F,) i64 representative pos_key per family
+    fam_umi: np.ndarray,  # (F, U) u8 representative canonical UMI per family
+    duplex: bool,
+    name_prefix: str = "cons",
+) -> BamRecords:
+    """Build consensus BAM records from (scattered-back) pipeline output.
+
+    Emitted per valid family/molecule: a mapped record at the family's
+    canonical position with RX (canonical UMI), cD (max depth) and cM
+    (min positive depth) aux tags — the fgbio-style consensus metadata.
+    """
+    idx = np.nonzero(np.asarray(cons_valid, bool))[0]
+    n = len(idx)
+    l = cons_base.shape[1]
+    ref_id, pos = unpack_pos_key(fam_pos_key[idx])
+    names, umis, aux = [], [], []
+    for k, f in enumerate(idx):
+        rx = umi_codes_to_string(fam_umi[f], paired=duplex)
+        depth = cons_depth[f]
+        pos_depth = depth[depth > 0]
+        c_max = int(depth.max()) if depth.size else 0
+        c_min = int(pos_depth.min()) if pos_depth.size else 0
+        names.append(f"{name_prefix}:{int(ref_id[k])}:{int(pos[k])}:{int(f)}")
+        umis.append(rx)
+        aux.append(make_aux_z("RX", rx) + make_aux_i("cD", c_max) + make_aux_i("cM", c_min))
+    return BamRecords(
+        names=names,
+        flags=np.zeros(n, np.uint16),
+        ref_id=ref_id,
+        pos=pos,
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.full(n, -1, np.int32),
+        next_pos=np.full(n, -1, np.int32),
+        tlen=np.zeros(n, np.int32),
+        lengths=np.full(n, l, np.int32),
+        seq=np.where(cons_base[idx] == BASE_PAD, 4, cons_base[idx]).astype(np.uint8),
+        qual=cons_qual[idx].astype(np.uint8),
+        cigars=[[(l, "M")] for _ in range(n)],
+        umi=umis,
+        aux_raw=aux,
+    )
+
+
+def simulated_bam(cfg=None, path: str | None = None):
+    """Simulate a truth-aware batch and render it as a BAM (bytes or file).
+
+    Convenience used by the CLI's `simulate` subcommand and tests.
+    Returns (header, records, batch, truth).
+    """
+    from duplexumiconsensusreads_tpu.io.bam import write_bam
+    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+
+    cfg = cfg or SimConfig()
+    batch, truth = simulate_batch(cfg)
+    header = BamHeader.synthetic()
+    recs = readbatch_to_records(batch, duplex=cfg.duplex)
+    if path is not None:
+        write_bam(path, header, recs)
+    return header, recs, batch, truth

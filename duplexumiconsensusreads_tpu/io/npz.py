@@ -1,0 +1,25 @@
+"""Packed .npz interchange for ReadBatch tensors.
+
+The testing/benchmark format SURVEY.md §7 calls for ("a simple packed
+.npz/Arrow interchange so tests don't need real BAMs"): a ReadBatch is
+six named arrays in one compressed npz, loadable straight onto device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.types import ReadBatch
+
+_FIELDS = ("bases", "quals", "umi", "pos_key", "strand_ab", "valid")
+
+
+def save_readbatch(path: str, batch: ReadBatch) -> None:
+    np.savez_compressed(
+        path, **{name: np.asarray(getattr(batch, name)) for name in _FIELDS}
+    )
+
+
+def load_readbatch(path: str) -> ReadBatch:
+    with np.load(path) as z:
+        return ReadBatch(**{name: z[name] for name in _FIELDS})

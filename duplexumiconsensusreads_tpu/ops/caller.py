@@ -30,6 +30,10 @@ from duplexumiconsensusreads_tpu.types import (
 )
 
 
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
 class ConsensusCaller:
     def __init__(
         self,
@@ -69,7 +73,11 @@ class ConsensusCaller:
         quals = np.asarray(batch.quals)
         valid = np.asarray(batch.valid)
         fam = np.asarray(fams.family_id)
-        f_max = batch.n_reads
+        # Family axis sized from the actual family count (known host-side
+        # at this operator boundary), rounded to a power of two so jit
+        # recompiles O(log N) times, not per batch. Padding to n_reads
+        # would make the one-hot GEMM quadratic in batch size.
+        f_max = _pow2(int(fams.n_families))
 
         def ssc(q):
             return ssc_kernel(
@@ -111,7 +119,7 @@ class ConsensusCaller:
             np.asarray(fams.molecule_id),
             np.asarray(batch.strand_ab),
             valid,
-            m_max=batch.n_reads,
+            m_max=_pow2(int(fams.n_molecules)),
             min_duplex_reads=p.min_duplex_reads,
             max_qual=p.max_qual,
         )

@@ -43,6 +43,24 @@ class UmiGrouper:
         if self.backend == "cpu":
             return _oracle_group(batch, self.params)
         p = self.params
+        u_max = self.u_max
+        if u_max is None and p.strategy == "adjacency":
+            # Size the unique-UMI table from the data (cheap host count,
+            # rounded to a power of two to bound recompiles) instead of
+            # defaulting to n_reads, which would make the all-pairs
+            # Hamming/reachability matrices quadratic in batch size.
+            from duplexumiconsensusreads_tpu.utils.phred import pack_umi
+
+            valid = np.asarray(batch.valid, bool)
+            key = np.stack(
+                [
+                    np.asarray(batch.pos_key)[valid],
+                    pack_umi(np.asarray(batch.umi)[valid]),
+                ],
+                axis=1,
+            )
+            n_unique = max(len(np.unique(key, axis=0)), 1)
+            u_max = 1 << (n_unique - 1).bit_length()
         fam, mol, n_fam, n_mol, n_over = group_kernel(
             dense_pos_ids(batch.pos_key),
             np.asarray(batch.umi),
@@ -52,7 +70,7 @@ class UmiGrouper:
             max_hamming=p.max_hamming,
             count_ratio=p.count_ratio,
             paired=p.paired,
-            u_max=self.u_max,
+            u_max=u_max,
         )
         if int(n_over):
             import warnings

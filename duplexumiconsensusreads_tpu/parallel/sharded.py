@@ -34,11 +34,9 @@ def _vmapped(pos, umi, strand_ab, valid, bases, quals, spec):
     )(pos, umi, strand_ab, valid, bases, quals)
 
 
-def sharded_pipeline(
-    stacked: dict, spec: PipelineSpec, mesh: Mesh, axis: str = "data"
-) -> dict:
-    """Run all buckets across the mesh; returns stacked outputs (B, ...)."""
-    args = shard_stacked(stacked, mesh, axis)
+def presharded_pipeline(args: dict, spec: PipelineSpec, mesh: Mesh) -> dict:
+    """Run the vmapped pipeline on already-device-resident sharded args
+    (from shard_stacked) — the pure-compute path benchmarks should time."""
     with mesh:
         return _vmapped(
             args["pos"],
@@ -49,3 +47,10 @@ def sharded_pipeline(
             args["quals"],
             spec,
         )
+
+
+def sharded_pipeline(
+    stacked: dict, spec: PipelineSpec, mesh: Mesh, axis: str = "data"
+) -> dict:
+    """Run all buckets across the mesh; returns stacked outputs (B, ...)."""
+    return presharded_pipeline(shard_stacked(stacked, mesh, axis), spec, mesh)

@@ -16,5 +16,10 @@ import os
 import jax
 
 if not os.environ.get("DUT_TEST_TPU"):
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        # backend already initialised (pre-provisioned via XLA_FLAGS or a
+        # plugin touching jax.devices() first) — run on whatever exists
+        pass

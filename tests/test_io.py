@@ -1,0 +1,172 @@
+"""IO layer tests: BGZF codec, BAM parse/serialize roundtrip, and the
+BamRecords ↔ ReadBatch conversion contract (strand derivation, UMI
+canonicalisation, pos_key packing)."""
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.io import bgzf
+from duplexumiconsensusreads_tpu.io.bam import (
+    FLAG_PAIRED,
+    FLAG_READ1,
+    FLAG_READ2,
+    FLAG_REVERSE,
+    BamHeader,
+    parse_bam,
+    read_bam,
+    serialize_bam,
+    write_bam,
+)
+from duplexumiconsensusreads_tpu.io.convert import (
+    pack_pos_key,
+    read_is_top_strand,
+    readbatch_to_records,
+    records_to_readbatch,
+    simulated_bam,
+    unpack_pos_key,
+)
+from duplexumiconsensusreads_tpu.io.npz import load_readbatch, save_readbatch
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+
+
+class TestBgzf:
+    def test_roundtrip_small(self):
+        data = b"hello bgzf world" * 100
+        assert bgzf.decompress(bgzf.compress(data)) == data
+
+    def test_roundtrip_multiblock(self):
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, size=200_000, dtype=np.uint8).tobytes()
+        comp = bgzf.compress(data)
+        assert bgzf.decompress(comp) == data
+        # must be multiple independent blocks + EOF marker
+        offsets = list(bgzf.iter_block_offsets(comp))
+        assert len(offsets) >= 4
+        assert comp.endswith(bgzf.BGZF_EOF)
+
+    def test_per_block_decompress_matches(self):
+        data = bytes(range(256)) * 1000
+        comp = bgzf.compress(data)
+        joined = b"".join(
+            bgzf.decompress_block(comp, off, size)
+            for off, size in bgzf.iter_block_offsets(comp)
+        )
+        assert joined == data
+
+    def test_is_bgzf(self):
+        assert bgzf.is_bgzf(bgzf.compress(b"x"))
+        assert not bgzf.is_bgzf(b"plainly not gzip")
+        import gzip
+
+        assert not bgzf.is_bgzf(gzip.compress(b"x"))  # gzip but not BGZF
+
+    def test_empty(self):
+        assert bgzf.decompress(bgzf.compress(b"")) == b""
+
+
+class TestBamRoundtrip:
+    def test_simulated_roundtrip(self, tmp_path):
+        path = str(tmp_path / "sim.bam")
+        header, recs, batch, _ = simulated_bam(
+            SimConfig(n_molecules=20, duplex=True, seed=3), path=path
+        )
+        header2, recs2 = read_bam(path)
+        assert header2.ref_names == header.ref_names
+        assert header2.ref_lengths == header.ref_lengths
+        assert recs2.names == recs.names
+        np.testing.assert_array_equal(recs2.flags, recs.flags)
+        np.testing.assert_array_equal(recs2.pos, recs.pos)
+        np.testing.assert_array_equal(recs2.seq, recs.seq)
+        np.testing.assert_array_equal(recs2.qual, recs.qual)
+        assert recs2.umi == recs.umi
+        assert recs2.cigars == recs.cigars
+        assert recs2.aux_raw == recs.aux_raw
+
+    def test_batch_conversion_roundtrip(self, tmp_path):
+        """BAM → ReadBatch must invert ReadBatch → BAM exactly."""
+        cfg = SimConfig(n_molecules=30, duplex=True, umi_error=0.02, seed=11)
+        batch, _ = simulate_batch(cfg)
+        recs = readbatch_to_records(batch, duplex=True)
+        batch2, info = records_to_readbatch(recs, duplex=True)
+        assert info["n_valid"] == int(np.asarray(batch.valid).sum())
+        np.testing.assert_array_equal(batch2.bases, np.asarray(batch.bases))
+        np.testing.assert_array_equal(batch2.quals, np.asarray(batch.quals))
+        np.testing.assert_array_equal(batch2.umi, np.asarray(batch.umi))
+        np.testing.assert_array_equal(batch2.strand_ab, np.asarray(batch.strand_ab))
+        # pos_key is re-packed (ref<<36|pos); ordering/grouping structure
+        # must be preserved even though raw values differ
+        _, inv1 = np.unique(np.asarray(batch.pos_key), return_inverse=True)
+        _, inv2 = np.unique(batch2.pos_key, return_inverse=True)
+        np.testing.assert_array_equal(inv1, inv2)
+
+    def test_uncompressed_parse(self):
+        header, recs, *_ = simulated_bam(SimConfig(n_molecules=5, seed=1))
+        raw = serialize_bam(header, recs)
+        header2, recs2 = parse_bam(raw)  # raw (non-BGZF) BAM also parses
+        assert recs2.names == recs.names
+
+    def test_dropped_reads(self, tmp_path):
+        header, recs, *_ = simulated_bam(SimConfig(n_molecules=5, seed=2))
+        recs.umi[0] = ""  # no RX
+        recs.aux_raw[0] = b""
+        recs.umi[1] = "NNN-ACG"  # N in UMI
+        batch, info = records_to_readbatch(recs, duplex=True)
+        assert info["n_dropped_no_umi"] == 2  # N-containing → unparseable too
+        assert not batch.valid[0] and not batch.valid[1]
+        assert batch.valid[2:].all()
+
+
+class TestStrandAndKeys:
+    @pytest.mark.parametrize(
+        "flag,expect_top",
+        [
+            (0, True),  # unpaired forward
+            (FLAG_REVERSE, False),  # unpaired reverse
+            (FLAG_PAIRED | FLAG_READ1, True),  # F1
+            (FLAG_PAIRED | FLAG_READ1 | FLAG_REVERSE, False),  # R1
+            (FLAG_PAIRED | FLAG_READ2 | FLAG_REVERSE, True),  # R2 → top
+            (FLAG_PAIRED | FLAG_READ2, False),  # F2 → bottom
+        ],
+    )
+    def test_strand_rule(self, flag, expect_top):
+        assert read_is_top_strand(flag) == expect_top
+
+    def test_pos_key_pack_unpack(self):
+        ref = np.array([0, 3, 120], np.int32)
+        pos = np.array([0, 1_000_000, (1 << 31) - 1], np.int64)
+        ref2, pos2 = unpack_pos_key(pack_pos_key(ref, pos))
+        np.testing.assert_array_equal(ref2, ref)
+        np.testing.assert_array_equal(pos2, pos)
+
+    def test_ba_umi_swap(self):
+        """BA reads must carry the swapped (canonical) UMI pair."""
+        cfg = SimConfig(n_molecules=8, duplex=True, seed=5)
+        batch, _ = simulate_batch(cfg)
+        recs = readbatch_to_records(batch, duplex=True)
+        strand = np.asarray(batch.strand_ab, bool)
+        ab = np.nonzero(strand)[0]
+        ba = np.nonzero(~strand)[0]
+        assert len(ab) and len(ba)
+        # In the BAM, a molecule's AB and BA reads have RX halves swapped
+        canon = {}
+        for i in ab:
+            canon[recs.umi[i]] = i
+        half = len(recs.umi[0].replace("-", "")) // 2
+        for i in ba:
+            a, b = recs.umi[i].split("-")
+            swapped = b + "-" + a
+            # swapped form should exist among AB reads of the same molecule
+            # (at least for error-free UMIs; seed=5 has umi_error=0)
+            assert swapped in canon
+
+
+class TestNpz:
+    def test_roundtrip(self, tmp_path):
+        batch, _ = simulate_batch(SimConfig(n_molecules=10, seed=9))
+        p = str(tmp_path / "b.npz")
+        save_readbatch(p, batch)
+        batch2 = load_readbatch(p)
+        for f in ("bases", "quals", "umi", "pos_key", "strand_ab", "valid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(batch, f)), getattr(batch2, f)
+            )

@@ -26,15 +26,12 @@ from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch, pad_
 from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 
 
-def _dense_pos(batch):
-    """Host int64 pos_key -> dense i32 ids (order-preserving)."""
-    _, inv = np.unique(np.asarray(batch.pos_key), return_inverse=True)
-    return inv.astype(np.int32)
+from duplexumiconsensusreads_tpu.ops.grouper import dense_pos_ids
 
 
 def _run_group_kernel(batch, params, u_max=None):
     fam, mol, n_fam, n_mol, n_over = group_kernel(
-        _dense_pos(batch),
+        dense_pos_ids(batch.pos_key),
         np.asarray(batch.umi),
         np.asarray(batch.strand_ab),
         np.asarray(batch.valid),
