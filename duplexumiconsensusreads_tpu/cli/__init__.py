@@ -1,0 +1,3 @@
+from duplexumiconsensusreads_tpu.cli.main import CONFIG_PRESETS, build_parser, main
+
+__all__ = ["main", "build_parser", "CONFIG_PRESETS"]

@@ -1,0 +1,264 @@
+"""Command-line interface.
+
+`python -m duplexumiconsensusreads_tpu <subcommand>`:
+
+  call      BAM/npz in → consensus BAM out (the reference workflow with
+            --backend=tpu|cpu, per BASELINE.json's operator contract)
+  simulate  write a truth-aware synthetic BAM (+ truth npz) for testing
+  validate  measure consensus error rate of a consensus BAM vs truth
+  bench     run the reads/sec benchmark (same as bench.py)
+
+The --config presets map 1:1 onto the five driver benchmark configs
+(BASELINE.json `configs`); explicit flags override preset fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+CONFIG_PRESETS = {
+    # 1. single-strand consensus, exact grouping (small amplicon)
+    "config1": dict(grouping="exact", mode="ss", error_model="none"),
+    # 2. directional adjacency grouping, Hamming<=1 (hybrid-capture panel)
+    "config2": dict(grouping="adjacency", mode="ss", error_model="none"),
+    # 3. duplex consensus, top+bottom merge (ctDNA panel)
+    "config3": dict(grouping="adjacency", mode="duplex", error_model="none"),
+    # 4. whole-exome duplex, family-size-bucketed shards across the mesh
+    "config4": dict(grouping="adjacency", mode="duplex", error_model="none", capacity=4096),
+    # 5. per-cycle error-model / quality-recalibrated duplex
+    "config5": dict(grouping="adjacency", mode="duplex", error_model="cycle"),
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="duplexumi",
+        description="TPU-native duplex UMI consensus calling",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("call", help="group UMIs and call consensus reads")
+    c.add_argument("input", help="input BAM (or ReadBatch .npz)")
+    c.add_argument("-o", "--output", required=True, help="output consensus BAM")
+    c.add_argument("--config", choices=sorted(CONFIG_PRESETS), help="benchmark preset")
+    c.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
+    c.add_argument("--grouping", choices=["exact", "adjacency"], default=None)
+    c.add_argument("--mode", choices=["ss", "duplex"], default=None)
+    c.add_argument("--error-model", choices=["none", "cycle"], default=None)
+    c.add_argument("--max-hamming", type=int, default=1)
+    c.add_argument("--min-reads", type=int, default=1)
+    c.add_argument("--min-duplex-reads", type=int, default=1)
+    c.add_argument("--max-qual", type=int, default=90)
+    c.add_argument("--max-input-qual", type=int, default=50)
+    c.add_argument("--capacity", type=int, default=None, help="bucket read capacity")
+    c.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
+    c.add_argument("--report", help="write run counters/timings JSON here")
+    c.add_argument("--profile", help="write a jax.profiler trace to this dir")
+
+    s = sub.add_parser("simulate", help="write a truth-aware synthetic BAM")
+    s.add_argument("-o", "--output", required=True, help="output BAM path")
+    s.add_argument("--truth", help="also write ground-truth npz here")
+    s.add_argument("--molecules", type=int, default=1000)
+    s.add_argument("--read-len", type=int, default=150)
+    s.add_argument("--umi-len", type=int, default=6)
+    s.add_argument("--positions", type=int, default=32)
+    s.add_argument("--family-size", type=int, default=4)
+    s.add_argument("--max-family-size", type=int, default=16)
+    s.add_argument("--base-error", type=float, default=0.01)
+    s.add_argument("--cycle-error-slope", type=float, default=0.0)
+    s.add_argument("--umi-error", type=float, default=0.0)
+    s.add_argument("--single-strand", action="store_true", help="no duplex pairing")
+    s.add_argument("--seed", type=int, default=0)
+
+    v = sub.add_parser("validate", help="consensus error rate vs simulation truth")
+    v.add_argument("consensus", help="consensus BAM from `call`")
+    v.add_argument("--truth", required=True, help="truth npz from `simulate --truth`")
+    v.add_argument("--json", action="store_true", help="print JSON instead of text")
+
+    b = sub.add_parser("bench", help="run the reads/sec benchmark")
+    b.add_argument("--reads", type=int, default=None)
+    b.add_argument("--capacity", type=int, default=None)
+
+    return p
+
+
+def _cmd_call(args) -> int:
+    from duplexumiconsensusreads_tpu.runtime.executor import call_consensus_file
+    from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+    preset = dict(CONFIG_PRESETS.get(args.config, {}))
+    grouping = args.grouping or preset.get("grouping", "exact")
+    mode = args.mode or preset.get("mode", "ss")
+    error_model = args.error_model or preset.get("error_model", "none")
+    capacity = args.capacity or preset.get("capacity", 2048)
+
+    gp = GroupingParams(
+        strategy=grouping,
+        max_hamming=args.max_hamming,
+        paired=(mode == "duplex"),
+    )
+    cp = ConsensusParams(
+        mode="duplex" if mode == "duplex" else "single_strand",
+        min_reads=args.min_reads,
+        min_duplex_reads=args.min_duplex_reads,
+        max_qual=args.max_qual,
+        max_input_qual=args.max_input_qual,
+        error_model=None if error_model == "none" else error_model,
+    )
+    rep = call_consensus_file(
+        args.input,
+        args.output,
+        gp,
+        cp,
+        backend=args.backend,
+        capacity=capacity,
+        n_devices=args.devices,
+        report_path=args.report,
+        profile_dir=args.profile,
+    )
+    print(
+        f"[duplexumi] {rep.n_valid_reads}/{rep.n_records} reads → "
+        f"{rep.n_consensus} consensus ({rep.n_molecules} molecules, "
+        f"{rep.n_buckets} buckets, backend={rep.backend}) "
+        f"in {sum(rep.seconds.values()):.2f}s {rep.seconds}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def _cmd_simulate(args) -> int:
+    import numpy as np
+
+    from duplexumiconsensusreads_tpu.io import simulated_bam
+    from duplexumiconsensusreads_tpu.simulate import SimConfig
+
+    cfg = SimConfig(
+        n_molecules=args.molecules,
+        read_len=args.read_len,
+        umi_len=args.umi_len,
+        n_positions=args.positions,
+        mean_family_size=args.family_size,
+        max_family_size=args.max_family_size,
+        base_error=args.base_error,
+        cycle_error_slope=args.cycle_error_slope,
+        umi_error=args.umi_error,
+        duplex=not args.single_strand,
+        seed=args.seed,
+    )
+    _, recs, batch, truth = simulated_bam(cfg, path=args.output)
+    if args.truth:
+        np.savez_compressed(
+            args.truth,
+            mol_seq=truth.mol_seq,
+            mol_pos_key=truth.mol_pos_key,
+            mol_umi=truth.mol_umi,
+            read_mol=truth.read_mol,
+            read_strand=truth.read_strand,
+            duplex=np.bool_(cfg.duplex),
+        )
+    print(
+        f"[duplexumi] simulated {len(recs)} reads / {args.molecules} molecules "
+        f"→ {args.output}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def _cmd_validate(args) -> int:
+    import numpy as np
+
+    from duplexumiconsensusreads_tpu.io import read_bam
+    from duplexumiconsensusreads_tpu.io.convert import (
+        pack_pos_key,
+        umi_string_to_codes,
+        unpack_pos_key,
+    )
+
+    _, recs = read_bam(args.consensus)
+    with np.load(args.truth) as z:
+        mol_seq = z["mol_seq"]
+        mol_pos_key = z["mol_pos_key"]
+        mol_umi = z["mol_umi"]
+
+    # truth pos_key is the simulator's raw key; consensus BAM re-packs it
+    # as (ref=0) << 36 | pos, so compare on the coordinate part
+    _, truth_pos = unpack_pos_key(pack_pos_key(np.zeros(len(mol_pos_key)), mol_pos_key))
+    index = {}
+    for m in range(len(mol_seq)):
+        index[(int(truth_pos[m]), mol_umi[m].tobytes())] = m
+
+    n_match = n_err = n_base = 0
+    unmatched = 0
+    for i in range(len(recs)):
+        codes = umi_string_to_codes(recs.umi[i])
+        key = (int(recs.pos[i]), codes.tobytes() if codes is not None else b"")
+        m = index.get(key)
+        if m is None:
+            unmatched += 1
+            continue
+        n_match += 1
+        l = int(recs.lengths[i])
+        called = recs.seq[i, :l]
+        true = mol_seq[m][:l]
+        real = called != 4
+        n_err += int((called[real] != true[real]).sum())
+        n_base += int(real.sum())
+
+    rate = n_err / max(n_base, 1)
+    out = {
+        "n_consensus": len(recs),
+        "n_matched_to_truth": n_match,
+        "n_unmatched": unmatched,
+        "n_bases": n_base,
+        "n_errors": n_err,
+        "error_rate": rate,
+    }
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(
+            f"[duplexumi] {n_match}/{len(recs)} consensus matched to truth; "
+            f"error rate {rate:.3e} ({n_err}/{n_base} bases); "
+            f"{unmatched} unmatched",
+        )
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    import os
+
+    if args.reads:
+        os.environ["DUT_BENCH_READS"] = str(args.reads)
+    if args.capacity:
+        os.environ["DUT_BENCH_CAPACITY"] = str(args.capacity)
+    import importlib.util
+    import os.path
+
+    bench_path = __file__.rsplit("duplexumiconsensusreads_tpu", 1)[0] + "bench.py"
+    if not os.path.exists(bench_path):  # installed layout: no bench.py
+        print("bench.py not found next to the package", file=sys.stderr)
+        return 2
+    spec = importlib.util.spec_from_file_location("dut_bench", bench_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "call":
+        return _cmd_call(args)
+    if args.cmd == "simulate":
+        return _cmd_simulate(args)
+    if args.cmd == "validate":
+        return _cmd_validate(args)
+    if args.cmd == "bench":
+        return _cmd_bench(args)
+    raise AssertionError(args.cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,10 +34,6 @@ _NIBBLE_TO_CODE[4] = 2  # G
 _NIBBLE_TO_CODE[8] = 3  # T
 _CODE_TO_NIBBLE = np.array([1, 2, 4, 8, 15, 15], np.uint8)  # A C G T N PAD→N
 
-_CHAR_TO_CODE = np.full(256, 4, np.uint8)
-for _i, _c in enumerate("ACGT"):
-    _CHAR_TO_CODE[ord(_c)] = _i
-
 FLAG_PAIRED = 0x1
 FLAG_REVERSE = 0x10
 FLAG_MATE_REVERSE = 0x20

@@ -1,0 +1,181 @@
+"""Fast BAM → ReadBatch path using the native loader (native/).
+
+The C++ library decompresses BGZF blocks in parallel and extracts
+record fields straight into preallocated NumPy buffers; this module
+does only vectorised post-processing (UMI char→code mapping, duplex
+strand derivation + canonical pair swap, pos_key packing — the same
+contract io/convert.py documents). Falls back to None when the native
+library can't be built; callers then use the pure-Python codec.
+
+The native path intentionally skips read names / cigars / full aux
+blobs — it feeds the compute pipeline, which needs none of them. Use
+io.read_bam for full-fidelity parsing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.io.bam import (
+    FLAG_PAIRED,
+    FLAG_READ1,
+    FLAG_REVERSE,
+    BamHeader,
+)
+from duplexumiconsensusreads_tpu.io.convert import pack_pos_key
+from duplexumiconsensusreads_tpu.types import ReadBatch
+
+_CHAR_CODE = np.full(256, 255, np.uint8)
+for _i, _c in enumerate(b"ACGT"):
+    _CHAR_CODE[_c] = _i
+for _i, _c in enumerate(b"acgt"):  # Python codec upper()s, so must we
+    _CHAR_CODE[_c] = _i
+_SEP = ord("-")
+
+
+def _parse_header_region(data: bytes, header_end: int) -> BamHeader:
+    (l_text,) = struct.unpack_from("<i", data, 4)
+    text = data[8 : 8 + l_text].split(b"\x00", 1)[0].decode("utf-8")
+    off = 8 + l_text
+    (n_ref,) = struct.unpack_from("<i", data, off)
+    off += 4
+    names, lengths = [], []
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack_from("<i", data, off)
+        off += 4
+        names.append(data[off : off + l_name - 1].decode("ascii"))
+        off += l_name
+        (l_ref,) = struct.unpack_from("<i", data, off)
+        off += 4
+        lengths.append(l_ref)
+    return BamHeader(text=text, ref_names=names, ref_lengths=lengths)
+
+
+def read_bam_native(
+    path: str, duplex: bool = True, n_threads: int | None = None
+) -> tuple[BamHeader, ReadBatch, dict] | None:
+    """Parse a BAM file via the native loader. None if lib unavailable."""
+    from duplexumiconsensusreads_tpu.native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    nt = n_threads or min(os.cpu_count() or 1, 16)
+
+    with open(path, "rb") as f:
+        raw = np.frombuffer(f.read(), np.uint8)
+
+    if len(raw) >= 2 and raw[0] == 0x1F and raw[1] == 0x8B:
+        usize = lib.dut_bgzf_usize(raw, len(raw))
+        if usize < 0:
+            raise ValueError(f"{path}: malformed BGZF")
+        data = np.empty(usize, np.uint8)
+        if lib.dut_bgzf_decompress(raw, len(raw), data, usize, nt) != usize:
+            raise ValueError(f"{path}: BGZF decompression failed")
+    else:
+        data = raw.copy()
+
+    header_end = ctypes.c_long()
+    l_max = ctypes.c_int()
+    rx_max = ctypes.c_int()
+    n_rec = lib.dut_bam_scan(
+        data, len(data), ctypes.byref(header_end),
+        ctypes.byref(l_max), ctypes.byref(rx_max), None,
+    )
+    if n_rec < 0:
+        raise ValueError(f"{path}: malformed BAM")
+    header = _parse_header_region(
+        data[: header_end.value].tobytes(), header_end.value
+    )
+
+    rec_off = np.empty(n_rec, np.int64)
+    lib.dut_bam_scan(
+        data, len(data), ctypes.byref(header_end),
+        ctypes.byref(l_max), ctypes.byref(rx_max),
+        rec_off.ctypes.data_as(ctypes.c_void_p),
+    )
+
+    n, l, rx_cap = int(n_rec), max(int(l_max.value), 1), max(int(rx_max.value), 1)
+    flags = np.empty(n, np.uint16)
+    ref_id = np.empty(n, np.int32)
+    pos = np.empty(n, np.int32)
+    next_ref = np.empty(n, np.int32)
+    next_pos = np.empty(n, np.int32)
+    lseq = np.empty(n, np.int32)
+    seq = np.empty((n, l), np.uint8)
+    qual = np.empty((n, l), np.uint8)
+    rx = np.empty((n, rx_cap), np.uint8)
+    rc = lib.dut_bam_fill(
+        data, len(data), rec_off, n, l, rx_cap, nt,
+        flags, ref_id, pos, next_ref, next_pos, lseq, seq, qual, rx,
+    )
+    if rc != 0:
+        raise ValueError(f"{path}: BAM record fill failed")
+
+    # --- vectorised ReadBatch assembly (contract: io/convert.py) ---
+    # Mirror the Python codec's semantics exactly: a read is
+    # "parseable" iff it has a non-empty RX whose non-separator chars
+    # are all ACGT (case-insensitive); umi_len is the max over
+    # PARSEABLE reads only (an unparseable long RX must not inflate
+    # it); parseable reads of a different length are dropped as
+    # length-inconsistent.
+    codes_all = _CHAR_CODE[rx]
+    has_char = rx != 0
+    is_umi_char = (rx != _SEP) & has_char
+    n_umi_chars = is_umi_char.sum(axis=1)
+    has_rx = has_char.any(axis=1)
+    bad_char = ((codes_all == 255) & is_umi_char).any(axis=1)
+    parseable = has_rx & ~bad_char
+    umi_len = int(n_umi_chars[parseable].max()) if parseable.any() else 0
+    valid = parseable & (n_umi_chars == umi_len) & (umi_len > 0)
+
+    umi_codes = np.zeros((n, umi_len), np.uint8)
+    if umi_len:
+        vidx = np.nonzero(valid)[0]
+        layout = is_umi_char[vidx]
+        if len(layout) and (layout == layout[0]).all():
+            # fast path: identical RX layout on every valid read
+            cols = np.nonzero(layout[0])[0]
+            umi_codes[vidx] = codes_all[np.ix_(vidx, cols)]
+        else:
+            for i in vidx:
+                umi_codes[i] = codes_all[i][is_umi_char[i]]
+
+    f = flags.astype(np.int64)
+    paired = (f & FLAG_PAIRED) != 0
+    rev = (f & FLAG_REVERSE) != 0
+    r1 = (f & FLAG_READ1) != 0
+    top = np.where(paired, r1 != rev, ~rev)
+
+    if duplex and umi_len:
+        h = umi_len // 2
+        ba = ~top & valid
+        umi_codes[ba] = np.concatenate(
+            [umi_codes[ba][:, h:], umi_codes[ba][:, :h]], axis=1
+        )
+
+    paired_ok = paired & (next_ref == ref_id) & (next_pos >= 0)
+    coord = np.where(paired_ok, np.minimum(pos, next_pos), pos)
+    pos_key = pack_pos_key(ref_id, coord)
+
+    batch = ReadBatch(
+        bases=seq,
+        quals=qual,
+        umi=umi_codes,
+        pos_key=pos_key,
+        strand_ab=top & valid,  # invalid rows keep the codec's False default
+        valid=valid,
+    )
+    info = {
+        "n_records": n,
+        "n_valid": int(valid.sum()),
+        "n_dropped_no_umi": int((~parseable).sum()),
+        "n_dropped_umi_len": int((parseable & ~valid).sum()),
+        "umi_len": umi_len,
+        "native": True,
+    }
+    return header, batch, info

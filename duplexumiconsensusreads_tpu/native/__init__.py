@@ -1,0 +1,87 @@
+"""ctypes binding to the native BAM loader (libdutbam.so).
+
+Lazy build-on-first-use: if the shared library is missing and a C++
+toolchain exists, `make` is invoked once in this directory. Everything
+degrades gracefully — ``get_lib()`` returns None when the native path
+is unavailable and callers fall back to the pure-Python codec.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libdutbam.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_c_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_c_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_c_u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
+_c_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.dut_bgzf_usize.restype = ctypes.c_long
+    lib.dut_bgzf_usize.argtypes = [_c_u8p, ctypes.c_long]
+    lib.dut_bgzf_decompress.restype = ctypes.c_long
+    lib.dut_bgzf_decompress.argtypes = [
+        _c_u8p, ctypes.c_long, _c_u8p, ctypes.c_long, ctypes.c_int,
+    ]
+    lib.dut_bam_scan.restype = ctypes.c_long
+    lib.dut_bam_scan.argtypes = [
+        _c_u8p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_void_p,
+    ]
+    lib.dut_bam_scan_offsets = lib.dut_bam_scan  # alias; offsets via ndarray
+    lib.dut_bam_fill.restype = ctypes.c_int
+    lib.dut_bam_fill.argtypes = [
+        _c_u8p, ctypes.c_long, _c_i64p, ctypes.c_long,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        _c_u16p, _c_i32p, _c_i32p, _c_i32p, _c_i32p, _c_i32p,
+        _c_u8p, _c_u8p, _c_u8p,
+    ]
+    return lib
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The bound library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _tried:
+            return None
+        _tried = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            return None
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None

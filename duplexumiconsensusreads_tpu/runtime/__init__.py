@@ -1,0 +1,13 @@
+from duplexumiconsensusreads_tpu.runtime.executor import (
+    RunReport,
+    call_batch_cpu,
+    call_batch_tpu,
+    call_consensus_file,
+)
+
+__all__ = [
+    "RunReport",
+    "call_batch_cpu",
+    "call_batch_tpu",
+    "call_consensus_file",
+]

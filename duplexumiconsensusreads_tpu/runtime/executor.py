@@ -1,0 +1,305 @@
+"""File-level orchestration: BAM in → grouped/consensus-called → BAM out.
+
+This is the host runtime around the device pipeline: parse, bucket,
+dispatch buckets across the mesh, scatter device outputs back to
+file order, and emit consensus records. The CPU backend routes the
+same call through the NumPy oracle (the stand-in reference
+implementation), which is what `--backend=cpu` means at the CLI —
+the operator contract BASELINE.json's north_star requires.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.constants import NO_FAMILY
+from duplexumiconsensusreads_tpu.types import (
+    ConsensusParams,
+    FamilyAssignment,
+    GroupingParams,
+    ReadBatch,
+)
+from duplexumiconsensusreads_tpu.utils.phred import pack_umi
+
+
+@dataclasses.dataclass
+class RunReport:
+    """Counters + timings for one run (CLI --report writes this as JSON)."""
+
+    n_records: int = 0
+    n_valid_reads: int = 0
+    n_dropped: int = 0
+    n_buckets: int = 0
+    n_families: int = 0
+    n_molecules: int = 0
+    n_consensus: int = 0
+    n_devices: int = 1
+    backend: str = ""
+    seconds: dict = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+
+def representative_per_family(
+    fam_id: np.ndarray,  # (N,) dense ids, NO_FAMILY for unassigned
+    valid: np.ndarray,  # (N,)
+    pos_key: np.ndarray,  # (N,) i64
+    umi: np.ndarray,  # (N, U) u8
+    n_fam: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per dense family id: its pos_key and consensus-reported UMI.
+
+    pos_key is constant within a family by construction. The reported
+    UMI is the family's modal UMI (most frequent member UMI, ties to
+    the smallest packed code) — in adjacency mode this recovers the
+    directional cluster's seed in all but adversarial tie cases, since
+    the seed is defined as the highest-count UMI of the cluster.
+    """
+    fam_pos = np.zeros(n_fam, np.int64)
+    fam_umi = np.zeros((n_fam, umi.shape[1]), np.uint8)
+    sel = valid & (fam_id != NO_FAMILY)
+    idx = np.nonzero(sel)[0]
+    if not len(idx):
+        return fam_pos, fam_umi
+    f = fam_id[idx]
+    packed = pack_umi(umi[idx])
+    # count (family, umi) pairs
+    key = np.stack([f.astype(np.int64), packed], axis=1)
+    uniq, inv, cnt = np.unique(key, axis=0, return_inverse=True, return_counts=True)
+    # first read index carrying each unique (family, umi) pair
+    first_read = np.full(len(uniq), -1, np.int64)
+    # reversed iteration-free: scatter min read position per pair
+    order_reads = np.argsort(inv, kind="stable")
+    pair_sorted = inv[order_reads]
+    pair_first = np.nonzero(np.r_[True, pair_sorted[1:] != pair_sorted[:-1]])[0]
+    first_read[pair_sorted[pair_first]] = order_reads[pair_first]
+    # order unique pairs by (family, -count, packed); first per family wins
+    order = np.lexsort((uniq[:, 1], -cnt, uniq[:, 0]))
+    fam_sorted = uniq[order, 0]
+    first = np.nonzero(np.r_[True, fam_sorted[1:] != fam_sorted[:-1]])[0]
+    win_rows = order[first]  # one row index into uniq per family present
+    fams_present = uniq[win_rows, 0].astype(np.int64)
+    rep_reads = idx[first_read[win_rows]]
+    fam_pos[fams_present] = pos_key[rep_reads]
+    fam_umi[fams_present] = umi[rep_reads]
+    # families absent from the id array keep zeros; caller masks by cons_valid
+    return fam_pos, fam_umi
+
+
+def _specs_from_params(grouping: GroupingParams, consensus: ConsensusParams):
+    from duplexumiconsensusreads_tpu.ops.pipeline import PipelineSpec
+
+    return PipelineSpec(grouping=grouping, consensus=consensus)
+
+
+def call_batch_tpu(
+    batch: ReadBatch,
+    grouping: GroupingParams,
+    consensus: ConsensusParams,
+    capacity: int = 2048,
+    n_devices: int | None = None,
+    report: RunReport | None = None,
+):
+    """Run one host ReadBatch through the bucketed mesh pipeline.
+
+    Returns (cons_base, cons_qual, cons_depth, cons_valid, fam_pos,
+    fam_umi) concatenated over buckets in global dense-output order.
+    """
+    import jax
+
+    from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
+    from duplexumiconsensusreads_tpu.parallel import make_mesh
+    from duplexumiconsensusreads_tpu.parallel.sharded import sharded_pipeline
+
+    rep = report or RunReport()
+    spec = _specs_from_params(grouping, consensus)
+    duplex = consensus.mode == "duplex"
+
+    t0 = time.time()
+    buckets = build_buckets(batch, capacity=capacity, adjacency=grouping.strategy == "adjacency")
+    rep.n_buckets = len(buckets)
+    rep.seconds["bucketing"] = round(time.time() - t0, 4)
+    if not buckets:
+        u = batch.umi_len
+        z = np.zeros
+        return (
+            z((0, batch.read_len), np.uint8),
+            z((0, batch.read_len), np.uint8),
+            z((0, batch.read_len), np.int32),
+            z((0,), bool),
+            z((0,), np.int64),
+            z((0, u), np.uint8),
+        )
+
+    n_dev = n_devices or len(jax.devices())
+    mesh = make_mesh(n_dev)
+    rep.n_devices = n_dev
+    stacked = stack_buckets(buckets, multiple_of=n_dev)
+
+    t0 = time.time()
+    out = sharded_pipeline(stacked, spec, mesh)
+    out = {k: np.asarray(v) for k, v in out.items()}
+    rep.seconds["device_pipeline"] = round(time.time() - t0, 4)
+
+    t0 = time.time()
+    all_b, all_q, all_d, all_v, all_pos, all_umi = [], [], [], [], [], []
+    src_pos = np.asarray(batch.pos_key)
+    src_umi = np.asarray(batch.umi)
+    for bi, bk in enumerate(buckets):
+        ids = out["molecule_id"][bi] if duplex else out["family_id"][bi]
+        n_out = int(out["n_molecules"][bi] if duplex else out["n_families"][bi])
+        cv = out["cons_valid"][bi]
+        # representative lookup is in source-batch coordinates
+        ridx = bk.read_index
+        in_src = ridx >= 0
+        fam_pos, fam_umi = representative_per_family(
+            np.where(in_src, ids, NO_FAMILY),
+            bk.valid & in_src,
+            np.where(in_src, src_pos[np.maximum(ridx, 0)], 0),
+            src_umi[np.maximum(ridx, 0)],
+            n_fam=len(cv),
+        )
+        keep = np.zeros(len(cv), bool)
+        keep[:n_out] = True
+        keep &= cv.astype(bool)
+        all_b.append(out["cons_base"][bi][keep])
+        all_q.append(out["cons_qual"][bi][keep])
+        all_d.append(out["cons_depth"][bi][keep])
+        all_v.append(np.ones(int(keep.sum()), bool))
+        all_pos.append(fam_pos[keep])
+        all_umi.append(fam_umi[keep])
+        rep.n_families += int(out["n_families"][bi])
+        rep.n_molecules += int(out["n_molecules"][bi])
+    rep.seconds["scatter_back"] = round(time.time() - t0, 4)
+
+    return (
+        np.concatenate(all_b),
+        np.concatenate(all_q),
+        np.concatenate(all_d),
+        np.concatenate(all_v),
+        np.concatenate(all_pos),
+        np.concatenate(all_umi),
+    )
+
+
+def call_batch_cpu(
+    batch: ReadBatch,
+    grouping: GroupingParams,
+    consensus: ConsensusParams,
+    report: RunReport | None = None,
+):
+    """Oracle (reference-math) path over the whole batch."""
+    from duplexumiconsensusreads_tpu.ops import ConsensusCaller, UmiGrouper
+
+    rep = report or RunReport()
+    t0 = time.time()
+    fams: FamilyAssignment = UmiGrouper(grouping, backend="cpu")(batch)
+    cons = ConsensusCaller(consensus, backend="cpu")(batch, fams)
+    rep.seconds["cpu_pipeline"] = round(time.time() - t0, 4)
+    rep.n_families = int(fams.n_families)
+    rep.n_molecules = int(fams.n_molecules)
+
+    duplex = consensus.mode == "duplex"
+    ids = np.asarray(fams.molecule_id if duplex else fams.family_id)
+    n_out = int(fams.n_molecules if duplex else fams.n_families)
+    fam_pos, fam_umi = representative_per_family(
+        ids,
+        np.asarray(batch.valid, bool),
+        np.asarray(batch.pos_key),
+        np.asarray(batch.umi),
+        n_fam=n_out,
+    )
+    cv = np.asarray(cons.valid, bool)
+    return (
+        np.asarray(cons.bases)[cv],
+        np.asarray(cons.quals)[cv],
+        np.asarray(cons.depth)[cv],
+        np.ones(int(cv.sum()), bool),
+        fam_pos[cv],
+        fam_umi[cv],
+    )
+
+
+def call_consensus_file(
+    in_path: str,
+    out_path: str,
+    grouping: GroupingParams,
+    consensus: ConsensusParams,
+    backend: str = "tpu",
+    capacity: int = 2048,
+    n_devices: int | None = None,
+    report_path: str | None = None,
+    profile_dir: str | None = None,
+) -> RunReport:
+    """End-to-end: read BAM/npz → consensus → write consensus BAM."""
+    from duplexumiconsensusreads_tpu.io import (
+        BamHeader,
+        consensus_to_records,
+        load_readbatch,
+        read_bam,
+        records_to_readbatch,
+        write_bam,
+    )
+
+    rep = RunReport(backend=backend)
+    duplex = consensus.mode == "duplex"
+
+    t0 = time.time()
+    if in_path.endswith(".npz"):
+        batch = load_readbatch(in_path)
+        header = BamHeader.synthetic()
+        rep.n_records = batch.n_reads
+    else:
+        import os
+
+        res = None
+        if not os.environ.get("DUT_NO_NATIVE"):
+            from duplexumiconsensusreads_tpu.io.native_reader import read_bam_native
+
+            res = read_bam_native(in_path, duplex=duplex)
+        if res is not None:
+            header, batch, info = res
+        else:
+            header, recs = read_bam(in_path)
+            batch, info = records_to_readbatch(recs, duplex=duplex)
+        rep.n_records = info["n_records"]
+        rep.n_dropped = info["n_dropped_no_umi"] + info["n_dropped_umi_len"]
+    rep.n_valid_reads = int(np.asarray(batch.valid).sum())
+    rep.seconds["read_input"] = round(time.time() - t0, 4)
+
+    prof = None
+    if profile_dir:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+        prof = profile_dir
+    try:
+        if backend == "tpu":
+            cb, cq, cd, cv, fp, fu = call_batch_tpu(
+                batch, grouping, consensus, capacity, n_devices, rep
+            )
+        elif backend == "cpu":
+            cb, cq, cd, cv, fp, fu = call_batch_cpu(batch, grouping, consensus, rep)
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+    finally:
+        if prof:
+            import jax
+
+            jax.profiler.stop_trace()
+
+    t0 = time.time()
+    out_recs = consensus_to_records(cb, cq, cd, cv, fp, fu, duplex=duplex)
+    write_bam(out_path, header, out_recs)
+    rep.n_consensus = len(out_recs)
+    rep.seconds["write_output"] = round(time.time() - t0, 4)
+
+    if report_path:
+        with open(report_path, "w") as f:
+            f.write(rep.to_json() + "\n")
+    return rep

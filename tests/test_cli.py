@@ -1,0 +1,139 @@
+"""End-to-end CLI tests: simulate → call (all five benchmark presets,
+both backends) → validate against simulation truth. These are the
+framework's acceptance tests for the driver's five configs."""
+
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.cli import main
+from duplexumiconsensusreads_tpu.io import read_bam
+
+
+def _simulate(tmp_path, **kw):
+    bam = str(tmp_path / "sim.bam")
+    truth = str(tmp_path / "truth.npz")
+    args = [
+        "simulate",
+        "-o",
+        bam,
+        "--truth",
+        truth,
+        "--molecules",
+        str(kw.get("molecules", 60)),
+        "--read-len",
+        "40",
+        "--positions",
+        "6",
+        "--umi-error",
+        str(kw.get("umi_error", 0.0)),
+        "--base-error",
+        str(kw.get("base_error", 0.01)),
+        "--cycle-error-slope",
+        str(kw.get("cycle_error_slope", 0.0)),
+        "--seed",
+        str(kw.get("seed", 0)),
+    ]
+    if kw.get("single_strand"):
+        args.append("--single-strand")
+    assert main(args) == 0
+    return bam, truth
+
+
+@pytest.mark.parametrize("config", ["config1", "config2", "config3", "config4", "config5"])
+def test_call_presets_tpu(tmp_path, config):
+    single = config in ("config1", "config2")
+    bam, truth = _simulate(
+        tmp_path,
+        single_strand=single,
+        umi_error=0.02 if config != "config1" else 0.0,
+        cycle_error_slope=0.002 if config == "config5" else 0.0,
+        seed=zlib.crc32(config.encode()) % 1000,
+    )
+    out = str(tmp_path / "cons.bam")
+    report = str(tmp_path / "report.json")
+    assert (
+        main(
+            [
+                "call",
+                bam,
+                "-o",
+                out,
+                "--config",
+                config,
+                "--backend",
+                "tpu",
+                "--capacity",
+                "512",
+                "--report",
+                report,
+            ]
+        )
+        == 0
+    )
+    with open(report) as f:
+        rep = json.load(f)
+    assert rep["n_consensus"] > 0
+    assert rep["n_valid_reads"] == rep["n_records"]
+
+    _, recs = read_bam(out)
+    assert len(recs) == rep["n_consensus"]
+    assert all(u for u in recs.umi)  # every consensus carries RX
+    assert all(b"cD" in a for a in recs.aux_raw)
+
+
+def test_cpu_tpu_backends_agree(tmp_path):
+    bam, truth = _simulate(tmp_path, umi_error=0.02, seed=5)
+    out_cpu = str(tmp_path / "cpu.bam")
+    out_tpu = str(tmp_path / "tpu.bam")
+    for backend, out in (("cpu", out_cpu), ("tpu", out_tpu)):
+        assert (
+            main(
+                ["call", bam, "-o", out, "--config", "config3",
+                 "--backend", backend, "--capacity", "512"]
+            )
+            == 0
+        )
+    _, r_cpu = read_bam(out_cpu)
+    _, r_tpu = read_bam(out_tpu)
+    assert len(r_cpu) == len(r_tpu)
+    # same molecules called at the same positions with identical bases;
+    # quality tolerance ±2 (f32 vs f64 floor boundaries, see
+    # tests/test_kernels_parity.py docstring)
+    key_cpu = {(int(r_cpu.pos[i]), r_cpu.umi[i]): i for i in range(len(r_cpu))}
+    for j in range(len(r_tpu)):
+        i = key_cpu[(int(r_tpu.pos[j]), r_tpu.umi[j])]
+        np.testing.assert_array_equal(r_cpu.seq[i], r_tpu.seq[j])
+        assert np.abs(r_cpu.qual[i].astype(int) - r_tpu.qual[j].astype(int)).max() <= 2
+
+
+def test_validate_error_rate(tmp_path, capsys):
+    bam, truth = _simulate(tmp_path, molecules=80, base_error=0.02, seed=9)
+    out = str(tmp_path / "cons.bam")
+    assert main(["call", bam, "-o", out, "--config", "config3", "--capacity", "512"]) == 0
+    assert main(["validate", out, "--truth", truth, "--json"]) == 0
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["n_matched_to_truth"] > 0.9 * res["n_consensus"]
+    # duplex consensus must crush the raw 2% error rate
+    assert res["error_rate"] < 0.002
+    assert res["n_bases"] > 0
+
+
+def test_npz_input(tmp_path):
+    from duplexumiconsensusreads_tpu.io import save_readbatch
+    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+
+    batch, _ = simulate_batch(SimConfig(n_molecules=30, duplex=True, seed=2))
+    p = str(tmp_path / "b.npz")
+    save_readbatch(p, batch)
+    out = str(tmp_path / "cons.bam")
+    assert main(["call", p, "-o", out, "--config", "config3", "--capacity", "256"]) == 0
+    _, recs = read_bam(out)
+    assert len(recs) > 0
+
+
+def test_unknown_backend_rejected(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["call", "x.bam", "-o", "y.bam", "--backend", "gpu"])
