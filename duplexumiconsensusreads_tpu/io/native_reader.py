@@ -82,21 +82,20 @@ def read_bam_native(
     header_end = ctypes.c_long()
     l_max = ctypes.c_int()
     rx_max = ctypes.c_int()
+    # One scan pass: offsets buffer sized at the minimum-record-size
+    # upper bound (block_size field 4B + fixed fields 32B + 1 name byte)
+    # so counting and offset collection don't walk the file twice.
+    rec_off = np.empty(max(len(data) // 37, 1), np.int64)
     n_rec = lib.dut_bam_scan(
-        data, len(data), ctypes.byref(header_end),
-        ctypes.byref(l_max), ctypes.byref(rx_max), None,
-    )
-    if n_rec < 0:
-        raise ValueError(f"{path}: malformed BAM")
-    header = _parse_header_region(
-        data[: header_end.value].tobytes(), header_end.value
-    )
-
-    rec_off = np.empty(n_rec, np.int64)
-    lib.dut_bam_scan(
         data, len(data), ctypes.byref(header_end),
         ctypes.byref(l_max), ctypes.byref(rx_max),
         rec_off.ctypes.data_as(ctypes.c_void_p),
+    )
+    if n_rec < 0:
+        raise ValueError(f"{path}: malformed BAM")
+    rec_off = rec_off[:n_rec]
+    header = _parse_header_region(
+        data[: header_end.value].tobytes(), header_end.value
     )
 
     n, l, rx_cap = int(n_rec), max(int(l_max.value), 1), max(int(rx_max.value), 1)

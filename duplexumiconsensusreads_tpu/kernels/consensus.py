@@ -86,7 +86,7 @@ def ssc_kernel(
 
     contrib, real = _contributions(bases, quals, ok, max_input_qual)
 
-    if method == "matmul":
+    if method in ("matmul", "pallas", "pallas_interpret"):
         # (R, 4L | L | 1): loglik contributions, depth indicators, read count
         big = jnp.concatenate(
             [
@@ -96,10 +96,19 @@ def ssc_kernel(
             ],
             axis=1,
         )
-        onehot_f = (fid[:, None] == jnp.arange(f_max + 1, dtype=jnp.int32)).astype(
-            jnp.float32
-        )
-        out = jnp.dot(onehot_f.T, big, preferred_element_type=jnp.float32)[:f_max]
+        if method == "matmul":
+            onehot_f = (
+                fid[:, None] == jnp.arange(f_max + 1, dtype=jnp.int32)
+            ).astype(jnp.float32)
+            out = jnp.dot(onehot_f.T, big, preferred_element_type=jnp.float32)[
+                :f_max
+            ]
+        else:
+            from duplexumiconsensusreads_tpu.kernels.pallas_ssc import segment_gemm
+
+            out = segment_gemm(
+                big, fid, f_max=f_max, interpret=(method == "pallas_interpret")
+            )
         loglik = out[:, : 4 * l].reshape(f_max, l, 4)
         depth = out[:, 4 * l : 5 * l].astype(jnp.int32)
         fam_size = out[:, 5 * l].astype(jnp.int32)

@@ -2,27 +2,36 @@
 
 TPU-first design, all static shapes, no data-dependent control flow:
 
-1. Lexsort reads by (pos, UMI words) — XLA sort network on the VPU.
+1. Reads arrive sorted by (pos, UMI words). The host bucketing layer
+   (bucketing/buckets.py) already guarantees this order, so the
+   pipeline path sets ``presorted=True`` and the kernel runs ZERO
+   read-length sorts — XLA's O(n log^2 n) bitonic device sort was the
+   single most expensive op in the whole pipeline. The operator path
+   (ops/grouper.py) accepts arbitrary order and sorts on device first.
 2. Exact families = run boundaries in the sorted key stream (cumsum).
-3. Adjacency mode additionally:
-   a. compacts the unique (pos, UMI) table into ``u_max`` static slots
-      via a drop-mode scatter,
-   b. computes all-pairs Hamming distance as a one-hot matmul on the
-      MXU (matches = X @ X.T over (U, 4B) one-hots),
-   c. builds the directed UMI-tools edge matrix
+3. A compact unique-(pos, UMI) table of ``u_max`` static slots is built
+   with drop-mode scatters. Slots are occupied in stream order, so the
+   table itself is sorted by (pos, words) by construction.
+4. Adjacency mode additionally, on the table only (u_max << R):
+   a. all-pairs Hamming distance as a one-hot matmul on the MXU —
+      bf16 is exact here (0/1 terms, counts < 256),
+   b. the directed UMI-tools edge matrix
       edge[u,v] = ham<=h AND same pos AND cnt[u] >= r*cnt[v]-1,
-   d. runs transitive closure by repeated boolean matrix squaring
-      (ceil(log2 U) MXU matmuls — closure distance doubles per step),
-   e. assigns each UMI to the minimum-rank node that reaches it
+   c. transitive closure by repeated boolean matrix squaring (bf16:
+      a sum of positives can never round to 0, so >0 is exact),
+   d. each UMI joins the minimum-rank node that reaches it
       (rank = descending count, ties by packed UMI).
       This is provably identical to the oracle's sequential
       BFS-with-removal: the minimal-rank node reaching v cannot itself
       be reached by any lower-rank node (else that node would reach v,
       contradicting minimality), hence it is a BFS seed, and no earlier
       seed reaches v — so v lands in exactly that seed's cluster.
-4. Dense molecule ids = run boundaries of a second lexsort over
-   (pos, cluster UMI); paired mode splits families by strand (AB first),
-   matching the oracle's np.unique row ordering bit-for-bit.
+5. Dense ids come from the TABLE, never from re-sorting reads:
+   molecule id = rank of the slot's cluster key (pos, seed words)
+   (exact mode: the already-sorted slot index; adjacency: one
+   u_max-sized lexsort); paired family id = prefix-sum rank over the
+   (molecule, strand) presence array, AB before BA — bit-for-bit the
+   oracle's sorted np.unique ordering.
 
 Reference parity note: the reference mount was empty (SURVEY.md §0);
 the semantic contract is the oracle in oracle/grouping.py.
@@ -60,7 +69,14 @@ def _directional_cluster(
 ) -> jnp.ndarray:
     """Seed index per unique-UMI slot (directional clustering)."""
     u, b = u_codes.shape
-    onehot = (u_codes[:, :, None] == jnp.arange(4, dtype=jnp.int32)).astype(jnp.float32)
+    # bf16 single-pass MXU is exact here: one-hot entries are 0/1 and
+    # match counts are integers <= b < 256 (bf16 represents ints < 257
+    # exactly, and partial sums of 0/1 terms stay integral)
+    if 4 * b >= 256:
+        raise ValueError(f"UMI length {b} too large for bf16 Hamming matmul")
+    onehot = (u_codes[:, :, None] == jnp.arange(4, dtype=jnp.int32)).astype(
+        jnp.bfloat16
+    )
     matches = jnp.dot(
         onehot.reshape(u, 4 * b),
         onehot.reshape(u, 4 * b).T,
@@ -81,12 +97,15 @@ def _directional_cluster(
     order = jnp.lexsort((*[u_words[:, i] for i in range(u_words.shape[1] - 1, -1, -1)], cnt_key))
     rank = jnp.zeros(u, jnp.int32).at[order].set(jnp.arange(u, dtype=jnp.int32))
 
-    # transitive closure by repeated squaring on the MXU
-    reach = (edge | jnp.eye(u, dtype=bool)).astype(jnp.float32)
+    # transitive closure by repeated squaring on the MXU. bf16 is exact
+    # for the reachability test: entries are 0/1, every partial dot
+    # product is a sum of non-negative terms, and a sum of positives
+    # can never round to zero — so (result > 0) is precision-independent.
+    reach = (edge | jnp.eye(u, dtype=bool)).astype(jnp.bfloat16)
     n_iters = max(1, (u - 1).bit_length())
     for _ in range(n_iters):
         reach = (jnp.dot(reach, reach, preferred_element_type=jnp.float32) > 0).astype(
-            jnp.float32
+            jnp.bfloat16
         )
     reach_b = reach > 0  # reach_b[u, v]: u reaches v
 
@@ -96,7 +115,9 @@ def _directional_cluster(
 
 @partial(
     jax.jit,
-    static_argnames=("strategy", "max_hamming", "count_ratio", "paired", "u_max"),
+    static_argnames=(
+        "strategy", "max_hamming", "count_ratio", "paired", "u_max", "presorted",
+    ),
 )
 def group_kernel(
     pos: jnp.ndarray,  # (R,) i32 bucket-local dense position key
@@ -109,15 +130,26 @@ def group_kernel(
     count_ratio: int = 2,
     paired: bool = False,
     u_max: int | None = None,
+    presorted: bool = False,
 ):
     """Returns (family_id, molecule_id, n_families, n_molecules, n_overflow).
 
     family_id / molecule_id are (R,) i32 in original read order with
     NO_FAMILY on invalid or overflowed reads; ids are dense and ordered
     exactly like the oracle's (sorted (pos, cluster_umi[, strand])).
-    n_overflow counts reads dropped because the unique-UMI table
-    exceeded u_max slots (adjacency mode only; size buckets so it's 0).
+    n_overflow counts reads dropped because the unique-(pos, UMI) table
+    exceeded u_max slots — BOTH strategies route ids through the table,
+    so size u_max >= the unique-key count (u_max=None defaults to R,
+    which can never overflow; spec_for_buckets sizes it from the data).
+
+    presorted=True asserts the caller's contract that valid reads are
+    already in ascending (pos, UMI-words) order AND invalid reads sit
+    only at the tail (an interleaved invalid row would split a run).
+    The bucketing layer guarantees exactly this, letting the kernel
+    skip every read-length device sort.
     """
+    if strategy not in ("exact", "adjacency"):
+        raise ValueError(f"unknown grouping strategy {strategy!r}")
     r = pos.shape[0]
     if u_max is None:
         u_max = r
@@ -127,90 +159,99 @@ def group_kernel(
     pos_m = jnp.where(valid, pos.astype(jnp.int32), I32_MAX)
     words_m = jnp.where(valid[:, None], words, I32_MAX)
 
-    order = jnp.lexsort((*[words_m[:, i] for i in range(w - 1, -1, -1)], pos_m))
-    spos = pos_m[order]
-    swords = words_m[order]
-    svalid = valid[order]
-    uid = _run_ids([spos] + [swords[:, i] for i in range(w)])  # exact-group id, sorted order
+    if presorted:
+        order = jnp.arange(r, dtype=jnp.int32)
+        spos, swords, svalid = pos_m, words_m, valid
+    else:
+        order = jnp.lexsort((*[words_m[:, i] for i in range(w - 1, -1, -1)], pos_m))
+        spos = pos_m[order]
+        swords = words_m[order]
+        svalid = valid[order]
+
+    # Exact-group id along the sorted stream; invalid reads (keys MAX)
+    # land in trailing runs that never enter the table.
+    uid_raw = _run_ids([spos] + [swords[:, i] for i in range(w)])
+    uid = jnp.where(svalid, uid_raw, u_max)  # invalid -> dropped slot
+
+    # ---- unique-(pos, UMI) table; slots occupied in stream order, so
+    # the table is sorted by (pos, words) by construction ----
+    first = (
+        jnp.concatenate([jnp.ones((1,), bool), uid_raw[1:] != uid_raw[:-1]]) & svalid
+    )
+    tslot = jnp.where(first, jnp.minimum(uid, u_max), u_max)
+    u_words = jnp.full((u_max, w), I32_MAX, jnp.int32).at[tslot].set(
+        swords, mode="drop"
+    )
+    u_pos = jnp.full((u_max,), I32_MAX, jnp.int32).at[tslot].set(spos, mode="drop")
+    u_valid = u_pos != I32_MAX
+    in_table = uid < u_max
+    ok_sorted = svalid & in_table
 
     if strategy == "exact":
-        cluster_words_sorted = swords
-        overflow_sorted = jnp.zeros(r, bool)
-    elif strategy == "adjacency":
-        first = jnp.concatenate([jnp.ones((1,), bool), uid[1:] != uid[:-1]]) & svalid
-        slot = uid  # unique index; valid iff < u_max
-        scodes = umi_codes.astype(jnp.int32)[order]
-        # first occurrences define the table; non-firsts scatter to the
-        # dropped out-of-range slot u_max
-        u_words = jnp.full((u_max, w), I32_MAX, jnp.int32).at[
-            jnp.where(first, slot, u_max)
-        ].set(swords, mode="drop")
-        u_codes = jnp.zeros((u_max, scodes.shape[1]), jnp.int32).at[
-            jnp.where(first, slot, u_max)
-        ].set(scodes, mode="drop")
-        u_pos = jnp.full((u_max,), I32_MAX, jnp.int32).at[
-            jnp.where(first, slot, u_max)
-        ].set(spos, mode="drop")
+        # table already sorted & slots dense: molecule id == slot index
+        mid_of_slot = jnp.arange(u_max, dtype=jnp.int32)
+        n_mol = jnp.sum(u_valid).astype(jnp.int32)
+    else:
+        scodes = umi_codes.astype(jnp.int32)[order] if not presorted else umi_codes.astype(jnp.int32)
+        u_codes = jnp.zeros((u_max, scodes.shape[1]), jnp.int32).at[tslot].set(
+            scodes, mode="drop"
+        )
         u_cnt = (
             jnp.zeros((u_max + 1,), jnp.int32)
-            .at[jnp.minimum(slot, u_max)]
+            .at[jnp.minimum(uid, u_max)]
             .add(svalid.astype(jnp.int32), mode="drop")[:u_max]
         )
-        u_valid = u_cnt > 0
         seed = _directional_cluster(
             u_words, u_codes, u_pos, u_cnt, u_valid, max_hamming, count_ratio
         )
-        cluster_words_unique = jnp.take(u_words, seed, axis=0)  # (u_max, W)
-        in_table = slot < u_max
-        cluster_words_sorted = jnp.where(
-            (in_table & svalid)[:, None],
-            jnp.take(cluster_words_unique, jnp.minimum(slot, u_max - 1), axis=0),
-            I32_MAX,
+        # cluster key per slot = (pos, seed's words); rank distinct keys
+        # with ONE u_max-sized lexsort (never an R-sized sort)
+        seed_words = jnp.take(u_words, seed, axis=0)
+        key_w = jnp.where(u_valid[:, None], seed_words, I32_MAX)
+        key_p = jnp.where(u_valid, u_pos, I32_MAX)
+        t_order = jnp.lexsort(
+            (*[key_w[:, i] for i in range(w - 1, -1, -1)], key_p)
         )
-        overflow_sorted = svalid & ~in_table
-    else:
-        raise ValueError(f"unknown grouping strategy {strategy!r}")
+        mid_t = _run_ids([key_p[t_order]] + [key_w[t_order][:, i] for i in range(w)])
+        tv = u_valid[t_order]
+        n_mol = jnp.where(tv.any(), mid_t[jnp.sum(tv) - 1] + 1, 0).astype(jnp.int32)
+        mid_of_slot = (
+            jnp.full((u_max,), I32_MAX, jnp.int32)
+            .at[t_order]
+            .set(jnp.where(tv, mid_t, I32_MAX))
+        )
 
-    ok_sorted = svalid & ~overflow_sorted
-    # scatter back to original order
-    inv = jnp.zeros(r, jnp.int32).at[order].set(jnp.arange(r, dtype=jnp.int32))
-    cluster_words = jnp.take(cluster_words_sorted, inv, axis=0)
-    ok = jnp.take(ok_sorted, inv)
-
-    # dense molecule ids over sorted (pos, cluster_words)
-    pos_m2 = jnp.where(ok, pos.astype(jnp.int32), I32_MAX)
-    cw_m = jnp.where(ok[:, None], cluster_words, I32_MAX)
-    order2 = jnp.lexsort((*[cw_m[:, i] for i in range(w - 1, -1, -1)], pos_m2))
-    mid_sorted = _run_ids([pos_m2[order2]] + [cw_m[order2][:, i] for i in range(w)])
-    ok2 = ok[order2]
-    n_mol = jnp.where(ok2.any(), mid_sorted[jnp.sum(ok2) - 1] + 1, 0).astype(jnp.int32)
-    molecule_id = (
-        jnp.full(r, NO_FAMILY, jnp.int32)
-        .at[order2]
-        .set(jnp.where(ok2, mid_sorted, NO_FAMILY))
-    )
+    slot_c = jnp.minimum(uid, u_max - 1)
+    mid_sorted = jnp.where(ok_sorted, jnp.take(mid_of_slot, slot_c), NO_FAMILY)
 
     if paired:
-        strand_ba = (~strand_ab).astype(jnp.int32)
-        sb_m = jnp.where(ok, strand_ba, I32_MAX)
-        order3 = jnp.lexsort(
-            (sb_m, *[cw_m[:, i] for i in range(w - 1, -1, -1)], pos_m2)
+        sba = jnp.where(
+            (~strand_ab if presorted else ~strand_ab[order]), 1, 0
+        ).astype(jnp.int32)
+        # family key = (molecule, strand_ba); femb is monotone in that
+        # key, so a presence cumsum yields dense ids in oracle order
+        # (AB before BA) with zero sorts
+        femb = jnp.where(
+            ok_sorted, jnp.take(mid_of_slot, slot_c) * 2 + sba, 2 * u_max
         )
-        fid_sorted = _run_ids(
-            [pos_m2[order3]]
-            + [cw_m[order3][:, i] for i in range(w)]
-            + [sb_m[order3]]
+        pres = jnp.zeros((2 * u_max,), jnp.int32).at[femb].set(1, mode="drop")
+        fam_rank = jnp.cumsum(pres) - 1  # dense rank at each present key
+        fid_sorted = jnp.where(
+            ok_sorted, jnp.take(fam_rank, jnp.minimum(femb, 2 * u_max - 1)), NO_FAMILY
         )
-        ok3 = ok[order3]
-        n_fam = jnp.where(ok3.any(), fid_sorted[jnp.sum(ok3) - 1] + 1, 0).astype(jnp.int32)
-        family_id = (
-            jnp.full(r, NO_FAMILY, jnp.int32)
-            .at[order3]
-            .set(jnp.where(ok3, fid_sorted, NO_FAMILY))
-        )
+        n_fam = jnp.sum(pres).astype(jnp.int32)
     else:
-        family_id = molecule_id
+        fid_sorted = mid_sorted
         n_fam = n_mol
+
+    if presorted:
+        family_id, molecule_id = fid_sorted, mid_sorted
+        ok = ok_sorted
+    else:
+        inv = jnp.zeros(r, jnp.int32).at[order].set(jnp.arange(r, dtype=jnp.int32))
+        family_id = jnp.take(fid_sorted, inv)
+        molecule_id = jnp.take(mid_sorted, inv)
+        ok = jnp.take(ok_sorted, inv)
 
     n_overflow = jnp.sum(valid & ~ok).astype(jnp.int32)
     return family_id, molecule_id, n_fam, n_mol, n_overflow

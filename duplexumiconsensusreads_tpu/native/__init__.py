@@ -54,7 +54,6 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.c_void_p,
     ]
-    lib.dut_bam_scan_offsets = lib.dut_bam_scan  # alias; offsets via ndarray
     lib.dut_bam_fill.restype = ctypes.c_int
     lib.dut_bam_fill.argtypes = [
         _c_u8p, ctypes.c_long, _c_i64p, ctypes.c_long,

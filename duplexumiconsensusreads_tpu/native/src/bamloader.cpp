@@ -35,7 +35,7 @@ static int parse_bgzf_block(const uint8_t* data, long n, long off,
     uint16_t slen;
     std::memcpy(&slen, data + p + 2, 2);
     if (si1 == 66 && si2 == 67) {
-      if (slen != 2) return -1;
+      if (slen != 2 || p + 6 > xend) return -1;
       uint16_t bs;
       std::memcpy(&bs, data + p + 4, 2);
       bsize = (long)bs + 1;
@@ -148,15 +148,17 @@ long dut_bam_scan(const uint8_t* data, long n, long* header_end, int* l_max,
   if (n < 12 || std::memcmp(data, "BAM\x01", 4) != 0) return -1;
   int32_t l_text;
   std::memcpy(&l_text, data + 4, 4);
+  if (l_text < 0 || 8 + (long)l_text + 4 > n) return -1;
   long off = 8 + (long)l_text;
-  if (off + 4 > n) return -1;
   int32_t n_ref;
   std::memcpy(&n_ref, data + off, 4);
+  if (n_ref < 0) return -1;
   off += 4;
   for (int32_t r = 0; r < n_ref; r++) {
     if (off + 4 > n) return -1;
     int32_t l_name;
     std::memcpy(&l_name, data + off, 4);
+    if (l_name < 1 || off + 4 + (long)l_name + 4 > n) return -1;
     off += 4 + l_name + 4;
   }
   if (header_end) *header_end = off;
@@ -177,9 +179,11 @@ long dut_bam_scan(const uint8_t* data, long n, long* header_end, int* l_max,
     std::memcpy(&n_cig, r + 12, 2);
     int32_t l_seq;
     std::memcpy(&l_seq, r + 16, 4);
+    if (l_seq < 0) return -1;
     if (l_seq > lmax) lmax = l_seq;
     // aux region: after name, cigar, seq, qual
     long aux = off + 4 + 32 + l_rn + 4L * n_cig + (l_seq + 1) / 2 + l_seq;
+    if (aux > rec_end) return -1;  // fixed fields overrun the record
     while (aux + 3 <= rec_end) {
       uint8_t t1 = data[aux], t2 = data[aux + 1], typ = data[aux + 2];
       aux += 3;
@@ -191,6 +195,7 @@ long dut_bam_scan(const uint8_t* data, long n, long* header_end, int* l_max,
         case 'Z': case 'H': {
           long e = aux;
           while (e < rec_end && data[e] != 0) e++;
+          if (e >= rec_end) return -1;  // unterminated string
           if (t1 == 'R' && t2 == 'X' && typ == 'Z') {
             int len = (int)(e - aux);
             if (len > rxmax) rxmax = len;
@@ -199,16 +204,20 @@ long dut_bam_scan(const uint8_t* data, long n, long* header_end, int* l_max,
           break;
         }
         case 'B': {
+          if (aux + 5 > rec_end) return -1;
           uint8_t sub = data[aux];
           uint32_t cnt;
           std::memcpy(&cnt, data + aux + 1, 4);
           int esz = (sub == 'c' || sub == 'C') ? 1
-                    : (sub == 's' || sub == 'S') ? 2 : 4;
+                    : (sub == 's' || sub == 'S') ? 2
+                    : (sub == 'i' || sub == 'I' || sub == 'f') ? 4 : -1;
+          if (esz < 0) return -1;
           vlen = 5 + (long)cnt * esz;
           break;
         }
         default: return -1;
       }
+      if (vlen < 0 || aux + vlen > rec_end) return -1;
       aux += vlen;
     }
     count++;
@@ -276,7 +285,8 @@ int dut_bam_fill(const uint8_t* data, long n, const long* rec_off,
         } else {
           std::memcpy(qrow, qp, l_seq);
         }
-        // aux walk for RX
+        // aux walk for RX (records were bounds-validated by dut_bam_scan,
+        // but stay defensive: any overrun marks failure, never reads OOB)
         uint8_t* xrow = rx + (long)i * rx_cap;
         std::memset(xrow, 0, rx_cap);
         long aux = (qp - data) + l_seq;
@@ -291,6 +301,7 @@ int dut_bam_fill(const uint8_t* data, long n, const long* rec_off,
             case 'Z': case 'H': {
               long e = aux;
               while (e < rec_end && data[e] != 0) e++;
+              if (e >= rec_end) { failed = true; return; }
               if (t1 == 'R' && t2 == 'X' && typ == 'Z') {
                 long len = e - aux;
                 if (len > rx_cap) { failed = true; return; }
@@ -300,16 +311,20 @@ int dut_bam_fill(const uint8_t* data, long n, const long* rec_off,
               break;
             }
             case 'B': {
+              if (aux + 5 > rec_end) { failed = true; return; }
               uint8_t sub = data[aux];
               uint32_t cnt;
               std::memcpy(&cnt, data + aux + 1, 4);
               int esz = (sub == 'c' || sub == 'C') ? 1
-                        : (sub == 's' || sub == 'S') ? 2 : 4;
+                        : (sub == 's' || sub == 'S') ? 2
+                        : (sub == 'i' || sub == 'I' || sub == 'f') ? 4 : -1;
+              if (esz < 0) { failed = true; return; }
               vlen = 5 + (long)cnt * esz;
               break;
             }
             default: failed = true; return;
           }
+          if (vlen < 0 || aux + vlen > rec_end) { failed = true; return; }
           aux += vlen;
         }
       }

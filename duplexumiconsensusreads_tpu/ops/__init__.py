@@ -4,4 +4,5 @@ from duplexumiconsensusreads_tpu.ops.pipeline import (  # noqa: F401
     PipelineSpec,
     fused_pipeline,
     run_bucket,
+    spec_for_buckets,
 )

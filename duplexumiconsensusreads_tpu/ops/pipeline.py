@@ -31,14 +31,24 @@ from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 class PipelineSpec:
     """Static geometry + algorithm config of one fused pipeline compile.
 
-    Hashable → usable as a jit static argument. f_max/m_max default to
-    the read capacity R (worst case: every read its own family).
+    Hashable → usable as a jit static argument. u_max/f_max/m_max
+    default to the read capacity R (worst case: every read its own
+    family) — use spec_for_buckets() to size them from the data, which
+    is where most of the device FLOPs are saved.
     """
 
     grouping: GroupingParams = GroupingParams()
     consensus: ConsensusParams = ConsensusParams()
     u_max: int | None = None  # unique-UMI table slots (adjacency mode)
+    f_max: int | None = None  # family-axis rows for the ssc reduction
+    m_max: int | None = None  # molecule-axis rows for the duplex merge
     ssc_method: str = "matmul"
+    # True asserts reads are sorted by (pos, UMI) with padding at the
+    # tail — the bucketing layer's output contract — letting the device
+    # kernel skip its (expensive) sorts. spec_for_buckets() sets it;
+    # the conservative default matches fused_pipeline's original
+    # any-order contract.
+    presorted: bool = False
 
     def __post_init__(self):
         if self.consensus.mode == "duplex" and not self.grouping.paired:
@@ -46,6 +56,44 @@ class PipelineSpec:
                 "duplex consensus requires paired grouping "
                 "(GroupingParams(paired=True))"
             )
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def spec_for_buckets(
+    buckets,
+    grouping: GroupingParams,
+    consensus: ConsensusParams,
+    ssc_method: str = "matmul",
+) -> PipelineSpec:
+    """Size the static axes from bucket statistics.
+
+    Directional adjacency can only MERGE exact families, so the unique
+    (pos, UMI) count per bucket upper-bounds cluster count, hence:
+      u_max >= max unique          (table never overflows)
+      f_max >= 2*unique (paired: a unique pair can split into AB + BA
+               families) or unique (unpaired)
+      m_max >= unique
+    All rounded to powers of two (bounded recompiles), capped at the
+    read capacity R which is always sufficient.
+    """
+    if not buckets:
+        return PipelineSpec(grouping, consensus, ssc_method=ssc_method)
+    r = buckets[0].capacity
+    max_u = max(b.n_unique_umi for b in buckets)
+    u_max = min(_pow2(max_u), r)
+    f_bound = 2 * max_u if grouping.paired else max_u
+    return PipelineSpec(
+        grouping=grouping,
+        consensus=consensus,
+        u_max=u_max,
+        f_max=min(_pow2(f_bound), r),
+        m_max=min(_pow2(max_u), r),
+        ssc_method=ssc_method,
+        presorted=True,  # bucketing's output contract
+    )
 
 
 @partial(jax.jit, static_argnames=("spec",))
@@ -78,7 +126,11 @@ def fused_pipeline(
         count_ratio=g.count_ratio,
         paired=g.paired,
         u_max=spec.u_max,
+        presorted=spec.presorted,
     )
+
+    f_max = spec.f_max or r
+    m_max = spec.m_max or r
 
     def ssc(q):
         return ssc_kernel(
@@ -86,7 +138,7 @@ def fused_pipeline(
             q,
             fam,
             valid,
-            f_max=r,
+            f_max=f_max,
             min_reads=c.min_reads,
             max_qual=c.max_qual,
             max_input_qual=c.max_input_qual,
@@ -113,7 +165,7 @@ def fused_pipeline(
             mol,
             strand_ab,
             valid,
-            m_max=r,
+            m_max=m_max,
             min_duplex_reads=c.min_duplex_reads,
             max_qual=c.max_qual,
         )

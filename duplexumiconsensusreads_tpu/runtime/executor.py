@@ -91,10 +91,6 @@ def representative_per_family(
     return fam_pos, fam_umi
 
 
-def _specs_from_params(grouping: GroupingParams, consensus: ConsensusParams):
-    from duplexumiconsensusreads_tpu.ops.pipeline import PipelineSpec
-
-    return PipelineSpec(grouping=grouping, consensus=consensus)
 
 
 def call_batch_tpu(
@@ -116,14 +112,16 @@ def call_batch_tpu(
     from duplexumiconsensusreads_tpu.parallel import make_mesh
     from duplexumiconsensusreads_tpu.parallel.sharded import sharded_pipeline
 
+    from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
+
     rep = report or RunReport()
-    spec = _specs_from_params(grouping, consensus)
     duplex = consensus.mode == "duplex"
 
     t0 = time.time()
     buckets = build_buckets(batch, capacity=capacity, adjacency=grouping.strategy == "adjacency")
     rep.n_buckets = len(buckets)
     rep.seconds["bucketing"] = round(time.time() - t0, 4)
+    spec = spec_for_buckets(buckets, grouping, consensus)
     if not buckets:
         u = batch.umi_len
         z = np.zeros

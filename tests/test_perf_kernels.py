@@ -1,0 +1,131 @@
+"""Tests for the performance-path kernels: presorted grouping, axis
+auto-sizing, and the Pallas banded segment-GEMM (interpret mode on CPU).
+
+All of these are exact-optimization paths — outputs must be identical
+to the reference paths, not merely close.
+"""
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.bucketing import build_buckets
+from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel
+from duplexumiconsensusreads_tpu.ops import PipelineSpec, run_bucket, spec_for_buckets
+from duplexumiconsensusreads_tpu.ops.grouper import dense_pos_ids
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+def _bucket_inputs(cfg):
+    batch, _ = simulate_batch(cfg)
+    buckets = build_buckets(batch, capacity=512, adjacency=True)
+    return buckets
+
+
+@pytest.mark.parametrize("strategy", ["exact", "adjacency"])
+@pytest.mark.parametrize("paired", [True, False])
+def test_presorted_matches_sorting_path(strategy, paired):
+    cfg = SimConfig(n_molecules=80, duplex=True, umi_error=0.03, seed=31)
+    for bk in _bucket_inputs(cfg):
+        outs = []
+        for presorted in (False, True):
+            outs.append(
+                group_kernel(
+                    bk.pos,
+                    bk.umi,
+                    bk.strand_ab,
+                    bk.valid,
+                    strategy=strategy,
+                    paired=paired,
+                    u_max=256,
+                    presorted=presorted,
+                )
+            )
+        for a, b in zip(*outs):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_spec_for_buckets_bounds():
+    cfg = SimConfig(n_molecules=200, duplex=True, umi_error=0.02, seed=8)
+    buckets = _bucket_inputs(cfg)
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex")
+    spec = spec_for_buckets(buckets, gp, cp)
+    max_u = max(b.n_unique_umi for b in buckets)
+    assert spec.u_max >= max_u
+    assert spec.f_max >= min(2 * max_u, buckets[0].capacity)
+    assert spec.m_max >= min(max_u, buckets[0].capacity)
+    # auto-sized spec must produce zero overflow and same results as
+    # the worst-case spec
+    for bk in buckets:
+        out_auto = run_bucket(bk, spec)
+        out_full = run_bucket(bk, PipelineSpec(gp, cp))
+        assert int(out_auto["n_overflow"]) == 0
+        np.testing.assert_array_equal(
+            np.asarray(out_auto["family_id"]), np.asarray(out_full["family_id"])
+        )
+        na = int(out_auto["n_molecules"])
+        np.testing.assert_array_equal(
+            np.asarray(out_auto["cons_base"])[:na],
+            np.asarray(out_full["cons_base"])[:na],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_auto["cons_qual"])[:na],
+            np.asarray(out_full["cons_qual"])[:na],
+        )
+        assert not np.asarray(out_full["cons_valid"])[na:].any()
+
+
+class TestPallasSegmentGemm:
+    def _ref(self, big, fid, f):
+        ref = np.zeros((f, big.shape[1]), np.float32)
+        for i in range(len(fid)):
+            if 0 <= fid[i] < f:
+                ref[fid[i]] += big[i]
+        return ref
+
+    @pytest.mark.parametrize("sorted_ids", [True, False])
+    def test_parity_interpret(self, sorted_ids):
+        from duplexumiconsensusreads_tpu.kernels.pallas_ssc import segment_gemm
+
+        rng = np.random.default_rng(3)
+        r, c, f = 600, 140, 260
+        big = rng.standard_normal((r, c)).astype(np.float32)
+        fid = rng.integers(-1, f, size=r).astype(np.int32)
+        if sorted_ids:
+            fid = np.sort(fid)
+        out = segment_gemm(big, fid, f_max=f, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), self._ref(big, fid, f), rtol=1e-5, atol=1e-5
+        )
+
+    def test_ssc_method_pallas_interpret(self):
+        from duplexumiconsensusreads_tpu.kernels.consensus import ssc_kernel
+
+        cfg = SimConfig(n_molecules=40, duplex=False, seed=4)
+        batch, _ = simulate_batch(cfg)
+        from duplexumiconsensusreads_tpu.oracle import group_reads
+
+        fams = group_reads(batch, GroupingParams(strategy="exact"))
+        a = ssc_kernel(
+            np.asarray(batch.bases),
+            np.asarray(batch.quals),
+            np.asarray(fams.family_id),
+            np.asarray(batch.valid),
+            f_max=128,
+            method="matmul",
+        )
+        b = ssc_kernel(
+            np.asarray(batch.bases),
+            np.asarray(batch.quals),
+            np.asarray(fams.family_id),
+            np.asarray(batch.valid),
+            f_max=128,
+            method="pallas_interpret",
+        )
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(
+                np.asarray(x).astype(np.float64),
+                np.asarray(y).astype(np.float64),
+                atol=1,  # qual may differ by 1 at f32 sum-order boundaries
+            )
