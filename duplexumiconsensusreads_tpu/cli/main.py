@@ -56,6 +56,25 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     c.add_argument("--report", help="write run counters/timings JSON here")
     c.add_argument("--profile", help="write a jax.profiler trace to this dir")
+    c.add_argument(
+        "--chunk-reads",
+        type=int,
+        default=0,
+        help="stream the input in chunks of this many records (0 = whole "
+        "file in memory); requires coordinate-sorted input",
+    )
+    c.add_argument("--checkpoint", help="chunk-progress manifest path (streaming)")
+    c.add_argument(
+        "--resume",
+        action="store_true",
+        help="skip chunks already recorded in --checkpoint",
+    )
+    c.add_argument(
+        "--max-inflight",
+        type=int,
+        default=4,
+        help="chunks dispatched to the device ahead of scatter-back",
+    )
 
     s = sub.add_parser("simulate", help="write a truth-aware synthetic BAM")
     s.add_argument("-o", "--output", required=True, help="output BAM path")
@@ -70,6 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--cycle-error-slope", type=float, default=0.0)
     s.add_argument("--umi-error", type=float, default=0.0)
     s.add_argument("--single-strand", action="store_true", help="no duplex pairing")
+    s.add_argument(
+        "--sorted",
+        action="store_true",
+        help="emit records in coordinate order (streaming input contract)",
+    )
     s.add_argument("--seed", type=int, default=0)
 
     v = sub.add_parser("validate", help="consensus error rate vs simulation truth")
@@ -107,17 +131,37 @@ def _cmd_call(args) -> int:
         max_input_qual=args.max_input_qual,
         error_model=None if error_model == "none" else error_model,
     )
-    rep = call_consensus_file(
-        args.input,
-        args.output,
-        gp,
-        cp,
-        backend=args.backend,
-        capacity=capacity,
-        n_devices=args.devices,
-        report_path=args.report,
-        profile_dir=args.profile,
-    )
+    if args.chunk_reads > 0:
+        if args.backend != "tpu":
+            raise SystemExit("--chunk-reads streaming requires --backend=tpu")
+        from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+
+        rep = stream_call_consensus(
+            args.input,
+            args.output,
+            gp,
+            cp,
+            capacity=capacity,
+            chunk_reads=args.chunk_reads,
+            n_devices=args.devices,
+            max_inflight=args.max_inflight,
+            checkpoint_path=args.checkpoint,
+            resume=args.resume,
+            report_path=args.report,
+            profile_dir=args.profile,
+        )
+    else:
+        rep = call_consensus_file(
+            args.input,
+            args.output,
+            gp,
+            cp,
+            backend=args.backend,
+            capacity=capacity,
+            n_devices=args.devices,
+            report_path=args.report,
+            profile_dir=args.profile,
+        )
     print(
         f"[duplexumi] {rep.n_valid_reads}/{rep.n_records} reads → "
         f"{rep.n_consensus} consensus ({rep.n_molecules} molecules, "
@@ -147,7 +191,7 @@ def _cmd_simulate(args) -> int:
         duplex=not args.single_strand,
         seed=args.seed,
     )
-    _, recs, batch, truth = simulated_bam(cfg, path=args.output)
+    _, recs, batch, truth = simulated_bam(cfg, path=args.output, sort=args.sorted)
     if args.truth:
         np.savez_compressed(
             args.truth,

@@ -241,17 +241,27 @@ def consensus_to_records(
     )
 
 
-def simulated_bam(cfg=None, path: str | None = None):
+def simulated_bam(cfg=None, path: str | None = None, sort: bool = False):
     """Simulate a truth-aware batch and render it as a BAM (bytes or file).
 
     Convenience used by the CLI's `simulate` subcommand and tests.
-    Returns (header, records, batch, truth).
+    sort=True emits records in coordinate order (the streaming
+    executor's input contract). Returns (header, records, batch, truth).
     """
+    import dataclasses as _dc
+
     from duplexumiconsensusreads_tpu.io.bam import write_bam
     from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+    from duplexumiconsensusreads_tpu.types import ReadBatch
 
     cfg = cfg or SimConfig()
     batch, truth = simulate_batch(cfg)
+    if sort:
+        order = np.argsort(np.asarray(batch.pos_key), kind="stable")
+        batch = batch.take(order)
+        truth = _dc.replace(
+            truth, read_mol=truth.read_mol[order], read_strand=truth.read_strand[order]
+        )
     header = BamHeader.synthetic()
     recs = readbatch_to_records(batch, duplex=cfg.duplex)
     if path is not None:

@@ -171,10 +171,14 @@ long dut_bam_scan(const uint8_t* data, long n, long* header_end, int* l_max,
     std::memcpy(&bsz, data + off, 4);
     long rec_start = off;
     long rec_end = off + 4 + bsz;
-    if (bsz < 32 || rec_end > n) return -1;
+    // 32 fixed bytes + >=1 NUL-terminated read-name byte: the minimum
+    // true record is 37 bytes total, which io/native_reader.py relies
+    // on when sizing its offsets buffer at len(data)//37.
+    if (bsz < 33 || rec_end > n) return -1;
     if (rec_off) rec_off[count] = rec_start;
     const uint8_t* r = data + off + 4;
     uint8_t l_rn = r[8];
+    if (l_rn < 1) return -1;
     uint16_t n_cig;
     std::memcpy(&n_cig, r + 12, 2);
     int32_t l_seq;

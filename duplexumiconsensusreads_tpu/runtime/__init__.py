@@ -4,10 +4,16 @@ from duplexumiconsensusreads_tpu.runtime.executor import (
     call_batch_tpu,
     call_consensus_file,
 )
+from duplexumiconsensusreads_tpu.runtime.stream import (
+    iter_record_chunks,
+    stream_call_consensus,
+)
 
 __all__ = [
     "RunReport",
     "call_batch_cpu",
     "call_batch_tpu",
     "call_consensus_file",
+    "iter_record_chunks",
+    "stream_call_consensus",
 ]

@@ -38,6 +38,8 @@ class RunReport:
     n_molecules: int = 0
     n_consensus: int = 0
     n_devices: int = 1
+    n_chunks: int = 0  # streaming only
+    n_chunks_skipped: int = 0  # streaming resume: chunks served from shards
     backend: str = ""
     seconds: dict = dataclasses.field(default_factory=dict)
 
@@ -93,6 +95,54 @@ def representative_per_family(
 
 
 
+def scatter_bucket_outputs(
+    out: dict,  # stacked device outputs, ALREADY np.asarray'd, (B, ...)
+    buckets,
+    batch: ReadBatch,
+    duplex: bool,
+):
+    """Map per-bucket device outputs back to source-batch coordinates.
+
+    Returns (cons_base, cons_qual, cons_depth, fam_pos, fam_umi)
+    concatenated over buckets, containing only valid consensus rows
+    (rows past each bucket's real family/molecule count are dropped even
+    if a permissive min_reads left them flagged valid).
+    Shared by the whole-file and streaming executors so their outputs
+    cannot drift.
+    """
+    src_pos = np.asarray(batch.pos_key)
+    src_umi = np.asarray(batch.umi)
+    all_b, all_q, all_d, all_pos, all_umi = [], [], [], [], []
+    for bi, bk in enumerate(buckets):
+        ids = out["molecule_id"][bi] if duplex else out["family_id"][bi]
+        n_out = int(out["n_molecules"][bi] if duplex else out["n_families"][bi])
+        cv = out["cons_valid"][bi].astype(bool)
+        keep = np.zeros(len(cv), bool)
+        keep[:n_out] = True
+        keep &= cv
+        ridx = bk.read_index
+        in_src = ridx >= 0
+        fam_pos, fam_umi = representative_per_family(
+            np.where(in_src, ids, NO_FAMILY),
+            bk.valid & in_src,
+            np.where(in_src, src_pos[np.maximum(ridx, 0)], 0),
+            src_umi[np.maximum(ridx, 0)],
+            n_fam=len(cv),
+        )
+        all_b.append(out["cons_base"][bi][keep])
+        all_q.append(out["cons_qual"][bi][keep])
+        all_d.append(out["cons_depth"][bi][keep])
+        all_pos.append(fam_pos[keep])
+        all_umi.append(fam_umi[keep])
+    return (
+        np.concatenate(all_b),
+        np.concatenate(all_q),
+        np.concatenate(all_d),
+        np.concatenate(all_pos),
+        np.concatenate(all_umi),
+    )
+
+
 def call_batch_tpu(
     batch: ReadBatch,
     grouping: GroupingParams,
@@ -145,44 +195,12 @@ def call_batch_tpu(
     rep.seconds["device_pipeline"] = round(time.time() - t0, 4)
 
     t0 = time.time()
-    all_b, all_q, all_d, all_v, all_pos, all_umi = [], [], [], [], [], []
-    src_pos = np.asarray(batch.pos_key)
-    src_umi = np.asarray(batch.umi)
-    for bi, bk in enumerate(buckets):
-        ids = out["molecule_id"][bi] if duplex else out["family_id"][bi]
-        n_out = int(out["n_molecules"][bi] if duplex else out["n_families"][bi])
-        cv = out["cons_valid"][bi]
-        # representative lookup is in source-batch coordinates
-        ridx = bk.read_index
-        in_src = ridx >= 0
-        fam_pos, fam_umi = representative_per_family(
-            np.where(in_src, ids, NO_FAMILY),
-            bk.valid & in_src,
-            np.where(in_src, src_pos[np.maximum(ridx, 0)], 0),
-            src_umi[np.maximum(ridx, 0)],
-            n_fam=len(cv),
-        )
-        keep = np.zeros(len(cv), bool)
-        keep[:n_out] = True
-        keep &= cv.astype(bool)
-        all_b.append(out["cons_base"][bi][keep])
-        all_q.append(out["cons_qual"][bi][keep])
-        all_d.append(out["cons_depth"][bi][keep])
-        all_v.append(np.ones(int(keep.sum()), bool))
-        all_pos.append(fam_pos[keep])
-        all_umi.append(fam_umi[keep])
-        rep.n_families += int(out["n_families"][bi])
-        rep.n_molecules += int(out["n_molecules"][bi])
+    n_real = stacked["n_real_buckets"]
+    rep.n_families += int(out["n_families"][:n_real].sum())
+    rep.n_molecules += int(out["n_molecules"][:n_real].sum())
+    cb, cq, cd, fp, fu = scatter_bucket_outputs(out, buckets, batch, duplex)
     rep.seconds["scatter_back"] = round(time.time() - t0, 4)
-
-    return (
-        np.concatenate(all_b),
-        np.concatenate(all_q),
-        np.concatenate(all_d),
-        np.concatenate(all_v),
-        np.concatenate(all_pos),
-        np.concatenate(all_umi),
-    )
+    return cb, cq, cd, np.ones(len(cb), bool), fp, fu
 
 
 def call_batch_cpu(

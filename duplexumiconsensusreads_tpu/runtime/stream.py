@@ -1,0 +1,544 @@
+"""Streaming executor: consensus-call BAMs far larger than host RAM.
+
+The whole-file path (runtime/executor.py) parses everything up front;
+this module processes a coordinate-sorted BAM as a pipeline of chunks:
+
+  BGZF blocks → rolling decompress → record chunks (holding back the
+  trailing pos_key group so no family straddles a boundary) → buckets →
+  ASYNC device dispatch (several chunks in flight — on a tunneled chip
+  each dispatch costs ~100ms fixed latency, so overlap is what turns
+  per-chunk latency into pipeline throughput) → scatter-back → per-chunk
+  output shards → final single consensus BAM.
+
+Checkpoint/resume: a JSON manifest records finished chunk shards keyed
+by a parameter fingerprint; re-running with --resume skips completed
+chunks (the batch-domain analogue of training checkpoint/resume).
+
+Input contract (documented limitation, mirrors the reference domain's
+sort requirements — fgbio-style tools demand template-coordinate
+order): records must be ordered so that equal pos_keys are contiguous
+and pos_keys are non-decreasing. `duplexumi simulate --sorted` and any
+coordinate-sorted single-end BAM satisfy this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import struct
+import time
+from collections import deque
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.io import bgzf
+from duplexumiconsensusreads_tpu.io.bam import BamHeader, BamRecords, parse_bam
+from duplexumiconsensusreads_tpu.io.convert import (
+    consensus_to_records,
+    records_to_readbatch,
+)
+from duplexumiconsensusreads_tpu.runtime.executor import (
+    RunReport,
+    scatter_bucket_outputs,
+)
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+# --------------------------------------------------------------- input
+
+def _iter_bgzf_stream(f, read_size=4 << 20):
+    """Yield decompressed byte chunks from a BGZF (or raw BAM) file obj."""
+    head = f.read(18)
+    if head[:2] == b"\x1f\x8b":
+        buf = head
+        while True:
+            data = f.read(read_size)
+            if data:
+                buf += data
+            # decompress all complete blocks in buf
+            off = 0
+            out = []
+            while True:
+                try:
+                    if off + 18 > len(buf):
+                        break
+                    size = bgzf.read_block_size(buf, off)
+                except ValueError:
+                    raise
+                if off + size > len(buf):
+                    break
+                out.append(bgzf.decompress_block(buf, off, size))
+                off += size
+            if out:
+                yield b"".join(out)
+            buf = buf[off:]
+            if not data:
+                if buf:
+                    raise ValueError("trailing truncated BGZF block")
+                return
+    else:
+        yield head
+        while True:
+            data = f.read(read_size)
+            if not data:
+                return
+            yield data
+
+
+class BamStreamReader:
+    """Incremental BAM record reader over a rolling decompressed buffer."""
+
+    def __init__(self, path: str, read_size: int = 4 << 20):
+        self._f = open(path, "rb")
+        self._gen = _iter_bgzf_stream(self._f, read_size)
+        self._buf = bytearray()
+        self._eof = False
+        self.header = self._read_header()
+
+    def close(self):
+        self._f.close()
+
+    def _fill(self, need: int) -> bool:
+        while len(self._buf) < need and not self._eof:
+            try:
+                self._buf += next(self._gen)
+            except StopIteration:
+                self._eof = True
+        return len(self._buf) >= need
+
+    def _need(self, n: int, what: str) -> None:
+        if not self._fill(n):
+            raise ValueError(f"truncated BAM: incomplete {what}")
+
+    def _read_header(self) -> BamHeader:
+        self._need(12, "magic")
+        if bytes(self._buf[:4]) != b"BAM\x01":
+            raise ValueError("not a BAM file")
+        (l_text,) = struct.unpack_from("<i", self._buf, 4)
+        if l_text < 0:
+            raise ValueError("malformed BAM: negative l_text")
+        self._need(8 + l_text + 4, "header text")
+        text = bytes(self._buf[8 : 8 + l_text]).split(b"\x00", 1)[0].decode()
+        off = 8 + l_text
+        (n_ref,) = struct.unpack_from("<i", self._buf, off)
+        if n_ref < 0:
+            raise ValueError("malformed BAM: negative n_ref")
+        off += 4
+        names, lengths = [], []
+        for _ in range(n_ref):
+            self._need(off + 4, "reference entry")
+            (l_name,) = struct.unpack_from("<i", self._buf, off)
+            if l_name < 1:
+                raise ValueError("malformed BAM: bad reference name length")
+            off += 4
+            self._need(off + l_name + 4, "reference entry")
+            names.append(bytes(self._buf[off : off + l_name - 1]).decode())
+            off += l_name
+            (l_ref,) = struct.unpack_from("<i", self._buf, off)
+            off += 4
+            lengths.append(l_ref)
+        del self._buf[:off]
+        return BamHeader(text=text, ref_names=names, ref_lengths=lengths)
+
+    def read_raw_records(self, n: int) -> bytes | None:
+        """Raw bytes of up to n whole records; None at EOF."""
+        count = 0
+        off = 0
+        while count < n:
+            if not self._fill(off + 4):
+                break
+            (bsz,) = struct.unpack_from("<i", self._buf, off)
+            # 32 fixed bytes + >=1 read-name byte is the smallest record
+            if bsz < 33:
+                raise ValueError(f"malformed BAM: record block_size {bsz}")
+            self._need(off + 4 + bsz, "record")
+            off += 4 + bsz
+            count += 1
+        if count == 0:
+            return None
+        out = bytes(self._buf[:off])
+        del self._buf[:off]
+        return out
+
+
+def _records_from_raw(header: BamHeader, raw: bytes) -> BamRecords:
+    """Parse a raw record stream by prepending a minimal header."""
+    shell = bytearray()
+    shell += b"BAM\x01"
+    text = header.text.encode()
+    shell += struct.pack("<i", len(text)) + text
+    shell += struct.pack("<i", len(header.ref_names))
+    for name, length in zip(header.ref_names, header.ref_lengths):
+        nb = name.encode() + b"\x00"
+        shell += struct.pack("<i", len(nb)) + nb + struct.pack("<i", length)
+    _, recs = parse_bam(bytes(shell) + raw)
+    return recs
+
+
+def iter_record_chunks(path: str, chunk_reads: int):
+    """Yield (header, BamRecords) chunks; the trailing pos_key group of
+    each chunk is held back and prepended to the next so no molecule's
+    reads are split across chunks.
+
+    The sort contract (non-decreasing pos_key — see module docstring)
+    is VALIDATED on every chunk: a violation raises instead of silently
+    splitting a family across chunks. Note plain coordinate order is
+    NOT sufficient for paired-end data (a mate's pos_key is the
+    fragment's min coordinate, which sorts earlier than the mate) —
+    that input needs template-coordinate sorting, exactly as the
+    reference domain's duplex tools require.
+    """
+    reader = BamStreamReader(path)
+    header = reader.header
+    carry: BamRecords | None = None
+    prev_last = None
+    try:
+        while True:
+            raw = reader.read_raw_records(chunk_reads)
+            if raw is None:
+                if carry is not None and len(carry):
+                    yield header, carry
+                return
+            recs = _records_from_raw(header, raw)
+            if carry is not None and len(carry):
+                recs = _concat_records(carry, recs)
+            batch_pos = _rec_pos_keys(recs)
+            if len(batch_pos) > 1 and (np.diff(batch_pos) < 0).any():
+                i = int(np.nonzero(np.diff(batch_pos) < 0)[0][0])
+                raise ValueError(
+                    "input violates the streaming sort contract: pos_key "
+                    f"decreases at record ~{i} ({batch_pos[i]} -> "
+                    f"{batch_pos[i+1]}). Streaming needs non-decreasing "
+                    "fragment keys (template-coordinate order for paired "
+                    "data); use whole-file mode (--chunk-reads 0) for "
+                    "unsorted input."
+                )
+            if prev_last is not None and len(batch_pos) and batch_pos[0] <= prev_last:
+                raise ValueError(
+                    "input violates the streaming sort contract across a "
+                    "chunk boundary (pos_key repeats after being flushed)"
+                )
+            # hold back the final pos group (pos of the last record)
+            last = batch_pos[-1]
+            keep = np.nonzero(batch_pos != last)[0]
+            if len(keep) == 0:
+                carry = recs  # entire chunk is one group; keep growing
+                continue
+            cut = int(keep[-1]) + 1
+            carry = _slice_records(recs, cut, len(recs))
+            prev_last = batch_pos[cut - 1]
+            yield header, _slice_records(recs, 0, cut)
+    finally:
+        reader.close()
+
+
+def _rec_pos_keys(recs: BamRecords) -> np.ndarray:
+    from duplexumiconsensusreads_tpu.io.bam import FLAG_PAIRED
+    from duplexumiconsensusreads_tpu.io.convert import pack_pos_key
+
+    flags = np.asarray(recs.flags)
+    paired_ok = (
+        (flags & FLAG_PAIRED).astype(bool)
+        & (recs.next_ref_id == recs.ref_id)
+        & (recs.next_pos >= 0)
+    )
+    coord = np.where(paired_ok, np.minimum(recs.pos, recs.next_pos), recs.pos)
+    return pack_pos_key(recs.ref_id, coord)
+
+
+def _slice_records(recs: BamRecords, a: int, b: int) -> BamRecords:
+    return BamRecords(
+        names=recs.names[a:b],
+        flags=recs.flags[a:b],
+        ref_id=recs.ref_id[a:b],
+        pos=recs.pos[a:b],
+        mapq=recs.mapq[a:b],
+        next_ref_id=recs.next_ref_id[a:b],
+        next_pos=recs.next_pos[a:b],
+        tlen=recs.tlen[a:b],
+        lengths=recs.lengths[a:b],
+        seq=recs.seq[a:b],
+        qual=recs.qual[a:b],
+        cigars=recs.cigars[a:b],
+        umi=recs.umi[a:b],
+        aux_raw=recs.aux_raw[a:b],
+    )
+
+
+def _concat_records(a: BamRecords, b: BamRecords) -> BamRecords:
+    lmax = max(a.seq.shape[1], b.seq.shape[1])
+
+    def padseq(x, fill):
+        out = np.full((x.shape[0], lmax), fill, np.uint8)
+        out[:, : x.shape[1]] = x
+        return out
+
+    from duplexumiconsensusreads_tpu.constants import BASE_PAD
+
+    return BamRecords(
+        names=a.names + b.names,
+        flags=np.concatenate([a.flags, b.flags]),
+        ref_id=np.concatenate([a.ref_id, b.ref_id]),
+        pos=np.concatenate([a.pos, b.pos]),
+        mapq=np.concatenate([a.mapq, b.mapq]),
+        next_ref_id=np.concatenate([a.next_ref_id, b.next_ref_id]),
+        next_pos=np.concatenate([a.next_pos, b.next_pos]),
+        tlen=np.concatenate([a.tlen, b.tlen]),
+        lengths=np.concatenate([a.lengths, b.lengths]),
+        seq=np.concatenate([padseq(a.seq, BASE_PAD), padseq(b.seq, BASE_PAD)]),
+        qual=np.concatenate([padseq(a.qual, 0), padseq(b.qual, 0)]),
+        cigars=a.cigars + b.cigars,
+        umi=a.umi + b.umi,
+        aux_raw=a.aux_raw + b.aux_raw,
+    )
+
+
+# ------------------------------------------------------------ checkpoint
+
+@dataclasses.dataclass
+class Checkpoint:
+    path: str
+    fingerprint: str
+    done: dict  # chunk index (str) -> shard path
+
+    @staticmethod
+    def load_or_create(path: str, fingerprint: str) -> "Checkpoint":
+        if os.path.exists(path):
+            with open(path) as f:
+                d = json.load(f)
+            if d.get("fingerprint") == fingerprint:
+                done = {
+                    k: v for k, v in d.get("done", {}).items() if os.path.exists(v)
+                }
+                return Checkpoint(path, fingerprint, done)
+        return Checkpoint(path, fingerprint, {})
+
+    def mark(self, chunk: int, shard_path: str) -> None:
+        self.done[str(chunk)] = shard_path
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"fingerprint": self.fingerprint, "done": self.done}, f)
+        os.replace(tmp, self.path)
+
+
+def _fingerprint(in_path: str, grouping, consensus, capacity, chunk_reads) -> str:
+    st = os.stat(in_path)
+    key = json.dumps(
+        [
+            os.path.abspath(in_path),
+            st.st_size,
+            int(st.st_mtime),
+            dataclasses.asdict(grouping),
+            dataclasses.asdict(consensus),
+            capacity,
+            chunk_reads,
+        ],
+        sort_keys=True,
+    )
+    return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+
+# -------------------------------------------------------------- executor
+
+def stream_call_consensus(
+    in_path: str,
+    out_path: str,
+    grouping: GroupingParams,
+    consensus: ConsensusParams,
+    capacity: int = 2048,
+    chunk_reads: int = 500_000,
+    n_devices: int | None = None,
+    max_inflight: int = 4,
+    checkpoint_path: str | None = None,
+    resume: bool = False,
+    report_path: str | None = None,
+    profile_dir: str | None = None,
+    progress=None,
+) -> RunReport:
+    """Chunked, async-pipelined consensus calling (TPU backend).
+
+    Writes per-chunk shards next to out_path, then finalises a single
+    consensus BAM. With checkpoint_path + resume=True, finished chunks
+    are skipped on rerun and shards are kept on disk for future
+    resumes; without a checkpoint the shard directory is removed after
+    a successful finalise.
+    """
+    import jax
+
+    from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
+    from duplexumiconsensusreads_tpu.io.bam import serialize_bam, write_bam
+    from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
+    from duplexumiconsensusreads_tpu.parallel import make_mesh
+    from duplexumiconsensusreads_tpu.parallel.sharded import sharded_pipeline
+
+    rep = RunReport(backend="tpu-stream")
+    duplex = consensus.mode == "duplex"
+    t_start = time.time()
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+
+    ckpt = None
+    if checkpoint_path:
+        fp = _fingerprint(in_path, grouping, consensus, capacity, chunk_reads)
+        ckpt = Checkpoint.load_or_create(checkpoint_path, fp)
+        if not resume:
+            ckpt.done = {}
+
+    n_dev = n_devices or len(jax.devices())
+    mesh = make_mesh(n_dev)
+    rep.n_devices = n_dev
+
+    shard_dir = out_path + ".shards"
+    os.makedirs(shard_dir, exist_ok=True)
+    shards: dict[int, str] = {}
+    inflight: deque = deque()
+    header_out: BamHeader | None = None
+    spec_cache: dict = {}
+
+    def drain_one():
+        nonlocal rep
+        k, out, buckets, batch = inflight.popleft()
+        out = {key: np.asarray(v) for key, v in out.items()}
+        rep.n_families += int(out["n_families"].sum())
+        rep.n_molecules += int(out["n_molecules"].sum())
+        shard = _finish_chunk(
+            k, out, buckets, batch, duplex, shard_dir, serialize_bam, header_out
+        )
+        shards[k] = shard
+        if ckpt:
+            ckpt.mark(k, shard)
+        if progress:
+            progress(k, rep)
+
+    n_skipped = 0
+    try:
+        for k, (header, recs) in enumerate(iter_record_chunks(in_path, chunk_reads)):
+            header_out = header_out or header
+            rep.n_records += len(recs)
+            rep.n_chunks += 1
+            if ckpt and str(k) in ckpt.done:
+                shards[k] = ckpt.done[str(k)]
+                n_skipped += 1
+                continue
+            batch, info = records_to_readbatch(recs, duplex=duplex)
+            rep.n_valid_reads += info["n_valid"]
+            rep.n_dropped += info["n_dropped_no_umi"] + info["n_dropped_umi_len"]
+            buckets = build_buckets(
+                batch, capacity=capacity, adjacency=grouping.strategy == "adjacency"
+            )
+            rep.n_buckets += len(buckets)
+            if not buckets:
+                shards[k] = _write_shard(shard_dir, k, b"")
+                if ckpt:
+                    ckpt.mark(k, shards[k])
+                continue
+            spec = spec_for_buckets(buckets, grouping, consensus)
+            spec_cache[spec] = True
+            stacked = stack_buckets(buckets, multiple_of=n_dev)
+            out = sharded_pipeline(stacked, spec, mesh)  # async dispatch
+            inflight.append((k, out, buckets, batch))
+            while len(inflight) >= max_inflight:
+                drain_one()
+        while inflight:
+            drain_one()
+    finally:
+        if profile_dir:
+            jax.profiler.stop_trace()
+
+    # ---- finalise: header + shard record streams -> one BAM. Shards
+    # are compressed and appended one at a time (BGZF members
+    # concatenate), so peak memory stays one chunk regardless of the
+    # total output size; records are counted during the same pass. ----
+    if header_out is None:
+        header_out = BamHeader.synthetic()
+        write_bam(out_path, header_out, _empty_records())
+    else:
+        shell = serialize_bam(header_out, _empty_records())
+        with open(out_path, "wb") as f:
+            f.write(bgzf.compress(shell, eof=False))
+            for k in sorted(shards):
+                with open(shards[k], "rb") as s:
+                    data = s.read()
+                if data:
+                    f.write(bgzf.compress(data, eof=False))
+                rep.n_consensus += _count_records(data)
+            f.write(bgzf.BGZF_EOF)
+    if not checkpoint_path:
+        # no resume requested: the shards can never be reused
+        for k in shards:
+            try:
+                os.remove(shards[k])
+            except OSError:
+                pass
+        try:
+            os.rmdir(shard_dir)
+        except OSError:
+            pass
+    rep.n_chunks_skipped = n_skipped
+    rep.seconds["total"] = round(time.time() - t_start, 3)
+    rep.seconds["n_pipeline_compiles"] = len(spec_cache)
+    if report_path:
+        with open(report_path, "w") as f:
+            f.write(rep.to_json() + "\n")
+    return rep
+
+
+def _empty_records() -> BamRecords:
+    return BamRecords(
+        names=[],
+        flags=np.zeros(0, np.uint16),
+        ref_id=np.zeros(0, np.int32),
+        pos=np.zeros(0, np.int32),
+        mapq=np.zeros(0, np.uint8),
+        next_ref_id=np.zeros(0, np.int32),
+        next_pos=np.zeros(0, np.int32),
+        tlen=np.zeros(0, np.int32),
+        lengths=np.zeros(0, np.int32),
+        seq=np.zeros((0, 0), np.uint8),
+        qual=np.zeros((0, 0), np.uint8),
+        cigars=[],
+        umi=[],
+        aux_raw=[],
+    )
+
+
+def _write_shard(shard_dir: str, k: int, payload: bytes) -> str:
+    path = os.path.join(shard_dir, f"chunk{k:06d}.recs")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
+def _count_records(data: bytes) -> int:
+    n = 0
+    off = 0
+    while off < len(data):
+        (bsz,) = struct.unpack_from("<i", data, off)
+        off += 4 + bsz
+        n += 1
+    return n
+
+
+def _finish_chunk(
+    k, out, buckets, batch, duplex, shard_dir, serialize_bam, header
+) -> str:
+    """Scatter one chunk's device output back and write its shard."""
+    cb, cq, cd, fp, fu = scatter_bucket_outputs(out, buckets, batch, duplex)
+    recs = consensus_to_records(
+        cb,
+        cq,
+        cd,
+        np.ones(len(cb), bool),
+        fp,
+        fu,
+        duplex=duplex,
+        name_prefix=f"cons{k}",
+    )
+    # record stream only (header stripped) so shards concatenate
+    full = serialize_bam(header, recs)
+    shell = serialize_bam(header, _empty_records())
+    return _write_shard(shard_dir, k, full[len(shell):])
