@@ -54,6 +54,13 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--max-input-qual", type=int, default=50)
     c.add_argument("--capacity", type=int, default=None, help="bucket read capacity")
     c.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
+    c.add_argument(
+        "--cycle-shards",
+        type=int,
+        default=1,
+        help="shard the read-length axis this many ways (long reads); "
+        "devices must be divisible by it",
+    )
     c.add_argument("--report", help="write run counters/timings JSON here")
     c.add_argument("--profile", help="write a jax.profiler trace to this dir")
     c.add_argument(
@@ -149,6 +156,7 @@ def _cmd_call(args) -> int:
             resume=args.resume,
             report_path=args.report,
             profile_dir=args.profile,
+            cycle_shards=args.cycle_shards,
         )
     else:
         rep = call_consensus_file(
@@ -161,6 +169,7 @@ def _cmd_call(args) -> int:
             n_devices=args.devices,
             report_path=args.report,
             profile_dir=args.profile,
+            cycle_shards=args.cycle_shards,
         )
     print(
         f"[duplexumi] {rep.n_valid_reads}/{rep.n_records} reads → "

@@ -84,6 +84,23 @@ def read_is_top_strand(flag: int) -> bool:
     return not flag & FLAG_REVERSE
 
 
+def records_pos_keys(recs: BamRecords) -> np.ndarray:
+    """Canonical fragment pos_key per record — THE grouping key.
+
+    Single source of truth shared by batch conversion and the
+    streaming chunker (whose family-integrity guarantee requires the
+    chunk-boundary key to be byte-identical to the grouping key).
+    """
+    flags = np.asarray(recs.flags)
+    paired_ok = (
+        (flags & FLAG_PAIRED).astype(bool)
+        & (recs.next_ref_id == recs.ref_id)
+        & (recs.next_pos >= 0)
+    )
+    coord = np.where(paired_ok, np.minimum(recs.pos, recs.next_pos), recs.pos)
+    return pack_pos_key(recs.ref_id, coord)
+
+
 def records_to_readbatch(
     recs: BamRecords, duplex: bool = True
 ) -> tuple[ReadBatch, dict]:
@@ -107,15 +124,7 @@ def records_to_readbatch(
     batch = ReadBatch.empty(n, l, umi_len)
     n_no_umi = n_bad_len = 0
     flags = np.asarray(recs.flags)
-    paired_ok = (
-        (flags & FLAG_PAIRED).astype(bool)
-        & (recs.next_ref_id == recs.ref_id)
-        & (recs.next_pos >= 0)
-    )
-    coord = np.where(
-        paired_ok, np.minimum(recs.pos, recs.next_pos), recs.pos
-    )
-    pos_key = pack_pos_key(recs.ref_id, coord)
+    pos_key = records_pos_keys(recs)
 
     for i in range(n):
         codes = umi_codes[i]

@@ -1,5 +1,10 @@
+from duplexumiconsensusreads_tpu.parallel.distributed import (  # noqa: F401
+    host_tile_range,
+    init_distributed,
+)
 from duplexumiconsensusreads_tpu.parallel.mesh import make_mesh  # noqa: F401
 from duplexumiconsensusreads_tpu.parallel.sharded import (  # noqa: F401
-    sharded_pipeline,
+    presharded_pipeline,
     shard_stacked,
+    sharded_pipeline,
 )

@@ -1,0 +1,74 @@
+"""Multi-host initialisation and input partitioning.
+
+The distributed execution model (the NCCL/MPI-backend analogue, done
+the JAX way): every host runs the same program; jax.distributed wires
+the hosts into one runtime whose jax.devices() spans all chips; the
+('data'[, 'cycle']) mesh then shards buckets across hosts over ICI/DCN
+with GSPMD. Because buckets are independent, the compiled program has
+no cross-device collectives — multi-host scaling is input partitioning
+plus a final per-host gather of the consensus shards each host owns.
+
+Input partitioning for BAMs: hosts take disjoint genomic-tile ranges
+(`host_tile_range`), stream their range with the chunked executor, and
+write per-host outputs that concatenate like shards (BGZF members).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Initialise jax.distributed from args or the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID —
+    cloud TPU pods auto-detect all three). No-op on single process.
+
+    Returns {"process_id", "num_processes", "local_devices",
+    "global_devices"} for logging.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None
+    )
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    if coordinator_address or (num_processes or 0) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def host_tile_range(
+    n_tiles: int,
+    process_id: int | None = None,
+    num_processes: int | None = None,
+) -> range:
+    """This host's contiguous share of n_tiles genomic tiles.
+
+    Tiles (position-key ranges) are the unit of input partitioning:
+    each host streams only its BAM region, so the input pipeline scales
+    with hosts exactly like the device pipeline does with chips.
+    """
+    pid = jax.process_index() if process_id is None else process_id
+    n = jax.process_count() if num_processes is None else num_processes
+    per = -(-n_tiles // n)
+    return range(min(pid * per, n_tiles), min((pid + 1) * per, n_tiles))

@@ -1,11 +1,22 @@
-"""Device mesh construction for bucket-parallel execution.
+"""Device mesh construction for bucket- and cycle-parallel execution.
 
 The workload is embarrassingly parallel over buckets (each bucket is a
-closed set of position groups), so the mesh is a single 'data' axis:
+closed set of position groups), so the primary mesh axis is 'data':
 buckets shard across chips over ICI, and the only cross-device traffic
-is the final host gather of consensus tensors. Multi-host meshes work
-unchanged — jax.sharding places bucket shards on each host's local
-chips and XLA rides ICI/DCN as needed.
+is the final host gather of consensus tensors.
+
+A second, optional 'cycle' axis shards the read-length dimension — the
+sequence-parallelism analogue for this domain. Consensus math is
+per-cycle independent (log-likelihood accumulation contracts over
+reads, never cycles), so cycle shards need ZERO collectives; grouping
+ignores the cycle axis entirely and is replicated by GSPMD. Use it for
+long-read workloads (multi-kb cycles) where one chip's share of a
+bucket's (R, L) tensor would otherwise blow past VMEM-friendly sizes.
+
+Multi-host: call parallel.distributed.init_distributed() first; after
+that jax.devices() spans every host and these meshes shard across
+ICI/DCN exactly the same way (GSPMD inserts nothing extra because the
+program has no cross-bucket communication).
 """
 
 from __future__ import annotations
@@ -15,9 +26,25 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
+def make_mesh(
+    n_devices: int | None = None,
+    axis: str = "data",
+    cycle_shards: int = 1,
+) -> Mesh:
+    """A ('data',) mesh, or ('data', 'cycle') when cycle_shards > 1.
+
+    n_devices counts TOTAL devices used; it must be divisible by
+    cycle_shards.
+    """
     devs = jax.devices()
     n = n_devices or len(devs)
     if n > len(devs):
         raise ValueError(f"requested {n} devices, have {len(devs)}")
-    return Mesh(np.array(devs[:n]), (axis,))
+    if cycle_shards <= 1:
+        return Mesh(np.array(devs[:n]), (axis,))
+    if n % cycle_shards:
+        raise ValueError(
+            f"n_devices {n} not divisible by cycle_shards {cycle_shards}"
+        )
+    arr = np.array(devs[:n]).reshape(n // cycle_shards, cycle_shards)
+    return Mesh(arr, (axis, "cycle"))

@@ -22,9 +22,21 @@ _ARRAY_KEYS = ("pos", "umi", "strand_ab", "valid", "bases", "quals")
 
 
 def shard_stacked(stacked: dict, mesh: Mesh, axis: str = "data") -> dict:
-    """Device-put the stacked bucket arrays with bucket-axis sharding."""
+    """Device-put the stacked bucket arrays with bucket-axis sharding.
+
+    On a ('data', 'cycle') mesh the (B, R, L) bases/quals tensors are
+    additionally sharded along L — per-cycle consensus math needs no
+    collectives, so this is free sequence parallelism for long reads.
+    """
     sh = NamedSharding(mesh, P(axis))
-    return {k: jax.device_put(stacked[k], sh) for k in _ARRAY_KEYS}
+    out = {}
+    has_cycle = "cycle" in mesh.axis_names
+    sh_cycle = NamedSharding(mesh, P(axis, None, "cycle")) if has_cycle else sh
+    for k in _ARRAY_KEYS:
+        out[k] = jax.device_put(
+            stacked[k], sh_cycle if k in ("bases", "quals") else sh
+        )
+    return out
 
 
 @partial(jax.jit, static_argnames=("spec",))

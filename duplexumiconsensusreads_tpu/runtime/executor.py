@@ -40,6 +40,8 @@ class RunReport:
     n_devices: int = 1
     n_chunks: int = 0  # streaming only
     n_chunks_skipped: int = 0  # streaming resume: chunks served from shards
+    n_size_classes: int = 0
+    n_pipeline_compiles: int = 0
     backend: str = ""
     seconds: dict = dataclasses.field(default_factory=dict)
 
@@ -150,6 +152,7 @@ def call_batch_tpu(
     capacity: int = 2048,
     n_devices: int | None = None,
     report: RunReport | None = None,
+    cycle_shards: int = 1,
 ):
     """Run one host ReadBatch through the bucketed mesh pipeline.
 
@@ -171,7 +174,6 @@ def call_batch_tpu(
     buckets = build_buckets(batch, capacity=capacity, adjacency=grouping.strategy == "adjacency")
     rep.n_buckets = len(buckets)
     rep.seconds["bucketing"] = round(time.time() - t0, 4)
-    spec = spec_for_buckets(buckets, grouping, consensus)
     if not buckets:
         u = batch.umi_len
         z = np.zeros
@@ -185,22 +187,53 @@ def call_batch_tpu(
         )
 
     n_dev = n_devices or len(jax.devices())
-    mesh = make_mesh(n_dev)
+    mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
     rep.n_devices = n_dev
-    stacked = stack_buckets(buckets, multiple_of=n_dev)
+    n_data = max(n_dev // max(cycle_shards, 1), 1)
+
+    # (genomic tile, family-size) bucketing, second axis: buckets are
+    # classed by their unique-key count (pow2) so a sparse-coverage
+    # bucket doesn't pay the dense buckets' u_max/f_max geometry. All
+    # classes are dispatched before any is drained (async overlap).
+    classes: dict[int, list] = {}
+    for bk in buckets:
+        cls = 1 << max(bk.n_unique_umi - 1, 0).bit_length()
+        classes.setdefault(cls, []).append(bk)
 
     t0 = time.time()
-    out = sharded_pipeline(stacked, spec, mesh)
-    out = {k: np.asarray(v) for k, v in out.items()}
-    rep.seconds["device_pipeline"] = round(time.time() - t0, 4)
+    pending = []
+    for cls in sorted(classes):
+        cbuckets = classes[cls]
+        cspec = spec_for_buckets(cbuckets, grouping, consensus)
+        stacked = stack_buckets(cbuckets, multiple_of=n_data)
+        pending.append((cbuckets, sharded_pipeline(stacked, cspec, mesh)))
+    rep.seconds["device_dispatch"] = round(time.time() - t0, 4)
 
     t0 = time.time()
-    n_real = stacked["n_real_buckets"]
-    rep.n_families += int(out["n_families"][:n_real].sum())
-    rep.n_molecules += int(out["n_molecules"][:n_real].sum())
-    cb, cq, cd, fp, fu = scatter_bucket_outputs(out, buckets, batch, duplex)
-    rep.seconds["scatter_back"] = round(time.time() - t0, 4)
-    return cb, cq, cd, np.ones(len(cb), bool), fp, fu
+    parts = []
+    for cbuckets, out in pending:
+        out = {k: np.asarray(v) for k, v in out.items()}
+        n_real = len(cbuckets)
+        rep.n_families += int(out["n_families"][:n_real].sum())
+        rep.n_molecules += int(out["n_molecules"][:n_real].sum())
+        parts.append(scatter_bucket_outputs(out, cbuckets, batch, duplex))
+    rep.seconds["device_pipeline_and_scatter"] = round(time.time() - t0, 4)
+    rep.n_size_classes = len(classes)
+
+    cb, cq, cd, fp, fu = (np.concatenate(x) for x in zip(*parts))
+    # class-wise dispatch visits buckets out of genomic order; restore
+    # (pos_key, UMI) order so the output BAM stays coordinate-sorted
+    # (its own streaming executor — and most downstream tools — expect
+    # non-decreasing positions)
+    order = np.lexsort((pack_umi(fu), fp))
+    return (
+        cb[order],
+        cq[order],
+        cd[order],
+        np.ones(len(cb), bool),
+        fp[order],
+        fu[order],
+    )
 
 
 def call_batch_cpu(
@@ -251,6 +284,7 @@ def call_consensus_file(
     n_devices: int | None = None,
     report_path: str | None = None,
     profile_dir: str | None = None,
+    cycle_shards: int = 1,
 ) -> RunReport:
     """End-to-end: read BAM/npz → consensus → write consensus BAM."""
     from duplexumiconsensusreads_tpu.io import (
@@ -297,7 +331,8 @@ def call_consensus_file(
     try:
         if backend == "tpu":
             cb, cq, cd, cv, fp, fu = call_batch_tpu(
-                batch, grouping, consensus, capacity, n_devices, rep
+                batch, grouping, consensus, capacity, n_devices, rep,
+                cycle_shards=cycle_shards,
             )
         elif backend == "cpu":
             cb, cq, cd, cv, fp, fu = call_batch_cpu(batch, grouping, consensus, rep)

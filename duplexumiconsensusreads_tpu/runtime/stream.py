@@ -39,6 +39,9 @@ from duplexumiconsensusreads_tpu.io.convert import (
     consensus_to_records,
     records_to_readbatch,
 )
+
+# chunk-boundary key MUST be the grouping key: one shared implementation
+from duplexumiconsensusreads_tpu.io.convert import records_pos_keys as _rec_pos_keys
 from duplexumiconsensusreads_tpu.runtime.executor import (
     RunReport,
     scatter_bucket_outputs,
@@ -157,6 +160,10 @@ class BamStreamReader:
             off += 4 + bsz
             count += 1
         if count == 0:
+            if self._buf and self._eof:
+                raise ValueError(
+                    "truncated BAM: trailing partial record at EOF"
+                )
             return None
         out = bytes(self._buf[:off])
         del self._buf[:off]
@@ -234,18 +241,6 @@ def iter_record_chunks(path: str, chunk_reads: int):
         reader.close()
 
 
-def _rec_pos_keys(recs: BamRecords) -> np.ndarray:
-    from duplexumiconsensusreads_tpu.io.bam import FLAG_PAIRED
-    from duplexumiconsensusreads_tpu.io.convert import pack_pos_key
-
-    flags = np.asarray(recs.flags)
-    paired_ok = (
-        (flags & FLAG_PAIRED).astype(bool)
-        & (recs.next_ref_id == recs.ref_id)
-        & (recs.next_pos >= 0)
-    )
-    coord = np.where(paired_ok, np.minimum(recs.pos, recs.next_pos), recs.pos)
-    return pack_pos_key(recs.ref_id, coord)
 
 
 def _slice_records(recs: BamRecords, a: int, b: int) -> BamRecords:
@@ -355,6 +350,7 @@ def stream_call_consensus(
     resume: bool = False,
     report_path: str | None = None,
     profile_dir: str | None = None,
+    cycle_shards: int = 1,
     progress=None,
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
@@ -368,7 +364,7 @@ def stream_call_consensus(
     import jax
 
     from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
-    from duplexumiconsensusreads_tpu.io.bam import serialize_bam, write_bam
+    from duplexumiconsensusreads_tpu.io.bam import serialize_bam
     from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
     from duplexumiconsensusreads_tpu.parallel import make_mesh
     from duplexumiconsensusreads_tpu.parallel.sharded import sharded_pipeline
@@ -387,14 +383,19 @@ def stream_call_consensus(
             ckpt.done = {}
 
     n_dev = n_devices or len(jax.devices())
-    mesh = make_mesh(n_dev)
+    mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
+    n_data = max(n_dev // max(cycle_shards, 1), 1)
     rep.n_devices = n_dev
+
+    # the input header is authoritative even if the file has no records
+    _hdr_reader = BamStreamReader(in_path)
+    header_out = _hdr_reader.header
+    _hdr_reader.close()
 
     shard_dir = out_path + ".shards"
     os.makedirs(shard_dir, exist_ok=True)
     shards: dict[int, str] = {}
     inflight: deque = deque()
-    header_out: BamHeader | None = None
     spec_cache: dict = {}
 
     def drain_one():
@@ -415,7 +416,6 @@ def stream_call_consensus(
     n_skipped = 0
     try:
         for k, (header, recs) in enumerate(iter_record_chunks(in_path, chunk_reads)):
-            header_out = header_out or header
             rep.n_records += len(recs)
             rep.n_chunks += 1
             if ckpt and str(k) in ckpt.done:
@@ -436,7 +436,7 @@ def stream_call_consensus(
                 continue
             spec = spec_for_buckets(buckets, grouping, consensus)
             spec_cache[spec] = True
-            stacked = stack_buckets(buckets, multiple_of=n_dev)
+            stacked = stack_buckets(buckets, multiple_of=n_data)
             out = sharded_pipeline(stacked, spec, mesh)  # async dispatch
             inflight.append((k, out, buckets, batch))
             while len(inflight) >= max_inflight:
@@ -451,20 +451,16 @@ def stream_call_consensus(
     # are compressed and appended one at a time (BGZF members
     # concatenate), so peak memory stays one chunk regardless of the
     # total output size; records are counted during the same pass. ----
-    if header_out is None:
-        header_out = BamHeader.synthetic()
-        write_bam(out_path, header_out, _empty_records())
-    else:
-        shell = serialize_bam(header_out, _empty_records())
-        with open(out_path, "wb") as f:
-            f.write(bgzf.compress(shell, eof=False))
-            for k in sorted(shards):
-                with open(shards[k], "rb") as s:
-                    data = s.read()
-                if data:
-                    f.write(bgzf.compress(data, eof=False))
-                rep.n_consensus += _count_records(data)
-            f.write(bgzf.BGZF_EOF)
+    shell = serialize_bam(header_out, _empty_records())
+    with open(out_path, "wb") as f:
+        f.write(bgzf.compress(shell, eof=False))
+        for k in sorted(shards):
+            with open(shards[k], "rb") as s:
+                data = s.read()
+            if data:
+                f.write(bgzf.compress(data, eof=False))
+            rep.n_consensus += _count_records(data)
+        f.write(bgzf.BGZF_EOF)
     if not checkpoint_path:
         # no resume requested: the shards can never be reused
         for k in shards:
@@ -477,8 +473,8 @@ def stream_call_consensus(
         except OSError:
             pass
     rep.n_chunks_skipped = n_skipped
+    rep.n_pipeline_compiles = len(spec_cache)
     rep.seconds["total"] = round(time.time() - t_start, 3)
-    rep.seconds["n_pipeline_compiles"] = len(spec_cache)
     if report_path:
         with open(report_path, "w") as f:
             f.write(rep.to_json() + "\n")

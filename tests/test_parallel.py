@@ -1,0 +1,121 @@
+"""Mesh sharding tests on the virtual 8-device CPU mesh: 1D data
+sharding, 2D (data, cycle) sequence sharding, and multi-host helpers.
+Results must be identical no matter how the mesh slices the work."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
+from duplexumiconsensusreads_tpu.ops import spec_for_buckets
+from duplexumiconsensusreads_tpu.parallel import (
+    host_tile_range,
+    make_mesh,
+    sharded_pipeline,
+)
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _workload(read_len=64):
+    batch, _ = simulate_batch(
+        SimConfig(
+            n_molecules=160, read_len=read_len, n_positions=16,
+            umi_error=0.02, duplex=True, seed=77,
+        )
+    )
+    buckets = build_buckets(batch, capacity=256, adjacency=True)
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex", error_model="cycle")
+    spec = spec_for_buckets(buckets, gp, cp)
+    return buckets, spec
+
+
+def _run(buckets, spec, mesh, n_dev):
+    stacked = stack_buckets(buckets, multiple_of=n_dev)
+    out = sharded_pipeline(stacked, spec, mesh)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _assert_equivalent(ref, out, n):
+    """Partitioning must not change results — except that XLA may
+    reassociate f32 sums across layouts, which can perturb the tiny
+    excluded-max residual behind a high qual. Bases/ids/depth must be
+    exact; quals tolerate <0.01% of elements differing (all at the
+    high-confidence end where the residual underflows)."""
+    for k in ("family_id", "molecule_id", "cons_base", "cons_depth", "cons_valid"):
+        np.testing.assert_array_equal(ref[k][:n], out[k][:n], err_msg=k)
+    q_ref = ref["cons_qual"][:n].astype(int)
+    q_out = out["cons_qual"][:n].astype(int)
+    frac = (q_ref != q_out).mean()
+    assert frac < 1e-4, f"qual mismatch fraction {frac}"
+    # any differing sites must be high-confidence on both sides
+    diff = q_ref != q_out
+    if diff.any():
+        assert q_ref[diff].min() > 60 and q_out[diff].min() > 60
+
+
+@needs8
+def test_data_sharding_matches_single_device():
+    buckets, spec = _workload()
+    ref = _run(buckets, spec, make_mesh(1), 1)
+    out = _run(buckets, spec, make_mesh(8), 8)
+    _assert_equivalent(ref, out, len(buckets))
+
+
+@needs8
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4), (1, 8)])
+def test_cycle_sharding_matches(shape):
+    d, c = shape
+    buckets, spec = _workload(read_len=64)
+    ref = _run(buckets, spec, make_mesh(1), 1)
+    mesh = make_mesh(d * c, cycle_shards=c)
+    assert mesh.axis_names == ("data", "cycle")
+    out = _run(buckets, spec, mesh, d)
+    _assert_equivalent(ref, out, len(buckets))
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        make_mesh(max(len(jax.devices()) // 2 * 2, 2), cycle_shards=3) \
+            if len(jax.devices()) >= 2 else (_ for _ in ()).throw(
+                ValueError("divisible"))
+
+
+def test_host_tile_range_partition():
+    # simulated 4-process layout must cover all tiles disjointly
+    n_tiles = 10
+    seen = []
+    for pid in range(4):
+        r = host_tile_range(n_tiles, process_id=pid, num_processes=4)
+        seen.extend(r)
+    assert sorted(seen) == list(range(n_tiles))
+
+
+@needs8
+def test_cli_cycle_shards(tmp_path):
+    from duplexumiconsensusreads_tpu.cli import main
+    from duplexumiconsensusreads_tpu.io import read_bam, simulated_bam
+
+    bam = str(tmp_path / "x.bam")
+    simulated_bam(SimConfig(n_molecules=40, duplex=True, seed=6), path=bam)
+    out = str(tmp_path / "y.bam")
+    assert main(
+        ["call", bam, "-o", out, "--config", "config3", "--capacity", "256",
+         "--devices", "8", "--cycle-shards", "2"]
+    ) == 0
+    _, recs = read_bam(out)
+    assert len(recs) > 0
+
+
+def test_init_distributed_single_process():
+    from duplexumiconsensusreads_tpu.parallel import init_distributed
+
+    info = init_distributed()  # no coordinator -> no-op
+    assert info["num_processes"] == 1
+    assert info["global_devices"] == len(jax.devices())
