@@ -101,6 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="emit records in coordinate order (streaming input contract)",
     )
+    s.add_argument(
+        "--paired-end",
+        action="store_true",
+        help="emit paired-end style flags (F1R2/F2R1) with mate pointers",
+    )
     s.add_argument("--seed", type=int, default=0)
 
     v = sub.add_parser("validate", help="consensus error rate vs simulation truth")
@@ -200,7 +205,9 @@ def _cmd_simulate(args) -> int:
         duplex=not args.single_strand,
         seed=args.seed,
     )
-    _, recs, batch, truth = simulated_bam(cfg, path=args.output, sort=args.sorted)
+    _, recs, batch, truth = simulated_bam(
+        cfg, path=args.output, sort=args.sorted, paired_end=args.paired_end
+    )
     if args.truth:
         np.savez_compressed(
             args.truth,

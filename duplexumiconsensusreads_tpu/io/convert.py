@@ -159,10 +159,20 @@ def readbatch_to_records(
     batch: ReadBatch,
     duplex: bool = True,
     names: list[str] | None = None,
+    paired_end: bool = False,
 ) -> BamRecords:
-    """Inverse of records_to_readbatch for synthetic data: emit
-    single-end records whose reverse flag encodes the strand and whose
-    RX segments are de-canonicalised (swapped back for BA reads)."""
+    """Inverse of records_to_readbatch for synthetic data: emit records
+    whose flags encode the strand and whose RX segments are
+    de-canonicalised (swapped back for BA reads).
+
+    paired_end=False emits single-end records (reverse flag = strand).
+    paired_end=True emits paired-style flags instead — top strand as
+    F1R2 (read1 forward, mate reverse), bottom as F2R1 — with a mate
+    pointer at the same position, exercising the full paired strand
+    derivation and min(pos, next_pos) pos_key path end-to-end.
+    """
+    from duplexumiconsensusreads_tpu.io.bam import FLAG_MATE_REVERSE
+
     valid = np.asarray(batch.valid, bool)
     idx = np.nonzero(valid)[0]
     n = len(idx)
@@ -170,7 +180,12 @@ def readbatch_to_records(
     lengths = np.full(n, l, np.int32)
     ref_id, pos = unpack_pos_key(np.asarray(batch.pos_key)[idx])
     strand = np.asarray(batch.strand_ab, bool)[idx]
-    flags = np.where(strand, 0, FLAG_REVERSE).astype(np.uint16)
+    if paired_end:
+        top_flag = FLAG_PAIRED | FLAG_READ1 | FLAG_MATE_REVERSE  # F1R2
+        bot_flag = FLAG_PAIRED | FLAG_READ2 | FLAG_MATE_REVERSE  # F2(R1)
+        flags = np.where(strand, top_flag, bot_flag).astype(np.uint16)
+    else:
+        flags = np.where(strand, 0, FLAG_REVERSE).astype(np.uint16)
 
     umis = []
     for j, i in enumerate(idx):
@@ -184,15 +199,25 @@ def readbatch_to_records(
     # PAD cycles inside a record are not representable; render as N
     seq = np.where(seq == BASE_PAD, 4, seq).astype(np.uint8)
 
+    if paired_end:
+        # mate points at the same fragment start so pos_key (min of the
+        # two coordinates) round-trips exactly
+        next_ref_id = ref_id.copy()
+        next_pos = pos.copy()
+        tlen = np.full(n, l, np.int32)
+    else:
+        next_ref_id = np.full(n, -1, np.int32)
+        next_pos = np.full(n, -1, np.int32)
+        tlen = np.zeros(n, np.int32)
     return BamRecords(
         names=(names or [f"read{i}" for i in idx]),
         flags=flags,
         ref_id=ref_id,
         pos=pos,
         mapq=np.full(n, 60, np.uint8),
-        next_ref_id=np.full(n, -1, np.int32),
-        next_pos=np.full(n, -1, np.int32),
-        tlen=np.zeros(n, np.int32),
+        next_ref_id=next_ref_id,
+        next_pos=next_pos,
+        tlen=tlen,
         lengths=lengths,
         seq=seq,
         qual=np.asarray(batch.quals)[idx],
@@ -250,7 +275,9 @@ def consensus_to_records(
     )
 
 
-def simulated_bam(cfg=None, path: str | None = None, sort: bool = False):
+def simulated_bam(
+    cfg=None, path: str | None = None, sort: bool = False, paired_end: bool = False
+):
     """Simulate a truth-aware batch and render it as a BAM (bytes or file).
 
     Convenience used by the CLI's `simulate` subcommand and tests.
@@ -272,7 +299,7 @@ def simulated_bam(cfg=None, path: str | None = None, sort: bool = False):
             truth, read_mol=truth.read_mol[order], read_strand=truth.read_strand[order]
         )
     header = BamHeader.synthetic()
-    recs = readbatch_to_records(batch, duplex=cfg.duplex)
+    recs = readbatch_to_records(batch, duplex=cfg.duplex, paired_end=paired_end)
     if path is not None:
         write_bam(path, header, recs)
     return header, recs, batch, truth

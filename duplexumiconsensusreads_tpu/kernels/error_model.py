@@ -42,10 +42,19 @@ def fit_cycle_cap_kernel(
     )
     mism = jnp.sum(contrib & (bases.astype(jnp.int32) != cb), axis=0)
     total = jnp.sum(contrib, axis=0)
-    rate = (mism + 1.0) / (total + 2.0)
-    rate = jnp.maximum(rate, MIN_ERROR_PROB)
-    q = jnp.floor(-10.0 * jnp.log10(rate) + 1e-9)
-    return jnp.clip(q, 2, max_phred_cap).astype(jnp.int32)
+    # Exact-threshold Phred cap — comparisons, not log10: IEEE f32
+    # multiply/compare are bit-identical across NumPy and XLA, f32
+    # log10 is not. The table is shared with the oracle so parity can't
+    # drift (see oracle.error_model.phred_cap_from_counts).
+    from duplexumiconsensusreads_tpu.oracle.error_model import phred_cap_thresholds
+
+    thr = jnp.asarray(phred_cap_thresholds(max_phred_cap))
+    m = (mism + 1).astype(jnp.float32)
+    t = (total + 2).astype(jnp.float32)
+    count = jnp.sum(
+        (m[:, None] <= t[:, None] * thr[None, :]).astype(jnp.int32), axis=1
+    )
+    return jnp.clip(count - 1, 2, max_phred_cap).astype(jnp.int32)
 
 
 def apply_cycle_cap(quals: jnp.ndarray, cycle_cap: jnp.ndarray) -> jnp.ndarray:

@@ -42,6 +42,7 @@ class RunReport:
     n_chunks_skipped: int = 0  # streaming resume: chunks served from shards
     n_size_classes: int = 0
     n_pipeline_compiles: int = 0
+    n_retries: int = 0  # streaming: chunks re-dispatched after a failure
     backend: str = ""
     seconds: dict = dataclasses.field(default_factory=dict)
 

@@ -386,11 +386,7 @@ def stream_call_consensus(
     mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
     n_data = max(n_dev // max(cycle_shards, 1), 1)
     rep.n_devices = n_dev
-
-    # the input header is authoritative even if the file has no records
-    _hdr_reader = BamStreamReader(in_path)
-    header_out = _hdr_reader.header
-    _hdr_reader.close()
+    header_out: BamHeader | None = None
 
     shard_dir = out_path + ".shards"
     os.makedirs(shard_dir, exist_ok=True)
@@ -398,10 +394,26 @@ def stream_call_consensus(
     inflight: deque = deque()
     spec_cache: dict = {}
 
+    def dispatch(buckets, spec):
+        stacked = stack_buckets(buckets, multiple_of=n_data)
+        return sharded_pipeline(stacked, spec, mesh)
+
     def drain_one():
         nonlocal rep
-        k, out, buckets, batch = inflight.popleft()
-        out = {key: np.asarray(v) for key, v in out.items()}
+        k, out, buckets, batch, spec = inflight.popleft()
+        try:
+            out = {key: np.asarray(v) for key, v in out.items()}
+        except Exception as e:  # failure detection: retry the chunk once
+            rep.n_retries += 1
+            import sys
+
+            print(
+                f"[duplexumi] chunk {k} device execution failed ({e!r}); "
+                "re-dispatching once",
+                file=sys.stderr,
+            )
+            out = dispatch(buckets, spec)
+            out = {key: np.asarray(v) for key, v in out.items()}
         rep.n_families += int(out["n_families"].sum())
         rep.n_molecules += int(out["n_molecules"].sum())
         shard = _finish_chunk(
@@ -416,6 +428,7 @@ def stream_call_consensus(
     n_skipped = 0
     try:
         for k, (header, recs) in enumerate(iter_record_chunks(in_path, chunk_reads)):
+            header_out = header_out or header
             rep.n_records += len(recs)
             rep.n_chunks += 1
             if ckpt and str(k) in ckpt.done:
@@ -436,9 +449,8 @@ def stream_call_consensus(
                 continue
             spec = spec_for_buckets(buckets, grouping, consensus)
             spec_cache[spec] = True
-            stacked = stack_buckets(buckets, multiple_of=n_data)
-            out = sharded_pipeline(stacked, spec, mesh)  # async dispatch
-            inflight.append((k, out, buckets, batch))
+            out = dispatch(buckets, spec)  # async
+            inflight.append((k, out, buckets, batch, spec))
             while len(inflight) >= max_inflight:
                 drain_one()
         while inflight:
@@ -451,6 +463,11 @@ def stream_call_consensus(
     # are compressed and appended one at a time (BGZF members
     # concatenate), so peak memory stays one chunk regardless of the
     # total output size; records are counted during the same pass. ----
+    if header_out is None:
+        # record-less input: the real header is still authoritative
+        _r = BamStreamReader(in_path)
+        header_out = _r.header
+        _r.close()
     shell = serialize_bam(header_out, _empty_records())
     with open(out_path, "wb") as f:
         f.write(bgzf.compress(shell, eof=False))

@@ -1,0 +1,154 @@
+"""Randomized property tests: for many random (config, seed) draws the
+fused device pipeline must agree with the NumPy oracle, and paired-end
+flag encoding must be transparent to the whole workflow."""
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.bucketing import build_buckets
+from duplexumiconsensusreads_tpu.io import (
+    read_bam,
+    records_to_readbatch,
+    simulated_bam,
+)
+from duplexumiconsensusreads_tpu.oracle import group_reads
+from duplexumiconsensusreads_tpu.ops import ConsensusCaller, run_bucket, spec_for_buckets
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+def _random_case(rng):
+    duplex = bool(rng.integers(0, 2))
+    strategy = ["exact", "adjacency"][rng.integers(0, 2)]
+    cfg = SimConfig(
+        n_molecules=int(rng.integers(10, 80)),
+        read_len=int(rng.integers(20, 90)),
+        umi_len=int(rng.integers(4, 9)),
+        n_positions=int(rng.integers(1, 9)),
+        mean_family_size=int(rng.integers(1, 6)),
+        base_error=float(rng.uniform(0, 0.08)),
+        umi_error=float(rng.uniform(0, 0.04)) if strategy == "adjacency" else 0.0,
+        cycle_error_slope=float(rng.uniform(0, 0.002)),
+        n_frac=float(rng.uniform(0, 0.03)),
+        duplex=duplex,
+        seed=int(rng.integers(0, 1 << 30)),
+    )
+    gp = GroupingParams(strategy=strategy, paired=duplex)
+    cp = ConsensusParams(
+        mode="duplex" if duplex else "single_strand",
+        min_reads=int(rng.integers(1, 3)),
+        min_duplex_reads=int(rng.integers(1, 3)),
+        error_model=[None, "cycle"][rng.integers(0, 2)],
+    )
+    return cfg, gp, cp
+
+
+@pytest.mark.parametrize("trial", range(12))
+def test_pipeline_matches_oracle_random(trial):
+    rng = np.random.default_rng(1000 + trial)
+    cfg, gp, cp = _random_case(rng)
+    batch, _ = simulate_batch(cfg)
+
+    fams = group_reads(batch, gp)
+    oracle = ConsensusCaller(cp, backend="cpu")(batch, fams)
+
+    # the cycle error model is fitted per bucket; comparing against the
+    # whole-batch oracle fit requires a single bucket. error_model=None
+    # cases use a small capacity to also fuzz the bucket splitter.
+    capacity = 8192 if cp.error_model else 256
+    buckets = build_buckets(batch, capacity=capacity, adjacency=gp.strategy == "adjacency")
+    spec = spec_for_buckets(buckets, gp, cp)
+
+    # collect device outputs keyed by (pos_key, umi) of a member read,
+    # then compare against the oracle row of the same family
+    duplex = cp.mode == "duplex"
+    n_checked = 0
+    for bk in buckets:
+        out = {k: np.asarray(v) for k, v in run_bucket(bk, spec).items()}
+        ids = out["molecule_id"] if duplex else out["family_id"]
+        oracle_ids = np.asarray(fams.molecule_id if duplex else fams.family_id)
+        cv = out["cons_valid"]
+        for slot in range(bk.capacity):
+            if not (bk.valid[slot] and bk.read_index[slot] >= 0):
+                continue
+            dev_id = ids[slot]
+            if dev_id < 0:
+                continue
+            src = int(bk.read_index[slot])
+            o_id = int(oracle_ids[src])
+            if o_id < 0:
+                continue
+            dev_valid = bool(cv[dev_id])
+            o_valid = bool(np.asarray(oracle.valid)[o_id])
+            assert dev_valid == o_valid
+            if not dev_valid:
+                continue
+            dev_b = out["cons_base"][dev_id]
+            dev_q = out["cons_qual"][dev_id].astype(int)
+            o_b = np.asarray(oracle.bases)[o_id]
+            o_q = np.asarray(oracle.quals)[o_id].astype(int)
+            # Parity contract: bases identical EXCEPT at evidence ties,
+            # where f32-vs-f64 rounding may break the argmax either way
+            # — both sides then report (near-)zero confidence. Quals
+            # within +-1 of each other except where such a tie flipped
+            # a duplex site between agree/disagree scoring; those sites
+            # are low-confidence on at least one side.
+            b_diff = dev_b != o_b
+            if b_diff.any():
+                assert dev_q[b_diff].max() <= 3 and o_q[b_diff].max() <= 3
+            dq = np.abs(dev_q - o_q)
+            rough = dq > 1
+            if rough.any():
+                # >±1 divergence is allowed only at (a) tie flips —
+                # low confidence on both sides — or (b) deep sites
+                # where the Phred is the log of a tiny f32 residual
+                # (41 vs 47 is the same certainty); the mid-range,
+                # where quality actually informs callers, stays ±1
+                mn = np.minimum(dev_q, o_q)[rough]
+                assert ((mn <= 10) | (mn >= 25)).all()
+                assert rough.sum() <= 4  # isolated sites, not drift
+                assert dq[rough].max() <= 12
+            n_checked += 1
+    # a config can legitimately call nothing (strict min_reads vs tiny
+    # families) — but if the oracle called anything we must have
+    # compared at least one row
+    if int(np.asarray(oracle.valid).sum()) > 0:
+        assert n_checked > 0
+
+
+def test_paired_end_flags_roundtrip(tmp_path):
+    """Paired-end flag encoding must produce the identical ReadBatch —
+    strand from F1R2/F2R1 and pos_key through min(pos, next_pos)."""
+    cfg = SimConfig(n_molecules=60, duplex=True, umi_error=0.02, seed=44)
+    path_se = str(tmp_path / "se.bam")
+    path_pe = str(tmp_path / "pe.bam")
+    simulated_bam(cfg, path=path_se, paired_end=False)
+    simulated_bam(cfg, path=path_pe, paired_end=True)
+
+    _, recs_pe = read_bam(path_pe)
+    assert all(f & 0x1 for f in recs_pe.flags)  # all paired
+    _, recs_se = read_bam(path_se)
+    b_se, _ = records_to_readbatch(recs_se, duplex=True)
+    b_pe, _ = records_to_readbatch(recs_pe, duplex=True)
+    for f in ("bases", "quals", "umi", "pos_key", "strand_ab", "valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(b_se, f)), np.asarray(getattr(b_pe, f)), err_msg=f
+        )
+
+
+def test_paired_end_native_parity(tmp_path):
+    from duplexumiconsensusreads_tpu.io.native_reader import read_bam_native
+    from duplexumiconsensusreads_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    cfg = SimConfig(n_molecules=40, duplex=True, seed=9)
+    path = str(tmp_path / "pe.bam")
+    simulated_bam(cfg, path=path, paired_end=True)
+    _, b_nat, _ = read_bam_native(path, duplex=True)
+    _, recs = read_bam(path)
+    b_py, _ = records_to_readbatch(recs, duplex=True)
+    for f in ("bases", "quals", "umi", "pos_key", "strand_ab", "valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(b_nat, f)), np.asarray(getattr(b_py, f)), err_msg=f
+        )
