@@ -40,7 +40,10 @@ def main() -> None:
     from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
     from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 
-    n_target = int(os.environ.get("DUT_BENCH_READS", 300_000))
+    # ~600k reads/dispatch amortises the tunnel's fixed ~100ms per-call
+    # latency while staying inside HBM (1M+ reads/dispatch OOMs: the
+    # contributions + one-hot intermediates scale with bucket count)
+    n_target = int(os.environ.get("DUT_BENCH_READS", 600_000))
     capacity = int(os.environ.get("DUT_BENCH_CAPACITY", 2048))
     cpu_sample = int(os.environ.get("DUT_BENCH_CPU_SAMPLE", 3000))
 

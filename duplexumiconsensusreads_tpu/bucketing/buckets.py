@@ -28,7 +28,7 @@ import numpy as np
 from duplexumiconsensusreads_tpu.constants import BASE_PAD
 from duplexumiconsensusreads_tpu.ops.grouper import dense_pos_ids
 from duplexumiconsensusreads_tpu.types import ReadBatch
-from duplexumiconsensusreads_tpu.utils.phred import pack_umi
+from duplexumiconsensusreads_tpu.utils.phred import pack_umi_words64
 
 
 @dataclasses.dataclass
@@ -73,7 +73,9 @@ def _fill_bucket(batch: ReadBatch, idx: np.ndarray, r: int) -> Bucket:
     bk.bases[:n] = np.asarray(batch.bases)[idx]
     bk.quals[:n] = np.asarray(batch.quals)[idx]
     bk.read_index[:n] = idx
-    key = np.stack([np.asarray(batch.pos_key)[idx], pack_umi(np.asarray(batch.umi)[idx])], 1)
+    key = np.column_stack(
+        [np.asarray(batch.pos_key)[idx], pack_umi_words64(np.asarray(batch.umi)[idx])]
+    )
     bk.n_unique_umi = len(np.unique(key, axis=0))
     return bk
 
@@ -89,17 +91,21 @@ def build_buckets(
     if len(idx_all) == 0:
         return []
     pos = np.asarray(batch.pos_key)[idx_all]
-    packed = pack_umi(np.asarray(batch.umi)[idx_all])
-    order = np.lexsort((packed, pos))
+    words = pack_umi_words64(np.asarray(batch.umi)[idx_all])  # any UMI length
+    w = words.shape[1]
+    order = np.lexsort((*[words[:, i] for i in range(w - 1, -1, -1)], pos))
     idx_sorted = idx_all[order]
     pos_s = pos[order]
-    packed_s = packed[order]
+    words_s = words[order]
 
     # position-group and family boundaries in sorted order
     n = len(idx_sorted)
     pos_start = np.nonzero(np.r_[True, pos_s[1:] != pos_s[:-1]])[0]
     fam_start = np.nonzero(
-        np.r_[True, (pos_s[1:] != pos_s[:-1]) | (packed_s[1:] != packed_s[:-1])]
+        np.r_[
+            True,
+            (pos_s[1:] != pos_s[:-1]) | (words_s[1:] != words_s[:-1]).any(axis=1),
+        ]
     )[0]
 
     buckets: list[np.ndarray] = []

@@ -16,7 +16,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from duplexumiconsensusreads_tpu.constants import MIN_ERROR_PROB, N_REAL_BASES
+from duplexumiconsensusreads_tpu.constants import N_REAL_BASES
 
 
 @partial(jax.jit, static_argnames=("max_phred_cap",))
@@ -45,8 +45,8 @@ def fit_cycle_cap_kernel(
     # Exact-threshold Phred cap — comparisons, not log10: IEEE f32
     # multiply/compare are bit-identical across NumPy and XLA, f32
     # log10 is not. The table is shared with the oracle so parity can't
-    # drift (see oracle.error_model.phred_cap_from_counts).
-    from duplexumiconsensusreads_tpu.oracle.error_model import phred_cap_thresholds
+    # drift (see utils.phred.phred_cap_from_counts).
+    from duplexumiconsensusreads_tpu.utils.phred import phred_cap_thresholds
 
     thr = jnp.asarray(phred_cap_thresholds(max_phred_cap))
     m = (mism + 1).astype(jnp.float32)

@@ -42,36 +42,53 @@ class UmiGrouper:
     def __call__(self, batch: ReadBatch) -> FamilyAssignment:
         if self.backend == "cpu":
             return _oracle_group(batch, self.params)
+        from duplexumiconsensusreads_tpu.utils.phred import pack_umi_words64
+
         p = self.params
+        valid_arr = np.asarray(batch.valid, bool)
+        # multi-word packing handles any UMI length (int64 pack caps at
+        # 31 codes — real duplex pairs can exceed that); computed once
+        # and shared by the u_max sizing and the presort below
+        words = pack_umi_words64(np.asarray(batch.umi))
+        words[~valid_arr] = 0
         u_max = self.u_max
         if u_max is None and p.strategy == "adjacency":
             # Size the unique-UMI table from the data (cheap host count,
             # rounded to a power of two to bound recompiles) instead of
             # defaulting to n_reads, which would make the all-pairs
             # Hamming/reachability matrices quadratic in batch size.
-            from duplexumiconsensusreads_tpu.utils.phred import pack_umi
-
-            valid = np.asarray(batch.valid, bool)
-            key = np.stack(
-                [
-                    np.asarray(batch.pos_key)[valid],
-                    pack_umi(np.asarray(batch.umi)[valid]),
-                ],
-                axis=1,
+            key = np.column_stack(
+                [np.asarray(batch.pos_key)[valid_arr], words[valid_arr]]
             )
             n_unique = max(len(np.unique(key, axis=0)), 1)
             u_max = 1 << (n_unique - 1).bit_length()
-        fam, mol, n_fam, n_mol, n_over = group_kernel(
-            dense_pos_ids(batch.pos_key),
-            np.asarray(batch.umi),
-            np.asarray(batch.strand_ab),
-            np.asarray(batch.valid),
+        # host presort (cheap NumPy lexsort, invalid reads to the tail)
+        # so the device kernel runs its sort-free presorted path — the
+        # same contract bucketing provides the fused pipeline
+        w = words.shape[1]
+        order = np.lexsort(
+            (
+                *[words[:, i] for i in range(w - 1, -1, -1)],
+                np.asarray(batch.pos_key),
+                ~valid_arr,
+            )
+        )
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        fam_s, mol_s, n_fam, n_mol, n_over = group_kernel(
+            dense_pos_ids(batch.pos_key)[order],
+            np.asarray(batch.umi)[order],
+            np.asarray(batch.strand_ab)[order],
+            valid_arr[order],
             strategy=p.strategy,
             max_hamming=p.max_hamming,
             count_ratio=p.count_ratio,
             paired=p.paired,
             u_max=u_max,
+            presorted=True,
         )
+        fam = np.asarray(fam_s)[inv]
+        mol = np.asarray(mol_s)[inv]
         if int(n_over):
             import warnings
 

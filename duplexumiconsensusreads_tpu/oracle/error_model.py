@@ -14,34 +14,7 @@ import numpy as np
 
 from duplexumiconsensusreads_tpu.constants import N_REAL_BASES, NO_FAMILY
 from duplexumiconsensusreads_tpu.types import ConsensusBatch, FamilyAssignment, ReadBatch
-
-
-def phred_cap_thresholds(max_phred_cap: int) -> np.ndarray:
-    """f32 error-rate thresholds 10^(-q/10) for q = 0..max — the ONE
-    table both the oracle and the device kernel compare against; any
-    change here changes both sides together."""
-    return (10.0 ** (-np.arange(max_phred_cap + 1) / 10.0)).astype(np.float32)
-
-
-def phred_cap_from_counts(
-    mism: np.ndarray, total: np.ndarray, max_phred_cap: int
-) -> np.ndarray:
-    """floor(-10*log10((mism+1)/(total+2))) clipped to [2, max], computed
-    EXACTLY via f32 threshold comparisons.
-
-    cap = #{q in [0..max] : rate <= 10^(-q/10)} - 1. Both sides of each
-    comparison are f32 ((m+1) vs (t+2)*thr[q]); IEEE f32 multiply and
-    compare give bit-identical answers on NumPy and XLA/TPU, so the
-    device kernel (kernels/error_model.py) reproduces this function
-    bit-for-bit — a log10 in f32-on-device vs f64-on-host would flip
-    caps at floor boundaries and cascade into second-pass consensus
-    differences.
-    """
-    thr = phred_cap_thresholds(max_phred_cap)
-    m = (np.asarray(mism) + 1).astype(np.float32)
-    t = (np.asarray(total) + 2).astype(np.float32)
-    count = (m[:, None] <= t[:, None] * thr[None, :]).sum(axis=1)
-    return np.clip(count - 1, 2, max_phred_cap).astype(np.uint8)
+from duplexumiconsensusreads_tpu.utils.phred import phred_cap_from_counts
 
 
 def fit_cycle_error_model(

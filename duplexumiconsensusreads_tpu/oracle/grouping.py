@@ -22,7 +22,7 @@ import numpy as np
 
 from duplexumiconsensusreads_tpu.constants import NO_FAMILY
 from duplexumiconsensusreads_tpu.types import FamilyAssignment, GroupingParams, ReadBatch
-from duplexumiconsensusreads_tpu.utils.phred import pack_umi
+from duplexumiconsensusreads_tpu.utils.phred import pack_umi_words64
 
 
 def _directional_clusters(
@@ -34,8 +34,11 @@ def _directional_clusters(
     cluster seed (the highest-count UMI of its cluster).
     """
     n = len(umis)
-    packed = pack_umi(umis)
-    order = np.lexsort((packed, -counts))  # rank 0 = highest count, ties by packed
+    words = pack_umi_words64(umis)  # any UMI length
+    # rank 0 = highest count, ties by UMI lexicographic order
+    order = np.lexsort(
+        (*[words[:, i] for i in range(words.shape[1] - 1, -1, -1)], -counts)
+    )
     # adjacency: ham[u, v] and counts[u] >= ratio*counts[v] - 1 (directed u->v)
     ham = (umis[:, None, :] != umis[None, :, :]).sum(axis=2)
     edge = (ham <= max_hamming) & (
@@ -72,11 +75,13 @@ def group_reads(batch: ReadBatch, params: GroupingParams) -> FamilyAssignment:
     umi = np.asarray(batch.umi, np.uint8)
     strand = np.asarray(batch.strand_ab, bool)
 
-    # Resolved per-read cluster UMI (packed) after exact/adjacency grouping.
-    cluster_umi = np.full(n, -1, np.int64)
+    # Resolved per-read cluster UMI (packed words — any UMI length)
+    # after exact/adjacency grouping.
+    n_words = pack_umi_words64(umi[:1]).shape[1] if n else 1
+    cluster_umi = np.full((n, n_words), -1, np.int64)
     idx_valid = np.nonzero(valid)[0]
     if params.strategy == "exact":
-        cluster_umi[idx_valid] = pack_umi(umi[idx_valid])
+        cluster_umi[idx_valid] = pack_umi_words64(umi[idx_valid])
     elif params.strategy == "adjacency":
         for p in np.unique(pos[idx_valid]):
             sel = idx_valid[pos[idx_valid] == p]
@@ -86,12 +91,12 @@ def group_reads(batch: ReadBatch, params: GroupingParams) -> FamilyAssignment:
             seed_of = _directional_clusters(
                 uu, cnt, params.max_hamming, params.count_ratio
             )
-            cluster_umi[sel] = pack_umi(uu)[seed_of][inv]
+            cluster_umi[sel] = pack_umi_words64(uu)[seed_of][inv]
     else:
         raise ValueError(f"unknown grouping strategy {params.strategy!r}")
 
     # Dense molecule ids over (pos_key, cluster_umi), sorted.
-    mol_key = np.stack([pos, cluster_umi], axis=1)
+    mol_key = np.column_stack([pos, cluster_umi])
     molecule_id = np.full(n, NO_FAMILY, np.int32)
     fam_id = np.full(n, NO_FAMILY, np.int32)
     if len(idx_valid):

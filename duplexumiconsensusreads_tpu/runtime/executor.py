@@ -23,7 +23,7 @@ from duplexumiconsensusreads_tpu.types import (
     GroupingParams,
     ReadBatch,
 )
-from duplexumiconsensusreads_tpu.utils.phred import pack_umi
+from duplexumiconsensusreads_tpu.utils.phred import pack_umi_words64, umi_sort_keys
 
 
 @dataclasses.dataclass
@@ -72,9 +72,9 @@ def representative_per_family(
     if not len(idx):
         return fam_pos, fam_umi
     f = fam_id[idx]
-    packed = pack_umi(umi[idx])
+    words = pack_umi_words64(umi[idx])
     # count (family, umi) pairs
-    key = np.stack([f.astype(np.int64), packed], axis=1)
+    key = np.column_stack([f.astype(np.int64), words])
     uniq, inv, cnt = np.unique(key, axis=0, return_inverse=True, return_counts=True)
     # first read index carrying each unique (family, umi) pair
     first_read = np.full(len(uniq), -1, np.int64)
@@ -83,8 +83,11 @@ def representative_per_family(
     pair_sorted = inv[order_reads]
     pair_first = np.nonzero(np.r_[True, pair_sorted[1:] != pair_sorted[:-1]])[0]
     first_read[pair_sorted[pair_first]] = order_reads[pair_first]
-    # order unique pairs by (family, -count, packed); first per family wins
-    order = np.lexsort((uniq[:, 1], -cnt, uniq[:, 0]))
+    # order unique pairs by (family, -count, umi words); first per family wins
+    w = uniq.shape[1] - 1
+    order = np.lexsort(
+        (*[uniq[:, 1 + i] for i in range(w - 1, -1, -1)], -cnt, uniq[:, 0])
+    )
     fam_sorted = uniq[order, 0]
     first = np.nonzero(np.r_[True, fam_sorted[1:] != fam_sorted[:-1]])[0]
     win_rows = order[first]  # one row index into uniq per family present
@@ -226,7 +229,7 @@ def call_batch_tpu(
     # (pos_key, UMI) order so the output BAM stays coordinate-sorted
     # (its own streaming executor — and most downstream tools — expect
     # non-decreasing positions)
-    order = np.lexsort((pack_umi(fu), fp))
+    order = np.lexsort((*reversed(umi_sort_keys(fu)), fp))
     return (
         cb[order],
         cq[order],

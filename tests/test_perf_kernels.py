@@ -45,6 +45,32 @@ def test_presorted_matches_sorting_path(strategy, paired):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_long_umi_32_codes():
+    """Duplex UMI pairs can exceed the 31-code int64 pack limit (e.g.
+    2x16 bases) — grouping, bucketing, pipeline and scatter-back must
+    all handle multi-word UMI keys (regression: host paths once crashed
+    or would have mis-sorted)."""
+    from duplexumiconsensusreads_tpu.ops import UmiGrouper
+    from duplexumiconsensusreads_tpu.runtime.executor import call_batch_tpu
+
+    cfg = SimConfig(n_molecules=40, umi_len=16, duplex=True, umi_error=0.01, seed=3)
+    batch, _ = simulate_batch(cfg)
+    assert batch.umi_len == 32
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    f_cpu = UmiGrouper(gp, backend="cpu")(batch)
+    f_tpu = UmiGrouper(gp, backend="tpu")(batch)
+    np.testing.assert_array_equal(
+        np.asarray(f_cpu.family_id), np.asarray(f_tpu.family_id)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(f_cpu.molecule_id), np.asarray(f_tpu.molecule_id)
+    )
+    cp = ConsensusParams(mode="duplex")
+    cb, cq, cd, cv, fp, fu = call_batch_tpu(batch, gp, cp, capacity=256)
+    assert cv.sum() > 0
+    assert fu.shape[1] == 32
+
+
 def test_spec_for_buckets_bounds():
     cfg = SimConfig(n_molecules=200, duplex=True, umi_error=0.02, seed=8)
     buckets = _bucket_inputs(cfg)
