@@ -35,10 +35,34 @@ _NIBBLE_TO_CODE[8] = 3  # T
 _CODE_TO_NIBBLE = np.array([1, 2, 4, 8, 15, 15], np.uint8)  # A C G T N PAD→N
 
 FLAG_PAIRED = 0x1
+FLAG_UNMAPPED = 0x4
 FLAG_REVERSE = 0x10
 FLAG_MATE_REVERSE = 0x20
 FLAG_READ1 = 0x40
 FLAG_READ2 = 0x80
+FLAG_SECONDARY = 0x100
+FLAG_QCFAIL = 0x200
+FLAG_DUP = 0x400
+FLAG_SUPPLEMENTARY = 0x800
+
+# Records carrying any of these flags never enter UMI families:
+# unmapped reads have no coordinate; secondary/supplementary alignments
+# re-observe a primary record (counting them inflates family depth and
+# shifts consensus); QC-fail reads are untrusted. This mirrors the
+# conventional fgbio-style input filter. PCR/optical duplicates (0x400)
+# are deliberately NOT excluded — duplicate collapse is this tool's job.
+FLAG_CONSENSUS_EXCLUDE = FLAG_UNMAPPED | FLAG_SECONDARY | FLAG_QCFAIL | FLAG_SUPPLEMENTARY
+
+
+def consensus_excluded(flags, ref_id):
+    """Exclusion mask shared by BOTH codecs (io/convert.py and
+    io/native_reader.py must stay bit-identical — the streaming
+    chunker's sentinel flush assumes no excluded record can ever form a
+    family). ref_id < 0 is excluded unconditionally, not just via
+    FLAG_UNMAPPED: such records map to the UNMAPPED_POS_KEY sentinel."""
+    return ((np.asarray(flags).astype(np.int64) & FLAG_CONSENSUS_EXCLUDE) != 0) | (
+        np.asarray(ref_id) < 0
+    )
 
 
 @dataclasses.dataclass

@@ -32,6 +32,7 @@ from duplexumiconsensusreads_tpu.io.bam import (
     FLAG_REVERSE,
     BamHeader,
     BamRecords,
+    consensus_excluded,
     make_aux_i,
     make_aux_z,
 )
@@ -45,10 +46,28 @@ _CHAR_TO_CODE = {c: i for i, c in enumerate("ACGT")}
 _CODE_TO_CHAR = "ACGTN."
 
 
+# Sentinel key for unmapped records (ref_id < 0). samtools places
+# unmapped reads at EOF of a coordinate-sorted BAM, so their key must
+# sort AFTER every mapped key; sign-extending -1 through the shift/OR
+# would instead give pos_key=-1 (sorts first) and trip the streaming
+# sort-contract check on perfectly standard input.
+UNMAPPED_POS_KEY = np.int64(1) << 62
+_REF_ID_MAX = 1 << (62 - _POS_BITS)  # mapped keys must stay below the sentinel
+
+
 def pack_pos_key(ref_id: np.ndarray, coord: np.ndarray) -> np.ndarray:
-    return (np.asarray(ref_id, np.int64) << _POS_BITS) | (
-        np.asarray(coord, np.int64) & _POS_MASK
-    )
+    ref_id = np.asarray(ref_id, np.int64)
+    if (ref_id >= _REF_ID_MAX).any():
+        # a mapped key must never alias UNMAPPED_POS_KEY (the streaming
+        # chunker flushes sentinel keys without family hold-back) or
+        # overflow i64; refuse rather than silently corrupt grouping
+        raise ValueError(
+            f"ref_id >= {_REF_ID_MAX} cannot be packed into a pos_key "
+            f"({_POS_BITS} position bits); re-shard the reference"
+        )
+    coord = np.maximum(np.asarray(coord, np.int64), 0)
+    key = (np.maximum(ref_id, 0) << _POS_BITS) | (coord & _POS_MASK)
+    return np.where(ref_id < 0, UNMAPPED_POS_KEY, key)
 
 
 def unpack_pos_key(key: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -112,21 +131,28 @@ def records_to_readbatch(
     """
     n = len(recs)
     l = recs.seq.shape[1] if n else 0
+    flags = np.asarray(recs.flags)
+    excluded = consensus_excluded(flags, recs.ref_id)
+    n_flag_excluded = int(excluded.sum())
 
     umi_len = 0
     umi_codes: list[np.ndarray | None] = []
-    for rx in recs.umi:
-        codes = umi_string_to_codes(rx) if rx else None
+    for i, rx in enumerate(recs.umi):
+        # excluded reads skip UMI parsing entirely — their codes are
+        # never consumed, and a large unmapped/secondary tail would
+        # otherwise burn per-char Python time for nothing
+        codes = umi_string_to_codes(rx) if (rx and not excluded[i]) else None
         umi_codes.append(codes)
         if codes is not None and len(codes) > umi_len:
             umi_len = len(codes)
 
     batch = ReadBatch.empty(n, l, umi_len)
     n_no_umi = n_bad_len = 0
-    flags = np.asarray(recs.flags)
     pos_key = records_pos_keys(recs)
 
     for i in range(n):
+        if excluded[i]:
+            continue
         codes = umi_codes[i]
         if codes is None:
             n_no_umi += 1
@@ -150,6 +176,7 @@ def records_to_readbatch(
         "n_valid": int(batch.valid.sum()),
         "n_dropped_no_umi": n_no_umi,
         "n_dropped_umi_len": n_bad_len,
+        "n_dropped_flag": n_flag_excluded,
         "umi_len": umi_len,
     }
     return batch, info

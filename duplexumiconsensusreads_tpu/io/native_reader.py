@@ -25,6 +25,7 @@ from duplexumiconsensusreads_tpu.io.bam import (
     FLAG_READ1,
     FLAG_REVERSE,
     BamHeader,
+    consensus_excluded,
 )
 from duplexumiconsensusreads_tpu.io.convert import pack_pos_key
 from duplexumiconsensusreads_tpu.types import ReadBatch
@@ -98,6 +99,10 @@ def read_bam_native(
         data[: header_end.value].tobytes(), header_end.value
     )
 
+    # Allocation width stays >=1 so the ctypes buffers have real
+    # storage; seq/qual are sliced back to the true l_max below so a
+    # record-less / sequence-less file matches the Python codec's
+    # zero-width batch exactly.
     n, l, rx_cap = int(n_rec), max(int(l_max.value), 1), max(int(rx_max.value), 1)
     flags = np.empty(n, np.uint16)
     ref_id = np.empty(n, np.int32)
@@ -115,13 +120,21 @@ def read_bam_native(
     if rc != 0:
         raise ValueError(f"{path}: BAM record fill failed")
 
+    if int(l_max.value) < l:
+        seq = seq[:, : int(l_max.value)]
+        qual = qual[:, : int(l_max.value)]
+
     # --- vectorised ReadBatch assembly (contract: io/convert.py) ---
-    # Mirror the Python codec's semantics exactly: a read is
-    # "parseable" iff it has a non-empty RX whose non-separator chars
-    # are all ACGT (case-insensitive); umi_len is the max over
-    # PARSEABLE reads only (an unparseable long RX must not inflate
-    # it); parseable reads of a different length are dropped as
-    # length-inconsistent.
+    # Mirror the Python codec's semantics exactly: flag-excluded reads
+    # (unmapped/secondary/supplementary/qcfail) are invalid and touch
+    # nothing else; a read is "parseable" iff it has a non-empty RX
+    # whose non-separator chars are all ACGT (case-insensitive);
+    # umi_len is the max over PARSEABLE NON-EXCLUDED reads only (an
+    # unparseable long RX must not inflate it); parseable reads of a
+    # different length are dropped as length-inconsistent. An RX of
+    # only separators gives n_umi_chars == 0 — such reads are valid
+    # exactly when umi_len == 0, as in the Python codec.
+    excluded = consensus_excluded(flags, ref_id)
     codes_all = _CHAR_CODE[rx]
     has_char = rx != 0
     is_umi_char = (rx != _SEP) & has_char
@@ -129,8 +142,9 @@ def read_bam_native(
     has_rx = has_char.any(axis=1)
     bad_char = ((codes_all == 255) & is_umi_char).any(axis=1)
     parseable = has_rx & ~bad_char
-    umi_len = int(n_umi_chars[parseable].max()) if parseable.any() else 0
-    valid = parseable & (n_umi_chars == umi_len) & (umi_len > 0)
+    counted = parseable & ~excluded
+    umi_len = int(n_umi_chars[counted].max()) if counted.any() else 0
+    valid = counted & (n_umi_chars == umi_len)
 
     umi_codes = np.zeros((n, umi_len), np.uint8)
     if umi_len:
@@ -172,8 +186,9 @@ def read_bam_native(
     info = {
         "n_records": n,
         "n_valid": int(valid.sum()),
-        "n_dropped_no_umi": int((~parseable).sum()),
-        "n_dropped_umi_len": int((parseable & ~valid).sum()),
+        "n_dropped_no_umi": int((~parseable & ~excluded).sum()),
+        "n_dropped_umi_len": int((counted & ~valid).sum()),
+        "n_dropped_flag": int(excluded.sum()),
         "umi_len": umi_len,
         "native": True,
     }

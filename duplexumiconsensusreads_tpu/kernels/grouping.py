@@ -69,11 +69,11 @@ def _directional_cluster(
 ) -> jnp.ndarray:
     """Seed index per unique-UMI slot (directional clustering)."""
     u, b = u_codes.shape
-    # bf16 single-pass MXU is exact here: one-hot entries are 0/1 and
-    # match counts are integers <= b < 256 (bf16 represents ints < 257
-    # exactly, and partial sums of 0/1 terms stay integral)
-    if 4 * b >= 256:
-        raise ValueError(f"UMI length {b} too large for bf16 Hamming matmul")
+    # bf16 inputs + f32 accumulation is exact for any UMI length: the
+    # one-hot entries 0/1 are exactly representable in bf16, each
+    # product is 0 or 1, and preferred_element_type=float32 makes the
+    # MXU accumulate in f32, which sums integers exactly up to 2^24
+    # terms — far beyond any UMI length.
     onehot = (u_codes[:, :, None] == jnp.arange(4, dtype=jnp.int32)).astype(
         jnp.bfloat16
     )

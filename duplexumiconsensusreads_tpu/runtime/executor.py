@@ -322,7 +322,11 @@ def call_consensus_file(
             header, recs = read_bam(in_path)
             batch, info = records_to_readbatch(recs, duplex=duplex)
         rep.n_records = info["n_records"]
-        rep.n_dropped = info["n_dropped_no_umi"] + info["n_dropped_umi_len"]
+        rep.n_dropped = (
+            info["n_dropped_no_umi"]
+            + info["n_dropped_umi_len"]
+            + info.get("n_dropped_flag", 0)
+        )
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
     rep.seconds["read_input"] = round(time.time() - t0, 4)
 

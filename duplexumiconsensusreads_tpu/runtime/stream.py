@@ -36,6 +36,7 @@ import numpy as np
 from duplexumiconsensusreads_tpu.io import bgzf
 from duplexumiconsensusreads_tpu.io.bam import BamHeader, BamRecords, parse_bam
 from duplexumiconsensusreads_tpu.io.convert import (
+    UNMAPPED_POS_KEY,
     consensus_to_records,
     records_to_readbatch,
 )
@@ -227,6 +228,21 @@ def iter_record_chunks(path: str, chunk_reads: int):
                     "input violates the streaming sort contract across a "
                     "chunk boundary (pos_key repeats after being flushed)"
                 )
+            # Unmapped EOF tail: sentinel-key records are never groupable
+            # (the FLAG filter invalidates them downstream), so family
+            # integrity doesn't apply — flush the chunk immediately.
+            # Carrying them would be unbounded: the whole tail shares ONE
+            # pos_key, so the hold-back logic below would accumulate it
+            # in `carry` with quadratic re-concatenation.
+            if batch_pos[-1] == UNMAPPED_POS_KEY:
+                carry = None
+                # later all-sentinel chunks must pass the repeat check,
+                # but any MAPPED key after the tail is a sort violation
+                # and must trip it (mapped-after-unmapped would split a
+                # family with no hold-back)
+                prev_last = UNMAPPED_POS_KEY - 1
+                yield header, recs
+                continue
             # hold back the final pos group (pos of the last record)
             last = batch_pos[-1]
             keep = np.nonzero(batch_pos != last)[0]
@@ -300,22 +316,36 @@ class Checkpoint:
 
     @staticmethod
     def load_or_create(path: str, fingerprint: str) -> "Checkpoint":
+        """Load the manifest, pruning entries that no longer apply.
+
+        Whatever this returns is immediately persisted if it differs
+        from the on-disk state: a diverging manifest (mismatched
+        fingerprint, dead shard paths) must not survive on disk, where
+        a crash-before-first-mark would let a later --resume splice
+        stale shard bytes from a different run into the output."""
+        done: dict = {}
+        on_disk = None
         if os.path.exists(path):
             with open(path) as f:
-                d = json.load(f)
-            if d.get("fingerprint") == fingerprint:
+                on_disk = json.load(f)
+            if on_disk.get("fingerprint") == fingerprint:
                 done = {
-                    k: v for k, v in d.get("done", {}).items() if os.path.exists(v)
+                    k: v for k, v in on_disk.get("done", {}).items() if os.path.exists(v)
                 }
-                return Checkpoint(path, fingerprint, done)
-        return Checkpoint(path, fingerprint, {})
+        ckpt = Checkpoint(path, fingerprint, done)
+        if on_disk is not None and on_disk != {"fingerprint": fingerprint, "done": done}:
+            ckpt.save()
+        return ckpt
 
-    def mark(self, chunk: int, shard_path: str) -> None:
-        self.done[str(chunk)] = shard_path
+    def save(self) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"fingerprint": self.fingerprint, "done": self.done}, f)
         os.replace(tmp, self.path)
+
+    def mark(self, chunk: int, shard_path: str) -> None:
+        self.done[str(chunk)] = shard_path
+        self.save()
 
 
 def _fingerprint(in_path: str, grouping, consensus, capacity, chunk_reads) -> str:
@@ -380,7 +410,14 @@ def stream_call_consensus(
         fp = _fingerprint(in_path, grouping, consensus, capacity, chunk_reads)
         ckpt = Checkpoint.load_or_create(checkpoint_path, fp)
         if not resume:
+            # persist a fresh manifest NOW, unconditionally: a stale
+            # on-disk manifest (same OR different fingerprint) must not
+            # survive a crash-before-first-mark — this run is about to
+            # overwrite the shard files it points at, so a later
+            # --resume against the old manifest would serve shards
+            # whose content no longer matches its params
             ckpt.done = {}
+            ckpt.save()
 
     n_dev = n_devices or len(jax.devices())
     mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
@@ -429,15 +466,23 @@ def stream_call_consensus(
     try:
         for k, (header, recs) in enumerate(iter_record_chunks(in_path, chunk_reads)):
             header_out = header_out or header
-            rep.n_records += len(recs)
             rep.n_chunks += 1
             if ckpt and str(k) in ckpt.done:
                 shards[k] = ckpt.done[str(k)]
                 n_skipped += 1
                 continue
+            # per-read counters cover FRESH work only, so a resumed
+            # run's report is internally consistent (n_records matches
+            # n_valid_reads + drops); skipped chunks show up in
+            # n_chunks_skipped and the final n_consensus instead
+            rep.n_records += len(recs)
             batch, info = records_to_readbatch(recs, duplex=duplex)
             rep.n_valid_reads += info["n_valid"]
-            rep.n_dropped += info["n_dropped_no_umi"] + info["n_dropped_umi_len"]
+            rep.n_dropped += (
+                info["n_dropped_no_umi"]
+                + info["n_dropped_umi_len"]
+                + info.get("n_dropped_flag", 0)
+            )
             buckets = build_buckets(
                 batch, capacity=capacity, adjacency=grouping.strategy == "adjacency"
             )

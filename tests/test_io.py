@@ -116,6 +116,66 @@ class TestBamRoundtrip:
         assert batch.valid[2:].all()
 
 
+class TestFlagFiltering:
+    def test_excluded_flags_marked_invalid(self):
+        from duplexumiconsensusreads_tpu.io.bam import (
+            FLAG_DUP,
+            FLAG_SECONDARY,
+            FLAG_SUPPLEMENTARY,
+            FLAG_UNMAPPED,
+        )
+
+        header, recs, *_ = simulated_bam(SimConfig(n_molecules=8, seed=4))
+        recs.flags[0] |= FLAG_SECONDARY
+        recs.flags[1] |= FLAG_SUPPLEMENTARY
+        recs.flags[2] |= FLAG_UNMAPPED
+        recs.flags[3] |= FLAG_DUP  # duplicates stay IN — collapsing them is the job
+        batch, info = records_to_readbatch(recs, duplex=True)
+        assert not batch.valid[:3].any()
+        assert batch.valid[3]
+        assert info["n_dropped_flag"] == 3
+        assert info["n_valid"] == len(recs) - 3
+
+    def test_excluded_read_does_not_inflate_umi_len(self):
+        from duplexumiconsensusreads_tpu.io.bam import FLAG_SECONDARY
+
+        header, recs, *_ = simulated_bam(SimConfig(n_molecules=5, seed=6))
+        recs.umi[0] = "ACGTACGTACGT-ACGTACGTACGT"  # longer RX, but excluded
+        recs.flags[0] |= FLAG_SECONDARY
+        batch, info = records_to_readbatch(recs, duplex=True)
+        assert info["umi_len"] == 12  # 2 * umi_len=6 from the valid reads
+        assert info["n_dropped_umi_len"] == 0
+
+    def test_negative_ref_id_excluded_even_without_flag(self):
+        """ref_id<0 maps to the sentinel pos_key; such records must be
+        excluded unconditionally (the streaming chunker's sentinel flush
+        assumes they can never form a family), flag or no flag."""
+        header, recs, *_ = simulated_bam(SimConfig(n_molecules=5, seed=8))
+        recs.ref_id[0] = -1  # flags untouched — still excluded
+        batch, info = records_to_readbatch(recs, duplex=True)
+        assert not batch.valid[0]
+        assert info["n_dropped_flag"] == 1
+
+    def test_unmapped_pos_key_sorts_last(self):
+        from duplexumiconsensusreads_tpu.io.convert import UNMAPPED_POS_KEY
+
+        key = pack_pos_key(np.array([-1]), np.array([-1]))
+        assert key[0] == UNMAPPED_POS_KEY
+        big = pack_pos_key(np.array([1000]), np.array([(1 << 31) - 1]))
+        assert key[0] > big[0]
+
+    def test_pos_key_rejects_ref_id_aliasing_sentinel(self):
+        """ref_id >= 2^26 would alias UNMAPPED_POS_KEY (or overflow);
+        pack must refuse rather than silently corrupt grouping."""
+        with pytest.raises(ValueError, match="ref_id"):
+            pack_pos_key(np.array([1 << 26]), np.array([0]))
+        # largest legal ref_id still packs below the sentinel
+        from duplexumiconsensusreads_tpu.io.convert import UNMAPPED_POS_KEY
+
+        ok = pack_pos_key(np.array([(1 << 26) - 1]), np.array([(1 << 36) - 1]))
+        assert ok[0] < UNMAPPED_POS_KEY
+
+
 class TestStrandAndKeys:
     @pytest.mark.parametrize(
         "flag,expect_top",

@@ -83,6 +83,24 @@ def test_grouping_parity(name, cfg, gp):
     np.testing.assert_array_equal(mol, np.asarray(oracle.molecule_id))
 
 
+def test_grouping_long_umi():
+    """UMI pair of 64+ codes must cluster, not raise (regression: the
+    bf16 Hamming path once guarded 4*b < 256; with f32 accumulation the
+    matmul is exact for any b, so the guard was removed)."""
+    cfg = SimConfig(
+        n_molecules=12, duplex=True, umi_len=33, umi_error=0.02,
+        mean_family_size=4, seed=15,
+    )
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    oracle = group_reads(batch, gp)
+    fam, mol, n_fam, n_mol, n_over = _run_group_kernel(batch, gp)
+    assert n_over == 0
+    assert n_fam == int(oracle.n_families)
+    np.testing.assert_array_equal(fam, np.asarray(oracle.family_id))
+    np.testing.assert_array_equal(mol, np.asarray(oracle.molecule_id))
+
+
 def test_grouping_overflow_flagged():
     cfg = SimConfig(n_molecules=40, duplex=False, seed=14)
     batch, _ = simulate_batch(cfg)

@@ -89,6 +89,66 @@ def test_unparseable_long_rx_does_not_inflate_umi_len(tmp_path):
     _assert_batches_equal(b_nat, b_py)
 
 
+def test_native_flag_filter_parity(tmp_path):
+    """Flag-excluded reads (secondary/supplementary/unmapped) are
+    invalid in BOTH paths, with matching drop counts."""
+    from duplexumiconsensusreads_tpu.io import BamHeader, write_bam
+    from duplexumiconsensusreads_tpu.io.bam import (
+        FLAG_SECONDARY,
+        FLAG_SUPPLEMENTARY,
+        FLAG_UNMAPPED,
+    )
+
+    path = str(tmp_path / "fl.bam")
+    _, recs, *_ = simulated_bam(SimConfig(n_molecules=10, seed=19))
+    recs.flags[0] |= FLAG_SECONDARY
+    recs.flags[1] |= FLAG_SUPPLEMENTARY
+    recs.flags[2] |= FLAG_UNMAPPED
+    recs.ref_id[2] = -1
+    recs.pos[2] = -1
+    write_bam(path, BamHeader.synthetic(), recs)
+
+    _, b_nat, info = read_bam_native(path, duplex=True)
+    _, recs2 = read_bam(path)
+    b_py, info_py = records_to_readbatch(recs2, duplex=True)
+    assert info["n_dropped_flag"] == info_py["n_dropped_flag"] == 3
+    assert not b_nat.valid[:3].any()
+    _assert_batches_equal(b_nat, b_py)
+
+
+def test_native_degenerate_rx_parity(tmp_path):
+    """An RX of only separators ('-') is parseable with zero UMI chars:
+    valid iff umi_len == 0 — identical in both codecs."""
+    from duplexumiconsensusreads_tpu.io import BamHeader, write_bam
+    from duplexumiconsensusreads_tpu.io.bam import make_aux_z
+
+    # case 1: mixed — the '-' read is length-inconsistent, dropped
+    path = str(tmp_path / "deg1.bam")
+    _, recs, *_ = simulated_bam(SimConfig(n_molecules=6, seed=29))
+    recs.umi[0] = "-"
+    recs.aux_raw[0] = make_aux_z("RX", "-")
+    write_bam(path, BamHeader.synthetic(), recs)
+    _, b_nat, info = read_bam_native(path, duplex=True)
+    _, recs2 = read_bam(path)
+    b_py, info_py = records_to_readbatch(recs2, duplex=True)
+    assert info["n_valid"] == info_py["n_valid"] == len(recs) - 1
+    _assert_batches_equal(b_nat, b_py)
+
+    # case 2: ALL reads have '-' RX -> umi_len == 0, everyone valid
+    path2 = str(tmp_path / "deg2.bam")
+    _, recs3, *_ = simulated_bam(SimConfig(n_molecules=4, seed=31))
+    for i in range(len(recs3)):
+        recs3.umi[i] = "-"
+        recs3.aux_raw[i] = make_aux_z("RX", "-")
+    write_bam(path2, BamHeader.synthetic(), recs3)
+    _, b_nat2, info2 = read_bam_native(path2, duplex=True)
+    _, recs4 = read_bam(path2)
+    b_py2, info_py2 = records_to_readbatch(recs4, duplex=True)
+    assert info2["umi_len"] == info_py2["umi_len"] == 0
+    assert info2["n_valid"] == info_py2["n_valid"] == len(recs3)
+    _assert_batches_equal(b_nat2, b_py2)
+
+
 def test_native_uncompressed_and_aux_types(tmp_path):
     """Records with diverse aux tag types parse identically."""
     import struct

@@ -128,6 +128,138 @@ class TestStreamedCall:
         assert rep.n_buckets > 0  # did not skip
 
 
+def test_unmapped_reads_at_eof_stream_cleanly(tmp_path):
+    """A standard coordinate-sorted BAM carries its unmapped reads at
+    EOF (ref_id=-1, pos=-1). Their pos_key must sort LAST (sentinel),
+    not sign-extend to -1 and trip the sort-contract check; conversion
+    must drop them via the FLAG filter."""
+    from duplexumiconsensusreads_tpu.io import write_bam
+    from duplexumiconsensusreads_tpu.io.bam import FLAG_UNMAPPED
+    from duplexumiconsensusreads_tpu.io.convert import records_to_readbatch
+    from duplexumiconsensusreads_tpu.runtime.stream import _concat_records, _slice_records
+
+    path = str(tmp_path / "mapped.bam")
+    cfg = SimConfig(n_molecules=40, n_positions=6, seed=7)
+    header, recs, *_ = simulated_bam(cfg, path=path, sort=True)
+
+    import copy as _copy
+
+    # tail LARGER than chunk_reads: the flush branch must fire on
+    # multiple consecutive all-sentinel chunks without tripping the
+    # cross-boundary repeat check or accumulating carry
+    tail = _copy.deepcopy(_slice_records(recs, 0, 150))  # slices are views
+    tail.flags[:] = FLAG_UNMAPPED
+    tail.ref_id[:] = -1
+    tail.pos[:] = -1
+    tail.next_ref_id[:] = -1
+    tail.next_pos[:] = -1
+    full = _concat_records(recs, tail)
+    path2 = str(tmp_path / "with_unmapped.bam")
+    write_bam(path2, header, full)
+
+    seen = 0
+    n_flag_dropped = 0
+    for _, chunk in iter_record_chunks(path2, chunk_reads=60):
+        assert len(chunk) <= 60 + 150  # no unbounded carry growth
+        _, info = records_to_readbatch(chunk, duplex=True)
+        n_flag_dropped += info["n_dropped_flag"]
+        seen += len(chunk)
+    assert seen == len(recs) + 150
+    assert n_flag_dropped == 150
+
+
+def test_mapped_after_unmapped_tail_rejected(tmp_path):
+    """Mapped records AFTER the unmapped tail violate the sort contract
+    and must raise (the flush path must not let them slip past the
+    cross-boundary repeat check and split a family)."""
+    import copy as _copy
+
+    from duplexumiconsensusreads_tpu.io import write_bam
+    from duplexumiconsensusreads_tpu.io.bam import FLAG_UNMAPPED
+    from duplexumiconsensusreads_tpu.runtime.stream import _concat_records, _slice_records
+
+    path = str(tmp_path / "m.bam")
+    cfg = SimConfig(n_molecules=30, n_positions=5, seed=9)
+    header, recs, *_ = simulated_bam(cfg, path=path, sort=True)
+    mid = _copy.deepcopy(_slice_records(recs, 0, 40))
+    mid.flags[:] = FLAG_UNMAPPED
+    mid.ref_id[:] = -1
+    mid.pos[:] = -1
+    mid.next_ref_id[:] = -1
+    mid.next_pos[:] = -1
+    bad = _concat_records(
+        _concat_records(_slice_records(recs, 0, len(recs) // 2), mid),
+        _slice_records(recs, len(recs) // 2, len(recs)),
+    )
+    path2 = str(tmp_path / "bad_order.bam")
+    write_bam(path2, header, bad)
+    with pytest.raises(ValueError, match="sort contract"):
+        list(iter_record_chunks(path2, chunk_reads=30))
+
+
+def test_resume_report_counts_fresh_work_only(tmp_path):
+    path, _, _ = _sorted_bam(tmp_path, n_mol=60)
+    out = str(tmp_path / "r.bam")
+    ck = str(tmp_path / "ckr.json")
+    gp = GroupingParams(strategy="exact", paired=True)
+    cp = ConsensusParams(mode="duplex")
+    kw = dict(capacity=256, chunk_reads=120, checkpoint_path=ck)
+    rep1 = stream_call_consensus(path, out, gp, cp, resume=False, **kw)
+    rep2 = stream_call_consensus(path, out, gp, cp, resume=True, **kw)
+    # fully-resumed run did no fresh work: per-read counters are zero,
+    # chunk accounting still covers the file
+    assert rep2.n_records == 0
+    assert rep2.n_valid_reads == 0
+    assert rep2.n_chunks == rep1.n_chunks
+    assert rep2.n_chunks_skipped == rep1.n_chunks
+    assert rep2.n_consensus == rep1.n_consensus
+
+
+def test_nonresume_clears_manifest_on_disk(tmp_path):
+    """resume=False must persist the cleared manifest BEFORE any work:
+    if the run crashes before its first mark(), stale done-entries must
+    not survive on disk to be resurrected by a later --resume."""
+    from duplexumiconsensusreads_tpu.runtime.stream import _fingerprint
+
+    # unsorted input raises inside the chunk loop, before any mark()
+    bad = str(tmp_path / "unsorted.bam")
+    cfg = SimConfig(n_molecules=60, n_positions=8, seed=2)
+    simulated_bam(cfg, path=bad, sort=False)
+    gp = GroupingParams(strategy="exact", paired=True)
+    cp = ConsensusParams(mode="duplex")
+
+    ck = str(tmp_path / "ck3.json")
+    shard = str(tmp_path / "stale_shard")
+    open(shard, "w").close()  # must exist: load_or_create prunes dead paths
+    fp = _fingerprint(bad, gp, cp, 256, 50)
+    # stale manifests with BOTH matching and mismatching fingerprints
+    # must be wiped: this run overwrites the shard files either way
+    for stale_fp in (fp, "0123456789abcdef"):
+        with open(ck, "w") as f:
+            json.dump({"fingerprint": stale_fp, "done": {"0": shard}}, f)
+        with pytest.raises(ValueError, match="sort contract"):
+            stream_call_consensus(
+                bad, str(tmp_path / "o.bam"), gp, cp, capacity=256,
+                chunk_reads=50, checkpoint_path=ck, resume=False,
+            )
+        with open(ck) as f:
+            d = json.load(f)
+        assert d["done"] == {} and d["fingerprint"] == fp
+
+    # resume=True with a MISMATCHED fingerprint has the same crash
+    # window: load_or_create must persist the fresh manifest up front
+    with open(ck, "w") as f:
+        json.dump({"fingerprint": "feedfacefeedface", "done": {"0": shard}}, f)
+    with pytest.raises(ValueError, match="sort contract"):
+        stream_call_consensus(
+            bad, str(tmp_path / "o.bam"), gp, cp, capacity=256,
+            chunk_reads=50, checkpoint_path=ck, resume=True,
+        )
+    with open(ck) as f:
+        d = json.load(f)
+    assert d["done"] == {} and d["fingerprint"] == fp
+
+
 def test_unsorted_input_rejected(tmp_path):
     """The streaming sort contract is validated, not assumed: unsorted
     input must raise instead of silently splitting families."""
