@@ -1,152 +1,14 @@
-"""Benchmark harness: reads/sec consensus-called, TPU vs CPU-oracle baseline.
+"""Driver entry point: delegates to the installable benchmark module.
 
-Prints ONE JSON line:
-  {"metric": "reads_per_sec_duplex_consensus", "value": N,
-   "unit": "reads/s", "vs_baseline": R}
-
-The workload is benchmark config 3/5 (duplex consensus with adjacency
-grouping and the per-cycle error model — the hardest fused path) on a
-synthetic ctDNA-like batch. No published reference numbers exist
-(BASELINE.md): vs_baseline is measured against our own backend="cpu"
-NumPy oracle (the stand-in reference implementation, itself a
-per-family loop like the reference's pysam path), timed on a subsample
-and scaled per-read. Target (BASELINE.json): >=50x.
-
-Env knobs: DUT_BENCH_READS (default 300000), DUT_BENCH_CAPACITY (2048),
-DUT_BENCH_CPU_SAMPLE (3000).
+Prints ONE JSON line (see duplexumiconsensusreads_tpu/benchmark.py for
+the metric definition and env knobs).
 """
 
-from __future__ import annotations
-
-import json
-import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
-
-def main() -> None:
-    import jax
-
-    from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
-    from duplexumiconsensusreads_tpu.ops import ConsensusCaller, spec_for_buckets
-    from duplexumiconsensusreads_tpu.oracle import group_reads
-    from duplexumiconsensusreads_tpu.parallel import make_mesh
-    from duplexumiconsensusreads_tpu.parallel.sharded import (
-        presharded_pipeline,
-        shard_stacked,
-    )
-    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
-    from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
-
-    # ~600k reads/dispatch amortises the tunnel's fixed ~100ms per-call
-    # latency while staying inside HBM (1M+ reads/dispatch OOMs: the
-    # contributions + one-hot intermediates scale with bucket count)
-    n_target = int(os.environ.get("DUT_BENCH_READS", 600_000))
-    capacity = int(os.environ.get("DUT_BENCH_CAPACITY", 2048))
-    cpu_sample = int(os.environ.get("DUT_BENCH_CPU_SAMPLE", 3000))
-
-    gp = GroupingParams(strategy="adjacency", paired=True)
-    cp = ConsensusParams(mode="duplex", error_model="cycle", min_duplex_reads=1)
-
-    # ~9 reads per molecule (both strands); ~150 bp reads, panel-like tiling
-    n_mol = max(64, n_target // 9)
-    t0 = time.time()
-    sim_cfg = SimConfig(
-        n_molecules=n_mol,
-        read_len=150,
-        n_positions=max(8, n_mol // 48),
-        mean_family_size=4,
-        umi_error=0.01,
-        duplex=True,
-        seed=7,
-    )
-    batch, truth = simulate_batch(sim_cfg)
-    n_reads = int(np.asarray(batch.valid).sum())
-    buckets = build_buckets(batch, capacity=capacity, adjacency=True)
-    spec = spec_for_buckets(buckets, gp, cp)
-    sim_s = time.time() - t0
-
-    n_dev = len(jax.devices())
-    mesh = make_mesh(n_dev)
-    stacked = stack_buckets(buckets, multiple_of=n_dev)
-
-    # device-put once (sharded); timed loop measures pure compute, not
-    # host->device transfer of the input tensors
-    args = shard_stacked(stacked, mesh)
-    jax.block_until_ready(args)
-
-    # compile (excluded from timing). NOTE: timing ends with a small
-    # device->host read — on remote-tunneled platforms block_until_ready
-    # alone returns before execution finishes, silently inflating
-    # throughput by 100-1000x.
-    t0 = time.time()
-    out = presharded_pipeline(args, spec, mesh)
-    np.asarray(out["n_families"])
-    compile_s = time.time() - t0
-
-    # Steps are dispatched asynchronously and synced once at the end:
-    # that is exactly how the streaming executor overlaps chunks, and it
-    # amortises fixed per-call dispatch latency (~100ms on a tunneled
-    # chip) that would otherwise dominate the per-step number.
-    reps = int(os.environ.get("DUT_BENCH_REPS", 10))
-    t0 = time.time()
-    outs = [presharded_pipeline(args, spec, mesh) for _ in range(reps)]
-    for o in outs:
-        np.asarray(o["n_families"])
-    tpu_s = (time.time() - t0) / reps
-    tpu_rps = n_reads / tpu_s
-
-    # consensus error rate vs simulation truth (the "matched error
-    # rate" side of the metric): map each consensus molecule to its
-    # true molecule through a member read, compare called bases
-    out_np = {k: np.asarray(v) for k, v in outs[-1].items()}
-    n_err = n_base = 0
-    for bi, bk in enumerate(buckets):
-        mol = out_np["molecule_id"][bi]
-        cv = out_np["cons_valid"][bi]
-        ridx = bk.read_index
-        sel = np.nonzero((ridx >= 0) & bk.valid & (mol >= 0))[0]
-        if not len(sel):
-            continue
-        ms = mol[sel]
-        order = np.argsort(ms, kind="stable")
-        first = np.nonzero(np.r_[True, ms[order][1:] != ms[order][:-1]])[0]
-        rep_mol = ms[order][first]  # molecule rows present in bucket
-        rep_read = ridx[sel[order[first]]]  # one member read each
-        true_rows = truth.mol_seq[truth.read_mol[rep_read]]
-        called = out_np["cons_base"][bi][rep_mol]
-        real = (called < 4) & cv[rep_mol][:, None]
-        n_err += int((called[real] != true_rows[real]).sum())
-        n_base += int(real.sum())
-    err_rate = n_err / max(n_base, 1)
-
-    # CPU-oracle baseline on a subsample, scaled per-read
-    sub_idx = np.nonzero(np.asarray(batch.valid))[0][:cpu_sample]
-    sub = batch.take(sub_idx)
-    t0 = time.time()
-    fams = group_reads(sub, gp)
-    ConsensusCaller(cp, backend="cpu")(sub, fams)
-    cpu_s = time.time() - t0
-    cpu_rps = len(sub_idx) / cpu_s
-
-    result = {
-        "metric": "reads_per_sec_duplex_consensus",
-        "value": round(tpu_rps, 1),
-        "unit": "reads/s",
-        "vs_baseline": round(tpu_rps / cpu_rps, 2),
-    }
-    print(json.dumps(result))
-    print(
-        f"# reads={n_reads} buckets={len(buckets)} devices={n_dev} "
-        f"bucket_capacity={capacity} tpu_step={tpu_s:.3f}s compile={compile_s:.1f}s "
-        f"cpu_oracle={cpu_rps:.0f} reads/s (n={len(sub_idx)}) sim={sim_s:.1f}s "
-        f"consensus_error_rate={err_rate:.2e} ({n_err}/{n_base} bases, "
-        f"raw base_error={sim_cfg.base_error:g})",
-        file=sys.stderr,
-    )
-
+from duplexumiconsensusreads_tpu.benchmark import main
 
 if __name__ == "__main__":
     main()

@@ -293,17 +293,9 @@ def _cmd_bench(args) -> int:
         os.environ["DUT_BENCH_READS"] = str(args.reads)
     if args.capacity:
         os.environ["DUT_BENCH_CAPACITY"] = str(args.capacity)
-    import importlib.util
-    import os.path
+    from duplexumiconsensusreads_tpu.benchmark import main as bench_main
 
-    bench_path = __file__.rsplit("duplexumiconsensusreads_tpu", 1)[0] + "bench.py"
-    if not os.path.exists(bench_path):  # installed layout: no bench.py
-        print("bench.py not found next to the package", file=sys.stderr)
-        return 2
-    spec = importlib.util.spec_from_file_location("dut_bench", bench_path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    mod.main()
+    bench_main()
     return 0
 
 

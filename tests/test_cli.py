@@ -3,6 +3,7 @@ both backends) → validate against simulation truth. These are the
 framework's acceptance tests for the driver's five configs."""
 
 import json
+import os
 import zlib
 
 import numpy as np
@@ -137,3 +138,28 @@ def test_npz_input(tmp_path):
 def test_unknown_backend_rejected(tmp_path):
     with pytest.raises(SystemExit):
         main(["call", "x.bam", "-o", "y.bam", "--backend", "gpu"])
+
+
+def test_installed_entry_point_from_tempdir(tmp_path):
+    """The package must work installed: module entry point runnable from
+    an arbitrary cwd with the repo root NOT on sys.path (VERDICT item 7)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    code = (
+        "import duplexumiconsensusreads_tpu, sys;"
+        "from duplexumiconsensusreads_tpu.cli import main;"
+        "sys.exit(main(['simulate', '--out', 'x.bam', '--molecules', '5']))"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=str(tmp_path),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "x.bam").exists()

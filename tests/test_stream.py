@@ -61,6 +61,32 @@ class TestStreamReader:
             for j in range(i + 1, len(pos_sets)):
                 assert not (pos_sets[i] & pos_sets[j])
 
+    def test_native_stream_matches_python_codec(self, tmp_path):
+        from duplexumiconsensusreads_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("native loader unavailable")
+        path, recs, _ = _sorted_bam(tmp_path)
+
+        def drain(use_native, read_size):
+            r = BamStreamReader(path, read_size=read_size, use_native=use_native)
+            out = []
+            while True:
+                raw = r.read_raw_records(41)
+                if raw is None:
+                    break
+                out.append(raw)
+            r.close()
+            return r.header, b"".join(out)
+
+        h_py, raw_py = drain(False, 4096)
+        h_nat, raw_nat = drain(True, 4096)  # small reads: many native calls
+        assert h_py.text == h_nat.text and h_py.ref_names == h_nat.ref_names
+        assert raw_py == raw_nat
+        # large read_size: whole file in one native inflate batch
+        _, raw_one = drain(True, 64 << 20)
+        assert raw_one == raw_py
+
     def test_single_position_file(self, tmp_path):
         path, recs, _ = _sorted_bam(tmp_path, n_mol=30, n_positions=1)
         chunks = list(iter_record_chunks(path, chunk_reads=10))
