@@ -11,9 +11,15 @@ Rules:
 - buckets are filled greedily with whole position groups (adjacency
   clustering is position-local, so a split position group would miss
   cluster merges);
-- a position group larger than the capacity is split at exact-family
-  boundaries (safe for exact grouping; a warning is raised in
-  adjacency mode);
+- a position group larger than the capacity is handled WITHOUT changing
+  results: in adjacency mode the group is preclustered on the host with
+  the oracle's directional algorithm and its reads' UMIs are relabeled
+  to the cluster seed, after which splitting at (relabeled) family
+  boundaries is lossless under exact grouping — the kernel result then
+  matches the oracle exactly no matter how large the group is;
+- a single family larger than the capacity goes to its own "jumbo"
+  bucket with a next-pow2 capacity (dispatched as its own size class),
+  so consensus sees the whole family in one piece;
 - each bucket records source read indices so outputs can be scattered
   back to the caller's order.
 """
@@ -27,8 +33,13 @@ import numpy as np
 
 from duplexumiconsensusreads_tpu.constants import BASE_PAD
 from duplexumiconsensusreads_tpu.ops.grouper import dense_pos_ids
-from duplexumiconsensusreads_tpu.types import ReadBatch
+from duplexumiconsensusreads_tpu.types import GroupingParams, ReadBatch
 from duplexumiconsensusreads_tpu.utils.phred import pack_umi_words64
+
+# Host preclustering builds an nU x nU adjacency matrix; beyond this
+# many unique UMIs in ONE position group (far past any real panel
+# hotspot) fall back to the old family-boundary split with a warning.
+PRECLUSTER_MAX_UNIQUE = 40_000
 
 
 @dataclasses.dataclass
@@ -43,6 +54,10 @@ class Bucket:
     quals: np.ndarray  # (R, L) u8
     read_index: np.ndarray  # (R,) i64 into the source batch; -1 = padding
     n_unique_umi: int  # unique (pos, UMI) pairs — must be <= u_max
+    # True: UMIs were host-preclustered (relabeled to their directional
+    # cluster seed); the dispatcher must run this bucket with exact
+    # grouping so the device does not re-cluster relabeled seeds.
+    preclustered: bool = False
 
     @property
     def capacity(self) -> int:
@@ -62,30 +77,50 @@ def _empty_bucket(r: int, l: int, b: int) -> Bucket:
     )
 
 
-def _fill_bucket(batch: ReadBatch, idx: np.ndarray, r: int) -> Bucket:
+def _fill_bucket(
+    batch: ReadBatch,
+    idx: np.ndarray,
+    r: int,
+    umi_override: np.ndarray | None = None,
+    preclustered: bool = False,
+) -> Bucket:
     l, b = batch.read_len, batch.umi_len
     bk = _empty_bucket(r, l, b)
     n = len(idx)
+    umi = umi_override if umi_override is not None else np.asarray(batch.umi)[idx]
     bk.pos[:n] = dense_pos_ids(np.asarray(batch.pos_key)[idx])
-    bk.umi[:n] = np.asarray(batch.umi)[idx]
+    bk.umi[:n] = umi
     bk.strand_ab[:n] = np.asarray(batch.strand_ab)[idx]
     bk.valid[:n] = np.asarray(batch.valid)[idx]
     bk.bases[:n] = np.asarray(batch.bases)[idx]
     bk.quals[:n] = np.asarray(batch.quals)[idx]
     bk.read_index[:n] = idx
+    bk.preclustered = preclustered
     key = np.column_stack(
-        [np.asarray(batch.pos_key)[idx], pack_umi_words64(np.asarray(batch.umi)[idx])]
+        [np.asarray(batch.pos_key)[idx], pack_umi_words64(umi)]
     )
     bk.n_unique_umi = len(np.unique(key, axis=0))
     return bk
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
 
 
 def build_buckets(
     batch: ReadBatch,
     capacity: int,
     adjacency: bool = False,
+    grouping: GroupingParams | None = None,
 ) -> list[Bucket]:
-    """Pack a host ReadBatch into fixed-capacity buckets."""
+    """Pack a host ReadBatch into fixed-capacity buckets.
+
+    ``grouping`` supplies the directional parameters used to
+    host-precluster oversized position groups in adjacency mode; if
+    omitted, UMI-tools defaults (Hamming<=1, count_ratio 2) are used.
+    """
+    if grouping is not None:
+        adjacency = adjacency or grouping.strategy == "adjacency"
     valid = np.asarray(batch.valid, bool)
     idx_all = np.nonzero(valid)[0]
     if len(idx_all) == 0:
@@ -109,6 +144,9 @@ def build_buckets(
     )[0]
 
     buckets: list[np.ndarray] = []
+    # (idx, umi_override|None, capacity, preclustered) — buckets carved
+    # out of oversized position groups, possibly with jumbo capacities
+    special: list[tuple] = []
     cur: list[np.ndarray] = []
     cur_n = 0
 
@@ -118,36 +156,133 @@ def build_buckets(
             buckets.append(np.concatenate(cur))
             cur, cur_n = [], 0
 
+    # Jumbo buckets keep a whole >capacity family in one piece, but the
+    # geometry must stay bounded (stack_buckets pads the class with
+    # same-shape empties and XLA compiles per capacity): families past
+    # 64x the base capacity are hard-cut with a warning, the bounded
+    # behaviour the old splitter had.
+    jumbo_max = capacity * 64
+
+    def pack_family_runs(idx_g, bounds, umi_rows, preclustered):
+        """Greedy-pack whole families (runs delimited by ``bounds``,
+        local offsets into ``idx_g``) into capacity-sized buckets; a
+        family larger than the capacity gets a jumbo pow2 bucket."""
+        run_s = 0
+        run_n = 0
+        for fi in range(len(bounds) - 1):
+            fs, fe = int(bounds[fi]), int(bounds[fi + 1])
+            fsize = fe - fs
+            if fsize > jumbo_max:
+                warnings.warn(
+                    f"single UMI family of {fsize} reads exceeds the jumbo "
+                    f"bucket limit {jumbo_max}; splitting the family "
+                    "(consensus will emit one record per split)"
+                )
+                if run_n:
+                    special.append(
+                        (
+                            idx_g[run_s:fs],
+                            None if umi_rows is None else umi_rows[run_s:fs],
+                            capacity,
+                            preclustered,
+                        )
+                    )
+                for cs in range(fs, fe, jumbo_max):
+                    ce = min(cs + jumbo_max, fe)
+                    special.append(
+                        (
+                            idx_g[cs:ce],
+                            None if umi_rows is None else umi_rows[cs:ce],
+                            _pow2(ce - cs),
+                            preclustered,
+                        )
+                    )
+                run_s, run_n = fe, 0
+                continue
+            if fsize > capacity:
+                if run_n:
+                    special.append(
+                        (
+                            idx_g[run_s:fs],
+                            None if umi_rows is None else umi_rows[run_s:fs],
+                            capacity,
+                            preclustered,
+                        )
+                    )
+                special.append(
+                    (
+                        idx_g[fs:fe],
+                        None if umi_rows is None else umi_rows[fs:fe],
+                        _pow2(fsize),
+                        preclustered,
+                    )
+                )
+                run_s, run_n = fe, 0
+                continue
+            if run_n + fsize > capacity:
+                special.append(
+                    (
+                        idx_g[run_s:fs],
+                        None if umi_rows is None else umi_rows[run_s:fs],
+                        capacity,
+                        preclustered,
+                    )
+                )
+                run_s, run_n = fs, 0
+            run_n += fsize
+        if run_n:
+            special.append(
+                (
+                    idx_g[run_s:],
+                    None if umi_rows is None else umi_rows[run_s:],
+                    capacity,
+                    preclustered,
+                )
+            )
+
     pos_bounds = np.r_[pos_start, n]
     for gi in range(len(pos_start)):
         s, e = pos_bounds[gi], pos_bounds[gi + 1]
         size = e - s
         if size > capacity:
-            if adjacency:
-                warnings.warn(
-                    f"position group of {size} reads exceeds bucket capacity "
-                    f"{capacity}; adjacency clustering will not merge UMIs "
-                    "across the split"
-                )
-            # split at family boundaries
-            fs = fam_start[(fam_start >= s) & (fam_start < e)]
-            fam_bounds = np.r_[fs, e]
             flush()
-            chunk_s = s
-            for fi in range(1, len(fam_bounds)):
-                while fam_bounds[fi] - chunk_s > capacity:
-                    cut = fam_bounds[fi - 1]
-                    if cut <= chunk_s:  # single family > capacity: hard cuts
-                        warnings.warn(
-                            f"single UMI family of {fam_bounds[fi]-chunk_s} reads "
-                            f"exceeds capacity {capacity}; splitting the family"
-                        )
-                        cut = chunk_s + capacity
-                    buckets.append(idx_sorted[chunk_s:cut])
-                    chunk_s = cut
-            if e > chunk_s:
-                cur = [idx_sorted[chunk_s:e]]
-                cur_n = e - chunk_s
+            sel = idx_sorted[s:e]
+            if adjacency:
+                g = grouping or GroupingParams(strategy="adjacency")
+                umi_g = np.asarray(batch.umi)[sel]
+                uu, inv, cnt = np.unique(
+                    umi_g, axis=0, return_inverse=True, return_counts=True
+                )
+                if len(uu) > PRECLUSTER_MAX_UNIQUE:
+                    warnings.warn(
+                        f"position group with {len(uu)} unique UMIs exceeds "
+                        f"the precluster limit {PRECLUSTER_MAX_UNIQUE}; "
+                        "falling back to a family-boundary split (adjacency "
+                        "merges across the split will be missed)"
+                    )
+                    fs_ = fam_start[(fam_start >= s) & (fam_start < e)]
+                    pack_family_runs(sel, np.r_[fs_, e] - s, None, False)
+                    continue
+                from duplexumiconsensusreads_tpu.oracle.grouping import (
+                    directional_seeds,
+                )
+
+                seed_of = directional_seeds(
+                    uu, cnt, g.max_hamming, g.count_ratio
+                )
+                new_umi = uu[seed_of][inv]  # (size, B) seed-relabeled
+                w2 = pack_umi_words64(new_umi)
+                order_g = np.lexsort(
+                    tuple(w2[:, i] for i in range(w2.shape[1] - 1, -1, -1))
+                )
+                sel = sel[order_g]
+                new_umi = new_umi[order_g]
+                w2 = w2[order_g]
+                fam_b = np.nonzero(np.r_[True, (w2[1:] != w2[:-1]).any(axis=1)])[0]
+                pack_family_runs(sel, np.r_[fam_b, size], new_umi, True)
+            else:
+                fs_ = fam_start[(fam_start >= s) & (fam_start < e)]
+                pack_family_runs(sel, np.r_[fs_, e] - s, None, False)
             continue
         if cur_n + size > capacity:
             flush()
@@ -155,7 +290,12 @@ def build_buckets(
         cur_n += size
     flush()
 
-    return [_fill_bucket(batch, b, capacity) for b in buckets]
+    out = [_fill_bucket(batch, b, capacity) for b in buckets]
+    out.extend(
+        _fill_bucket(batch, idx, cap, umi_override=um, preclustered=pc)
+        for idx, um, cap, pc in special
+    )
+    return out
 
 
 def stack_buckets(buckets: list[Bucket], multiple_of: int = 1) -> dict:

@@ -320,7 +320,7 @@ def serialize_bam(header: BamHeader, recs: BamRecords) -> bytes:
 
 def write_bam(path: str, header: BamHeader, recs: BamRecords, level: int = 6) -> None:
     with open(path, "wb") as f:
-        f.write(bgzf.compress(serialize_bam(header, recs), level=level))
+        f.write(bgzf.compress_fast(serialize_bam(header, recs), level=level))
 
 
 def make_aux_z(tag: str, value: str) -> bytes:

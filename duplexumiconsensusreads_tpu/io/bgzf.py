@@ -113,6 +113,25 @@ def compress(data: bytes, level: int = 6, eof: bool = True) -> bytes:
     return out.getvalue()
 
 
+def compress_fast(data: bytes, level: int = 6, eof: bool = True) -> bytes:
+    """BGZF-compress via the native multithreaded library when present
+    (io/native), falling back to the pure-Python codec. DUT_NO_NATIVE=1
+    forces the fallback (same knob as the native reader)."""
+    import os
+
+    out = None
+    if not os.environ.get("DUT_NO_NATIVE"):
+        try:
+            from duplexumiconsensusreads_tpu.native import bgzf_compress_native
+
+            out = bgzf_compress_native(data, level=level)
+        except Exception:
+            out = None
+    if out is None:
+        return compress(data, level=level, eof=eof)
+    return out + (BGZF_EOF if eof else b"")
+
+
 def is_bgzf(data: bytes) -> bool:
     if len(data) < 18 or data[:2] != b"\x1f\x8b":
         return False

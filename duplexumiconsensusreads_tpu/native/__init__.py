@@ -48,6 +48,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dut_bgzf_decompress.argtypes = [
         _c_u8p, ctypes.c_long, _c_u8p, ctypes.c_long, ctypes.c_int,
     ]
+    lib.dut_bgzf_compress_bound.restype = ctypes.c_long
+    lib.dut_bgzf_compress_bound.argtypes = [ctypes.c_long]
+    lib.dut_bgzf_compress.restype = ctypes.c_long
+    lib.dut_bgzf_compress.argtypes = [
+        _c_u8p, ctypes.c_long, _c_u8p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+    ]
     lib.dut_bam_scan.restype = ctypes.c_long
     lib.dut_bam_scan.argtypes = [
         _c_u8p, ctypes.c_long,
@@ -77,6 +83,18 @@ def get_lib() -> ctypes.CDLL | None:
             return None
         try:
             _lib = _bind(ctypes.CDLL(_SO))
+        except AttributeError:
+            # stale .so from an older source revision: rebuild once
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            if not _build():
+                return None
+            try:
+                _lib = _bind(ctypes.CDLL(_SO))
+            except (OSError, AttributeError):
+                return None
         except OSError:
             return None
         return _lib
@@ -84,3 +102,24 @@ def get_lib() -> ctypes.CDLL | None:
 
 def native_available() -> bool:
     return get_lib() is not None
+
+
+def bgzf_compress_native(
+    data: bytes, level: int = 6, n_threads: int = 0
+) -> bytes | None:
+    """Parallel BGZF-compress ``data`` (no EOF block); None if the
+    native library is unavailable or compression fails."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if not data:
+        return b""
+    if n_threads <= 0:
+        n_threads = min(os.cpu_count() or 1, 16)
+    src = np.frombuffer(data, np.uint8)
+    cap = lib.dut_bgzf_compress_bound(len(src))
+    out = np.empty(max(cap, 1), np.uint8)
+    w = lib.dut_bgzf_compress(src, len(src), out, cap, level, n_threads)
+    if w < 0:
+        return None
+    return out[:w].tobytes()

@@ -137,6 +137,95 @@ long dut_bgzf_decompress(const uint8_t* data, long n, uint8_t* out,
   return total;
 }
 
+// BGZF payload cap per block (htslib's choice: leaves headroom so even
+// incompressible payloads fit the format's 65536 compressed-block cap
+// as one stored-mode deflate sub-block).
+static const long kBgzfPayload = 65280;
+// Per-block scratch/compacted-output slot: 18-byte BGZF header + worst
+// case deflate of 65280 (stored: 5 + 65280) + crc/isize trailer.
+static const long kBgzfSlot = 65536;
+
+// Required output capacity for dut_bgzf_compress over n input bytes.
+long dut_bgzf_compress_bound(long n) {
+  long blocks = n <= 0 ? 0 : (n + kBgzfPayload - 1) / kBgzfPayload;
+  return blocks * kBgzfSlot;
+}
+
+static long deflate_block(const uint8_t* src, long len, uint8_t* dst,
+                          int level) {
+  // Deflate one payload into dst+18 (raw stream), returning the TOTAL
+  // BGZF block size, or -1. Falls back to stored mode if the
+  // compressed form would overflow the 65536 block cap.
+  for (int attempt = 0; attempt < 2; attempt++) {
+    z_stream zs{};
+    int lvl = attempt == 0 ? level : 0;
+    if (deflateInit2(&zs, lvl, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK)
+      return -1;
+    zs.next_in = const_cast<uint8_t*>(src);
+    zs.avail_in = (uInt)len;
+    zs.next_out = dst + 18;
+    zs.avail_out = (uInt)(kBgzfSlot - 18 - 8);
+    int rc = deflate(&zs, Z_FINISH);
+    long clen = (long)zs.total_out;
+    deflateEnd(&zs);
+    if (rc != Z_STREAM_END) continue;  // overflow: retry stored
+    long bsize = 18 + clen + 8;
+    if (bsize > 65536) continue;
+    // gzip header with BC FEXTRA subfield carrying (bsize - 1)
+    dst[0] = 0x1f; dst[1] = 0x8b; dst[2] = 8; dst[3] = 4;
+    std::memset(dst + 4, 0, 5);  // mtime + xfl
+    dst[9] = 0xff;               // OS unknown
+    dst[10] = 6; dst[11] = 0;    // XLEN
+    dst[12] = 66; dst[13] = 67; dst[14] = 2; dst[15] = 0;
+    uint16_t bs16 = (uint16_t)(bsize - 1);
+    std::memcpy(dst + 16, &bs16, 2);
+    uint32_t crc = crc32(0L, Z_NULL, 0);
+    crc = crc32(crc, src, (uInt)len);
+    uint32_t isize = (uint32_t)len;
+    std::memcpy(dst + 18 + clen, &crc, 4);
+    std::memcpy(dst + 18 + clen + 4, &isize, 4);
+    return bsize;
+  }
+  return -1;
+}
+
+// Compress data into a BGZF block stream (no EOF marker), n_threads
+// parallel. out must have dut_bgzf_compress_bound(n) capacity.
+// Returns bytes written, or -1.
+long dut_bgzf_compress(const uint8_t* data, long n, uint8_t* out,
+                       long out_cap, int level, int n_threads) {
+  long n_blocks = n <= 0 ? 0 : (n + kBgzfPayload - 1) / kBgzfPayload;
+  if (out_cap < n_blocks * kBgzfSlot) return -1;
+  std::vector<long> bsizes(n_blocks, -1);
+  std::atomic<long> next{0};
+  std::atomic<bool> failed{false};
+  auto worker = [&]() {
+    for (;;) {
+      long i = next.fetch_add(1);
+      if (i >= n_blocks || failed.load()) return;
+      long s = i * kBgzfPayload;
+      long len = (s + kBgzfPayload <= n) ? kBgzfPayload : n - s;
+      long bs = deflate_block(data + s, len, out + i * kBgzfSlot, level);
+      if (bs < 0) { failed = true; return; }
+      bsizes[i] = bs;
+    }
+  };
+  int nt = n_threads > 0 ? n_threads : 1;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < nt; t++) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+  if (failed.load()) return -1;
+  // compact the fixed slots into a contiguous stream (in place, left
+  // to right: the write cursor never passes the read cursor)
+  long w = 0;
+  for (long i = 0; i < n_blocks; i++) {
+    if (w != i * kBgzfSlot)
+      std::memmove(out + w, out + i * kBgzfSlot, bsizes[i]);
+    w += bsizes[i];
+  }
+  return w;
+}
+
 // ----------------------------------------------------------------- BAM
 
 // Scan decompressed BAM: locate end of header, count records, find max

@@ -25,13 +25,16 @@ from duplexumiconsensusreads_tpu.types import FamilyAssignment, GroupingParams, 
 from duplexumiconsensusreads_tpu.utils.phred import pack_umi_words64
 
 
-def _directional_clusters(
+def directional_seeds(
     umis: np.ndarray, counts: np.ndarray, max_hamming: int, count_ratio: int
 ) -> np.ndarray:
     """Cluster unique UMIs (nU, U) with counts (nU,) -> seed index per UMI.
 
     Returns, for each unique UMI, the index (into ``umis``) of its
-    cluster seed (the highest-count UMI of its cluster).
+    cluster seed (the highest-count UMI of its cluster). Also used by
+    the bucketing layer to host-precluster oversized position groups
+    (bucketing/buckets.py), so the edge computation is blocked: peak
+    memory is O(nU * block * U) instead of O(nU**2 * U).
     """
     n = len(umis)
     words = pack_umi_words64(umis)  # any UMI length
@@ -40,10 +43,14 @@ def _directional_clusters(
         (*[words[:, i] for i in range(words.shape[1] - 1, -1, -1)], -counts)
     )
     # adjacency: ham[u, v] and counts[u] >= ratio*counts[v] - 1 (directed u->v)
-    ham = (umis[:, None, :] != umis[None, :, :]).sum(axis=2)
-    edge = (ham <= max_hamming) & (
-        counts[:, None] >= count_ratio * counts[None, :] - 1
-    )
+    edge = np.empty((n, n), bool)
+    block = max(1, (64 << 20) // max(n * umis.shape[1], 1))
+    for s in range(0, n, block):
+        e = min(s + block, n)
+        ham = (umis[s:e, None, :] != umis[None, :, :]).sum(axis=2)
+        edge[s:e] = (ham <= max_hamming) & (
+            counts[s:e, None] >= count_ratio * counts[None, :] - 1
+        )
     np.fill_diagonal(edge, False)
 
     seed_of = np.full(n, -1, np.int64)
@@ -88,7 +95,7 @@ def group_reads(batch: ReadBatch, params: GroupingParams) -> FamilyAssignment:
             uu, inv, cnt = np.unique(
                 umi[sel], axis=0, return_inverse=True, return_counts=True
             )
-            seed_of = _directional_clusters(
+            seed_of = directional_seeds(
                 uu, cnt, params.max_hamming, params.count_ratio
             )
             cluster_umi[sel] = pack_umi_words64(uu)[seed_of][inv]

@@ -149,6 +149,51 @@ def scatter_bucket_outputs(
     )
 
 
+def partition_buckets(
+    buckets,
+    grouping: GroupingParams,
+    consensus: ConsensusParams,
+    ssc_method: str = "matmul",
+):
+    """Split buckets into dispatch classes of identical geometry+strategy.
+
+    Returns [(class_buckets, PipelineSpec)]. Classes are keyed by
+    (capacity, preclustered, pow2(unique-count)): capacity separates
+    jumbo buckets (stack_buckets needs homogeneous shapes), the
+    unique-count class keeps sparse buckets from paying dense buckets'
+    u_max/f_max geometry, and preclustered buckets run with EXACT
+    grouping — their UMIs are already relabeled to the directional
+    cluster seed by the host (bucketing/buckets.py), so re-clustering
+    on device could over-merge seeds whose aggregated counts now
+    satisfy the directional edge condition.
+    """
+    import dataclasses as _dc
+
+    from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
+
+    classes: dict[tuple, list] = {}
+    for bk in buckets:
+        ucls = 1 << max(bk.n_unique_umi - 1, 0).bit_length()
+        classes.setdefault((bk.capacity, bk.preclustered, ucls), []).append(bk)
+    out = []
+    for key in sorted(classes):
+        cbuckets = classes[key]
+        g = _dc.replace(grouping, strategy="exact") if key[1] else grouping
+        out.append(
+            (cbuckets, spec_for_buckets(cbuckets, g, consensus, ssc_method))
+        )
+    return out
+
+
+def sort_consensus_outputs(cb, cq, cd, fp, fu):
+    """Order consensus rows by (pos_key, UMI) so the output BAM stays
+    coordinate-sorted (class-wise dispatch visits buckets out of
+    genomic order; downstream tools and our own streaming executor
+    expect non-decreasing positions)."""
+    order = np.lexsort((*reversed(umi_sort_keys(fu)), fp))
+    return cb[order], cq[order], cd[order], fp[order], fu[order]
+
+
 def call_batch_tpu(
     batch: ReadBatch,
     grouping: GroupingParams,
@@ -169,13 +214,11 @@ def call_batch_tpu(
     from duplexumiconsensusreads_tpu.parallel import make_mesh
     from duplexumiconsensusreads_tpu.parallel.sharded import sharded_pipeline
 
-    from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
-
     rep = report or RunReport()
     duplex = consensus.mode == "duplex"
 
     t0 = time.time()
-    buckets = build_buckets(batch, capacity=capacity, adjacency=grouping.strategy == "adjacency")
+    buckets = build_buckets(batch, capacity=capacity, grouping=grouping)
     rep.n_buckets = len(buckets)
     rep.seconds["bucketing"] = round(time.time() - t0, 4)
     if not buckets:
@@ -196,19 +239,15 @@ def call_batch_tpu(
     n_data = max(n_dev // max(cycle_shards, 1), 1)
 
     # (genomic tile, family-size) bucketing, second axis: buckets are
-    # classed by their unique-key count (pow2) so a sparse-coverage
-    # bucket doesn't pay the dense buckets' u_max/f_max geometry. All
-    # classes are dispatched before any is drained (async overlap).
-    classes: dict[int, list] = {}
-    for bk in buckets:
-        cls = 1 << max(bk.n_unique_umi - 1, 0).bit_length()
-        classes.setdefault(cls, []).append(bk)
+    # classed by (capacity, preclustered, pow2 unique-key count) so a
+    # sparse-coverage bucket doesn't pay the dense buckets' u_max/f_max
+    # geometry and jumbo/preclustered buckets get their own compiles.
+    # All classes are dispatched before any is drained (async overlap).
+    part = partition_buckets(buckets, grouping, consensus)
 
     t0 = time.time()
     pending = []
-    for cls in sorted(classes):
-        cbuckets = classes[cls]
-        cspec = spec_for_buckets(cbuckets, grouping, consensus)
+    for cbuckets, cspec in part:
         stacked = stack_buckets(cbuckets, multiple_of=n_data)
         pending.append((cbuckets, sharded_pipeline(stacked, cspec, mesh)))
     rep.seconds["device_dispatch"] = round(time.time() - t0, 4)
@@ -222,22 +261,11 @@ def call_batch_tpu(
         rep.n_molecules += int(out["n_molecules"][:n_real].sum())
         parts.append(scatter_bucket_outputs(out, cbuckets, batch, duplex))
     rep.seconds["device_pipeline_and_scatter"] = round(time.time() - t0, 4)
-    rep.n_size_classes = len(classes)
+    rep.n_size_classes = len(part)
 
     cb, cq, cd, fp, fu = (np.concatenate(x) for x in zip(*parts))
-    # class-wise dispatch visits buckets out of genomic order; restore
-    # (pos_key, UMI) order so the output BAM stays coordinate-sorted
-    # (its own streaming executor — and most downstream tools — expect
-    # non-decreasing positions)
-    order = np.lexsort((*reversed(umi_sort_keys(fu)), fp))
-    return (
-        cb[order],
-        cq[order],
-        cd[order],
-        np.ones(len(cb), bool),
-        fp[order],
-        fu[order],
-    )
+    cb, cq, cd, fp, fu = sort_consensus_outputs(cb, cq, cd, fp, fu)
+    return (cb, cq, cd, np.ones(len(cb), bool), fp, fu)
 
 
 def call_batch_cpu(

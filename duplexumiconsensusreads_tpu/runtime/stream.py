@@ -45,7 +45,9 @@ from duplexumiconsensusreads_tpu.io.convert import (
 from duplexumiconsensusreads_tpu.io.convert import records_pos_keys as _rec_pos_keys
 from duplexumiconsensusreads_tpu.runtime.executor import (
     RunReport,
+    partition_buckets,
     scatter_bucket_outputs,
+    sort_consensus_outputs,
 )
 from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 
@@ -432,7 +434,6 @@ def stream_call_consensus(
 
     from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
     from duplexumiconsensusreads_tpu.io.bam import serialize_bam
-    from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
     from duplexumiconsensusreads_tpu.parallel import make_mesh
     from duplexumiconsensusreads_tpu.parallel.sharded import sharded_pipeline
 
@@ -474,24 +475,27 @@ def stream_call_consensus(
 
     def drain_one():
         nonlocal rep
-        k, out, buckets, batch, spec = inflight.popleft()
-        try:
-            out = {key: np.asarray(v) for key, v in out.items()}
-        except Exception as e:  # failure detection: retry the chunk once
-            rep.n_retries += 1
-            import sys
+        k, entries, batch = inflight.popleft()
+        parts = []
+        for out, cbuckets, cspec in entries:
+            try:
+                out = {key: np.asarray(v) for key, v in out.items()}
+            except Exception as e:  # failure detection: retry the class once
+                rep.n_retries += 1
+                import sys
 
-            print(
-                f"[duplexumi] chunk {k} device execution failed ({e!r}); "
-                "re-dispatching once",
-                file=sys.stderr,
-            )
-            out = dispatch(buckets, spec)
-            out = {key: np.asarray(v) for key, v in out.items()}
-        rep.n_families += int(out["n_families"].sum())
-        rep.n_molecules += int(out["n_molecules"].sum())
+                print(
+                    f"[duplexumi] chunk {k} device execution failed ({e!r}); "
+                    "re-dispatching once",
+                    file=sys.stderr,
+                )
+                out = dispatch(cbuckets, cspec)
+                out = {key: np.asarray(v) for key, v in out.items()}
+            rep.n_families += int(out["n_families"].sum())
+            rep.n_molecules += int(out["n_molecules"].sum())
+            parts.append(scatter_bucket_outputs(out, cbuckets, batch, duplex))
         shard = _finish_chunk(
-            k, out, buckets, batch, duplex, shard_dir, serialize_bam, header_out
+            k, parts, duplex, shard_dir, serialize_bam, header_out
         )
         shards[k] = shard
         if ckpt:
@@ -520,19 +524,18 @@ def stream_call_consensus(
                 + info["n_dropped_umi_len"]
                 + info.get("n_dropped_flag", 0)
             )
-            buckets = build_buckets(
-                batch, capacity=capacity, adjacency=grouping.strategy == "adjacency"
-            )
+            buckets = build_buckets(batch, capacity=capacity, grouping=grouping)
             rep.n_buckets += len(buckets)
             if not buckets:
                 shards[k] = _write_shard(shard_dir, k, b"")
                 if ckpt:
                     ckpt.mark(k, shards[k])
                 continue
-            spec = spec_for_buckets(buckets, grouping, consensus)
-            spec_cache[spec] = True
-            out = dispatch(buckets, spec)  # async
-            inflight.append((k, out, buckets, batch, spec))
+            entries = []
+            for cbuckets, cspec in partition_buckets(buckets, grouping, consensus):
+                spec_cache[cspec] = True
+                entries.append((dispatch(cbuckets, cspec), cbuckets, cspec))
+            inflight.append((k, entries, batch))
             while len(inflight) >= max_inflight:
                 drain_one()
         while inflight:
@@ -552,12 +555,12 @@ def stream_call_consensus(
         _r.close()
     shell = serialize_bam(header_out, _empty_records())
     with open(out_path, "wb") as f:
-        f.write(bgzf.compress(shell, eof=False))
+        f.write(bgzf.compress_fast(shell, eof=False))
         for k in sorted(shards):
             with open(shards[k], "rb") as s:
                 data = s.read()
             if data:
-                f.write(bgzf.compress(data, eof=False))
+                f.write(bgzf.compress_fast(data, eof=False))
             rep.n_consensus += _count_records(data)
         f.write(bgzf.BGZF_EOF)
     if not checkpoint_path:
@@ -619,10 +622,11 @@ def _count_records(data: bytes) -> int:
 
 
 def _finish_chunk(
-    k, out, buckets, batch, duplex, shard_dir, serialize_bam, header
+    k, parts, duplex, shard_dir, serialize_bam, header
 ) -> str:
-    """Scatter one chunk's device output back and write its shard."""
-    cb, cq, cd, fp, fu = scatter_bucket_outputs(out, buckets, batch, duplex)
+    """Merge one chunk's per-class scattered outputs and write its shard."""
+    cb, cq, cd, fp, fu = (np.concatenate(x) for x in zip(*parts))
+    cb, cq, cd, fp, fu = sort_consensus_outputs(cb, cq, cd, fp, fu)
     recs = consensus_to_records(
         cb,
         cq,

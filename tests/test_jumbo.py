@@ -1,0 +1,223 @@
+"""Oversized position groups and jumbo families (VERDICT r1 item 4).
+
+A position group larger than the bucket capacity must not change
+adjacency results: the bucketing layer host-preclusters the group with
+the oracle's directional algorithm, relabels member UMIs to the cluster
+seed, and dispatches those buckets through exact grouping. A single
+family larger than the capacity gets its own jumbo pow2-capacity
+bucket. Both paths must match the oracle bit-for-bit (quals within the
+usual f32 tolerance).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.bucketing import build_buckets
+from duplexumiconsensusreads_tpu.runtime.executor import (
+    call_batch_cpu,
+    call_batch_tpu,
+)
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+from duplexumiconsensusreads_tpu.utils.phred import pack_umi_words64
+
+
+def _sorted_by_key(cb, cq, cd, fp, fu):
+    order = np.lexsort(
+        (
+            *[
+                pack_umi_words64(fu)[:, i]
+                for i in range(pack_umi_words64(fu).shape[1] - 1, -1, -1)
+            ],
+            fp,
+        )
+    )
+    return cb[order], cq[order], cd[order], fp[order], fu[order]
+
+
+def _assert_tpu_matches_cpu(batch, gp, cp, capacity):
+    t = call_batch_tpu(batch, gp, cp, capacity=capacity)
+    c = call_batch_cpu(batch, gp, cp)
+    tb, tq, td, tp_, tu = _sorted_by_key(t[0], t[1], t[2], t[4], t[5])
+    ob, oq, od, op_, ou = _sorted_by_key(c[0], c[1], c[2], c[4], c[5])
+    assert len(tb) == len(ob), (len(tb), len(ob))
+    np.testing.assert_array_equal(tp_, op_)
+    np.testing.assert_array_equal(tu, ou)
+    np.testing.assert_array_equal(tb, ob)
+    np.testing.assert_array_equal(td, od)
+    dq = np.abs(tq.astype(int) - oq.astype(int))
+    assert (dq <= 3).all()
+    assert (dq <= 1).mean() > 0.97
+
+
+def test_oversized_position_group_adjacency_matches_oracle():
+    """One position group ~3x the capacity, adjacency + duplex: results
+    must equal the oracle's (the old family-boundary split could not
+    merge UMIs across the split)."""
+    cfg = SimConfig(
+        n_molecules=220,
+        n_positions=2,
+        mean_family_size=4,
+        umi_error=0.04,
+        duplex=True,
+        seed=42,
+    )
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex", min_duplex_reads=1)
+    capacity = 256
+    # precondition: at least one position group really is oversized
+    pos = np.asarray(batch.pos_key)[np.asarray(batch.valid, bool)]
+    assert np.unique(pos, return_counts=True)[1].max() > 3 * capacity
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the old path warned; new one must not
+        _assert_tpu_matches_cpu(batch, gp, cp, capacity)
+
+
+def test_oversized_group_buckets_are_preclustered():
+    cfg = SimConfig(
+        n_molecules=150, n_positions=1, umi_error=0.03, duplex=True, seed=5
+    )
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    buckets = build_buckets(batch, capacity=128, grouping=gp)
+    assert any(b.preclustered for b in buckets)
+    for b in buckets:
+        assert b.capacity >= 128
+        assert b.capacity & (b.capacity - 1) == 0 or b.capacity == 128
+
+
+def test_jumbo_family_exact_matches_oracle():
+    """A single exact family far larger than the capacity must produce
+    ONE consensus (jumbo bucket), identical to the oracle, instead of
+    being hard-cut into several partial families."""
+    rng = np.random.default_rng(11)
+    n, l, u = 700, 40, 6
+    from duplexumiconsensusreads_tpu.types import ReadBatch
+
+    seq = rng.integers(0, 4, size=l, dtype=np.uint8)
+    batch = ReadBatch(
+        bases=np.tile(seq, (n, 1)),
+        quals=rng.integers(20, 40, size=(n, l), dtype=np.uint8),
+        umi=np.tile(rng.integers(0, 4, size=u, dtype=np.uint8), (n, 1)),
+        pos_key=np.full(n, 5000, np.int64),
+        strand_ab=np.ones(n, bool),
+        valid=np.ones(n, bool),
+    )
+    # sprinkle errors so consensus actually has work to do
+    err = rng.random((n, l)) < 0.05
+    batch.bases[err] = (batch.bases[err] + 1) % 4
+
+    gp = GroupingParams(strategy="exact")
+    cp = ConsensusParams(mode="single_strand", min_reads=2)
+    capacity = 256
+
+    buckets = build_buckets(batch, capacity=capacity, grouping=gp)
+    assert len(buckets) == 1
+    assert buckets[0].capacity == 1024  # pow2(700)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        t = call_batch_tpu(batch, gp, cp, capacity=capacity)
+    c = call_batch_cpu(batch, gp, cp)
+    assert len(t[0]) == len(c[0]) == 1
+    np.testing.assert_array_equal(t[0], c[0])
+    np.testing.assert_array_equal(t[2], c[2])
+
+
+def test_jumbo_cluster_adjacency_duplex():
+    """An adjacency cluster larger than capacity (post-relabel family)
+    routes through a preclustered jumbo bucket and still matches the
+    oracle."""
+    rng = np.random.default_rng(13)
+    from duplexumiconsensusreads_tpu.types import ReadBatch
+
+    n, l, u = 600, 32, 12
+    seed_umi = rng.integers(0, 4, size=u, dtype=np.uint8)
+    umi = np.tile(seed_umi, (n, 1))
+    # ~15% of reads carry a 1-off UMI (adjacency should fold them in)
+    off = rng.random(n) < 0.15
+    col = rng.integers(0, u, size=n)
+    umi[off, col[off]] = (umi[off, col[off]] + 1) % 4
+    seq = rng.integers(0, 4, size=l, dtype=np.uint8)
+    batch = ReadBatch(
+        bases=np.tile(seq, (n, 1)),
+        quals=rng.integers(20, 40, size=(n, l), dtype=np.uint8),
+        umi=umi,
+        pos_key=np.full(n, 9000, np.int64),
+        strand_ab=rng.random(n) < 0.5,
+        valid=np.ones(n, bool),
+    )
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex", min_duplex_reads=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _assert_tpu_matches_cpu(batch, gp, cp, capacity=256)
+
+
+@pytest.mark.parametrize("chunk_reads", [200])
+def test_streaming_oversized_group_matches_whole_file(tmp_path, chunk_reads):
+    """Streaming path with an oversized position group: output must
+    equal the whole-file path's."""
+    from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+
+    cfg_args = [
+        "simulate",
+        "--out",
+        str(tmp_path / "in.bam"),
+        "--molecules",
+        "160",
+        "--positions",
+        "2",
+        "--umi-error",
+        "0.03",
+        "--sorted",
+        "--seed",
+        "9",
+    ]
+    assert cli_main(cfg_args) == 0
+    common = [
+        "--config",
+        "config3",
+        "--backend",
+        "tpu",
+        "--capacity",
+        "128",
+    ]
+    assert (
+        cli_main(
+            [
+                "call",
+                str(tmp_path / "in.bam"),
+                "--out",
+                str(tmp_path / "whole.bam"),
+                *common,
+            ]
+        )
+        == 0
+    )
+    assert (
+        cli_main(
+            [
+                "call",
+                str(tmp_path / "in.bam"),
+                "--out",
+                str(tmp_path / "stream.bam"),
+                "--chunk-reads",
+                str(chunk_reads),
+                *common,
+            ]
+        )
+        == 0
+    )
+    from duplexumiconsensusreads_tpu.io import read_bam
+
+    _, rw = read_bam(str(tmp_path / "whole.bam"))
+    _, rs = read_bam(str(tmp_path / "stream.bam"))
+    assert len(rw) == len(rs)
+    np.testing.assert_array_equal(rw.pos, rs.pos)
+    np.testing.assert_array_equal(rw.seq, rs.seq)
+    np.testing.assert_array_equal(rw.qual, rs.qual)

@@ -159,9 +159,12 @@ def test_bucketing_preserves_reads_and_groups():
     assert not torn, f"families split across buckets: {torn[:3]}"
 
 
-def test_bucketing_giant_family_split():
-    """A single UMI family much larger than capacity must split into
-    multiple full buckets, not crash (deep families are routine in ctDNA)."""
+def test_bucketing_giant_family_jumbo():
+    """A single UMI family much larger than capacity gets ONE jumbo
+    pow2-capacity bucket (deep families are routine in ctDNA), keeping
+    consensus over the whole family intact."""
+    import warnings as _warnings
+
     from duplexumiconsensusreads_tpu.types import ReadBatch
 
     n, cap = 100, 32
@@ -169,11 +172,13 @@ def test_bucketing_giant_family_split():
     b.valid[:] = True
     b.bases[:] = 0
     b.pos_key[:] = 1000
-    with pytest.warns(UserWarning, match="exceeds capacity"):
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
         buckets = build_buckets(b, capacity=cap)
+    assert len(buckets) == 1
+    assert buckets[0].capacity == 128  # pow2(100)
     all_idx = np.concatenate([bk.read_index[bk.valid] for bk in buckets])
     assert sorted(all_idx) == list(range(n))
-    assert all(bk.valid.sum() <= cap for bk in buckets)
 
 
 def test_duplex_requires_paired_grouping():
