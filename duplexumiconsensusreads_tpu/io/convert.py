@@ -44,6 +44,13 @@ _POS_MASK = (1 << _POS_BITS) - 1
 
 _CHAR_TO_CODE = {c: i for i, c in enumerate("ACGT")}
 _CODE_TO_CHAR = "ACGTN."
+# u8 code -> ASCII byte, vectorised twin of _CODE_TO_CHAR (codes past
+# the alphabet render as '.', same as the scalar path would index-error
+# rather than emit — consensus UMIs only carry 0..3 in practice)
+_CODE_CHARS = np.full(256, ord("."), np.uint8)
+_CODE_CHARS[: len(_CODE_TO_CHAR)] = np.frombuffer(
+    _CODE_TO_CHAR.encode("ascii"), np.uint8
+)
 
 
 # Sentinel key for unmapped records (ref_id < 0). samtools places
@@ -237,7 +244,9 @@ def readbatch_to_records(
         next_pos = np.full(n, -1, np.int32)
         tlen = np.zeros(n, np.int32)
     return BamRecords(
-        names=(names or [f"read{i}" for i in idx]),
+        # fixed-width names give every record an identical byte layout,
+        # unlocking the uniform vectorised serializer (io/bam.py)
+        names=(names or [f"read{i:010d}" for i in idx]),
         flags=flags,
         ref_id=ref_id,
         pos=pos,
@@ -274,16 +283,43 @@ def consensus_to_records(
     n = len(idx)
     l = cons_base.shape[1]
     ref_id, pos = unpack_pos_key(fam_pos_key[idx])
-    names, umis, aux = [], [], []
-    for k, f in enumerate(idx):
-        rx = umi_codes_to_string(fam_umi[f], paired=duplex)
-        depth = cons_depth[f]
-        pos_depth = depth[depth > 0]
-        c_max = int(depth.max()) if depth.size else 0
-        c_min = int(pos_depth.min()) if pos_depth.size else 0
-        names.append(f"{name_prefix}:{int(ref_id[k])}:{int(pos[k])}:{int(f)}")
-        umis.append(rx)
-        aux.append(make_aux_z("RX", rx) + make_aux_i("cD", c_max) + make_aux_i("cM", c_min))
+    # vectorised RX strings: code matrix -> ASCII bytes (+ separator
+    # column for duplex pairs), one decode per batch instead of a
+    # Python join per record
+    u = fam_umi.shape[1]
+    chars = _CODE_CHARS[fam_umi[idx]] if n else np.zeros((0, u), np.uint8)
+    if duplex:
+        h = u // 2
+        sep = np.full((n, 1), ord(UMI_SEP), np.uint8)
+        chars = np.concatenate([chars[:, :h], sep, chars[:, h:]], axis=1)
+    w = chars.shape[1]
+    flat = chars.tobytes()
+    umis = [flat[k * w:(k + 1) * w].decode("ascii") for k in range(n)]
+    # vectorised depth stats: cD = max depth, cM = min positive depth
+    # (int64 up front: masking with the int64-max sentinel in the
+    # source's int32 dtype would wrap to -1 under NEP 50 promotion)
+    d = cons_depth[idx].astype(np.int64) if n else np.zeros((0, l), np.int64)
+    c_max = d.max(axis=1) if d.size else np.zeros(n, np.int64)
+    masked = np.where(d > 0, d, np.iinfo(np.int64).max)
+    c_min = np.where(
+        (d > 0).any(axis=1), masked.min(axis=1), 0
+    ) if d.size else np.zeros(n, np.int64)
+    cd_bytes = c_max.astype("<i4").tobytes()
+    cm_bytes = c_min.astype("<i4").tobytes()
+    names, aux = [], []
+    rid_l, pos_l, idx_l = ref_id.tolist(), pos.tolist(), idx.tolist()
+    for k in range(n):
+        # fixed-width fields -> identical record layout -> uniform
+        # vectorised serializer (io/bam.py)
+        names.append(f"{name_prefix}:{rid_l[k]}:{pos_l[k]:010d}:{idx_l[k]:07d}")
+        aux.append(
+            b"RXZ"
+            + umis[k].encode("ascii")
+            + b"\x00cDi"
+            + cd_bytes[4 * k : 4 * k + 4]
+            + b"cMi"
+            + cm_bytes[4 * k : 4 * k + 4]
+        )
     return BamRecords(
         names=names,
         flags=np.zeros(n, np.uint16),

@@ -56,6 +56,59 @@ def _parse_header_region(data: bytes, header_end: int) -> BamHeader:
     return BamHeader(text=text, ref_names=names, ref_lengths=lengths)
 
 
+def scan_region(lib, data: np.ndarray, what: str = "BAM"):
+    """One native scan pass over an uncompressed BAM byte region.
+
+    Returns (header_end, l_max, rx_max, rec_off). The offsets buffer is
+    sized at the minimum-record-size upper bound (block_size field 4B +
+    fixed fields 32B + 1 name byte) so counting and offset collection
+    don't walk the region twice.
+    """
+    header_end = ctypes.c_long()
+    l_max = ctypes.c_int()
+    rx_max = ctypes.c_int()
+    rec_off = np.empty(max(len(data) // 37, 1), np.int64)
+    n_rec = lib.dut_bam_scan(
+        data, len(data), ctypes.byref(header_end),
+        ctypes.byref(l_max), ctypes.byref(rx_max),
+        rec_off.ctypes.data_as(ctypes.c_void_p),
+    )
+    if n_rec < 0:
+        raise ValueError(f"{what}: malformed BAM")
+    return (
+        int(header_end.value),
+        int(l_max.value),
+        int(rx_max.value),
+        rec_off[:n_rec],
+    )
+
+
+def _gather_i32(data: np.ndarray, starts: np.ndarray, field_off: int) -> np.ndarray:
+    """Vectorised little-endian i32 reads at starts+field_off (unaligned)."""
+    idx = starts[:, None] + (field_off + np.arange(4))[None, :]
+    return np.ascontiguousarray(data[idx]).view("<i4")[:, 0]
+
+
+def region_pos_keys(data: np.ndarray, rec_off: np.ndarray) -> np.ndarray:
+    """Canonical fragment pos_key per record, straight from raw record
+    bytes — byte-identical to io.convert.records_pos_keys (the grouping
+    key the streaming chunker's family-integrity guarantee rides on)."""
+    if len(rec_off) == 0:
+        return np.zeros(0, np.int64)
+    body = rec_off + 4  # skip the block_size field
+    ref_id = _gather_i32(data, body, 0)
+    pos = _gather_i32(data, body, 4)
+    flag_word = _gather_i32(data, body, 12)  # n_cigar_op(16) | flag(16)
+    flags = (flag_word >> 16) & 0xFFFF
+    next_ref = _gather_i32(data, body, 20)
+    next_pos = _gather_i32(data, body, 24)
+    from duplexumiconsensusreads_tpu.io.bam import FLAG_PAIRED as _FP
+
+    paired_ok = ((flags & _FP) != 0) & (next_ref == ref_id) & (next_pos >= 0)
+    coord = np.where(paired_ok, np.minimum(pos, next_pos), pos)
+    return pack_pos_key(ref_id, coord)
+
+
 def read_bam_native(
     path: str, duplex: bool = True, n_threads: int | None = None
 ) -> tuple[BamHeader, ReadBatch, dict] | None:
@@ -80,30 +133,33 @@ def read_bam_native(
     else:
         data = raw.copy()
 
-    header_end = ctypes.c_long()
-    l_max = ctypes.c_int()
-    rx_max = ctypes.c_int()
-    # One scan pass: offsets buffer sized at the minimum-record-size
-    # upper bound (block_size field 4B + fixed fields 32B + 1 name byte)
-    # so counting and offset collection don't walk the file twice.
-    rec_off = np.empty(max(len(data) // 37, 1), np.int64)
-    n_rec = lib.dut_bam_scan(
-        data, len(data), ctypes.byref(header_end),
-        ctypes.byref(l_max), ctypes.byref(rx_max),
-        rec_off.ctypes.data_as(ctypes.c_void_p),
+    header_end, l_max, rx_max, rec_off = scan_region(lib, data, path)
+    header = _parse_header_region(data[:header_end].tobytes(), header_end)
+    batch, info = batch_from_offsets(
+        lib, data, rec_off, l_max, rx_max, duplex=duplex, n_threads=nt
     )
-    if n_rec < 0:
-        raise ValueError(f"{path}: malformed BAM")
-    rec_off = rec_off[:n_rec]
-    header = _parse_header_region(
-        data[: header_end.value].tobytes(), header_end.value
-    )
+    return header, batch, info
 
+
+def batch_from_offsets(
+    lib,
+    data: np.ndarray,
+    rec_off: np.ndarray,
+    l_max: int,
+    rx_max: int,
+    duplex: bool,
+    n_threads: int,
+) -> tuple[ReadBatch, dict]:
+    """Native fill + vectorised ReadBatch assembly for the records at
+    ``rec_off`` within ``data`` (uncompressed BAM bytes). l_max/rx_max
+    are capacity hints from scan_region (may cover a superset of the
+    records; widths are sliced back to the actual maxima below)."""
+    nt = n_threads
     # Allocation width stays >=1 so the ctypes buffers have real
     # storage; seq/qual are sliced back to the true l_max below so a
     # record-less / sequence-less file matches the Python codec's
     # zero-width batch exactly.
-    n, l, rx_cap = int(n_rec), max(int(l_max.value), 1), max(int(rx_max.value), 1)
+    n, l, rx_cap = len(rec_off), max(int(l_max), 1), max(int(rx_max), 1)
     flags = np.empty(n, np.uint16)
     ref_id = np.empty(n, np.int32)
     pos = np.empty(n, np.int32)
@@ -113,16 +169,20 @@ def read_bam_native(
     seq = np.empty((n, l), np.uint8)
     qual = np.empty((n, l), np.uint8)
     rx = np.empty((n, rx_cap), np.uint8)
+    rec_off = np.ascontiguousarray(rec_off)
     rc = lib.dut_bam_fill(
         data, len(data), rec_off, n, l, rx_cap, nt,
         flags, ref_id, pos, next_ref, next_pos, lseq, seq, qual, rx,
     )
     if rc != 0:
-        raise ValueError(f"{path}: BAM record fill failed")
+        raise ValueError("BAM record fill failed")
 
-    if int(l_max.value) < l:
-        seq = seq[:, : int(l_max.value)]
-        qual = qual[:, : int(l_max.value)]
+    # width = the actual max over THESE records (a superset capacity
+    # hint from scan_region must not widen the batch)
+    actual_l = int(lseq.max()) if n else 0
+    if actual_l < l:
+        seq = seq[:, :actual_l]
+        qual = qual[:, :actual_l]
 
     # --- vectorised ReadBatch assembly (contract: io/convert.py) ---
     # Mirror the Python codec's semantics exactly: flag-excluded reads
@@ -192,4 +252,4 @@ def read_bam_native(
         "umi_len": umi_len,
         "native": True,
     }
-    return header, batch, info
+    return batch, info

@@ -54,6 +54,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dut_bgzf_compress.argtypes = [
         _c_u8p, ctypes.c_long, _c_u8p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
     ]
+    lib.dut_bam_chain.restype = ctypes.c_long
+    lib.dut_bam_chain.argtypes = [
+        _c_u8p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long),
+    ]
     lib.dut_bam_scan.restype = ctypes.c_long
     lib.dut_bam_scan.argtypes = [
         _c_u8p, ctypes.c_long,

@@ -228,6 +228,27 @@ long dut_bgzf_compress(const uint8_t* data, long n, uint8_t* out,
 
 // ----------------------------------------------------------------- BAM
 
+// Walk the record chain from `off`: count complete records (up to
+// max_records) using only the block_size prefixes, no field parsing.
+// Sets *end_off to the byte offset just past the last complete record.
+// Returns the record count, or -1 on a malformed block_size. The
+// streaming reader uses this to slice whole-record byte runs off its
+// rolling buffer without a per-record Python loop.
+long dut_bam_chain(const uint8_t* data, long n, long off, long max_records,
+                   long* end_off) {
+  long count = 0;
+  while (count < max_records && off + 4 <= n) {
+    int32_t bsz;
+    std::memcpy(&bsz, data + off, 4);
+    if (bsz < 33) { *end_off = off; return -1; }  // report the bad record
+    if (off + 4 + (long)bsz > n) break;  // trailing partial record
+    off += 4 + bsz;
+    count++;
+  }
+  *end_off = off;
+  return count;
+}
+
 // Scan decompressed BAM: locate end of header, count records, find max
 // l_seq and max RX length. Fills rec_off (record start offsets, incl.
 // the 4-byte block_size field) when non-null (must have capacity from a

@@ -132,6 +132,7 @@ class BamStreamReader:
 
             native_lib = get_lib()
             n_threads = min(os.cpu_count() or 1, 16)
+        self._native_lib = native_lib
         self._f = open(path, "rb")
         self._gen = _iter_bgzf_stream(
             self._f, read_size, native_lib=native_lib, n_threads=n_threads
@@ -187,6 +188,8 @@ class BamStreamReader:
 
     def read_raw_records(self, n: int) -> bytes | None:
         """Raw bytes of up to n whole records; None at EOF."""
+        if self._native_lib is not None:
+            return self._read_raw_records_native(n)
         count = 0
         off = 0
         while count < n:
@@ -209,19 +212,95 @@ class BamStreamReader:
         del self._buf[:off]
         return out
 
+    def _read_raw_records_native(self, n: int) -> bytes | None:
+        """read_raw_records via the C record-chain walker: no
+        per-record Python loop (the walk was the streaming reader's
+        top host cost at scale)."""
+        import ctypes
+
+        lib = self._native_lib
+        count = 0
+        off = 0
+        while count < n:
+            # the frombuffer view must not outlive the iteration: a live
+            # export would block the bytearray resize below
+            buf_arr = np.frombuffer(self._buf, np.uint8)
+            end = ctypes.c_long()
+            c = lib.dut_bam_chain(
+                buf_arr, len(buf_arr), off, n - count, ctypes.byref(end)
+            )
+            del buf_arr
+            if c < 0:
+                bad = int(end.value)  # chain reports the offending record
+                bsz = struct.unpack_from("<i", self._buf, bad)[0] if len(
+                    self._buf
+                ) >= bad + 4 else -1
+                raise ValueError(f"malformed BAM: record block_size {bsz}")
+            count += c
+            off = int(end.value)
+            if count >= n:
+                break
+            if not self._fill(len(self._buf) + 1):
+                break  # EOF: return what we have; partial tail errors next call
+        if count == 0:
+            if self._buf and self._eof:
+                raise ValueError(
+                    "truncated BAM: trailing partial record at EOF"
+                )
+            return None
+        out = bytes(self._buf[:off])
+        del self._buf[:off]
+        return out
+
 
 def _records_from_raw(header: BamHeader, raw: bytes) -> BamRecords:
     """Parse a raw record stream by prepending a minimal header."""
-    shell = bytearray()
-    shell += b"BAM\x01"
-    text = header.text.encode()
-    shell += struct.pack("<i", len(text)) + text
-    shell += struct.pack("<i", len(header.ref_names))
-    for name, length in zip(header.ref_names, header.ref_lengths):
-        nb = name.encode() + b"\x00"
-        shell += struct.pack("<i", len(nb)) + nb + struct.pack("<i", length)
-    _, recs = parse_bam(bytes(shell) + raw)
+    _, recs = parse_bam(_header_shell(header) + raw)
     return recs
+
+
+def _resolve_chunk_boundary(keys: np.ndarray, prev_last):
+    """THE chunk-boundary rule, shared by the Python and native chunk
+    iterators (their boundaries must stay byte-identical — checkpoint
+    manifests key chunks by index). On the combined buffer's pos_keys,
+    returns (cut, new_prev_last):
+
+      cut == 0         entire buffer is one position group: keep growing
+      cut == len(keys) unmapped sentinel tail: flush everything, no
+                       hold-back (sentinel keys are never groupable)
+      otherwise        yield records [:cut], hold back the final group
+
+    Raises on sort-contract violations (the one shared wording).
+    """
+    if len(keys) > 1 and (np.diff(keys) < 0).any():
+        i = int(np.nonzero(np.diff(keys) < 0)[0][0])
+        raise ValueError(
+            "input violates the streaming sort contract: pos_key "
+            f"decreases at record ~{i} ({keys[i]} -> "
+            f"{keys[i+1]}). Streaming needs non-decreasing "
+            "fragment keys (template-coordinate order for paired "
+            "data); use whole-file mode (--chunk-reads 0) for "
+            "unsorted input."
+        )
+    if prev_last is not None and len(keys) and keys[0] <= prev_last:
+        raise ValueError(
+            "input violates the streaming sort contract across a "
+            "chunk boundary (pos_key repeats after being flushed)"
+        )
+    # Unmapped EOF tail: sentinel-key records are never groupable (the
+    # FLAG filter invalidates them downstream), so family integrity
+    # doesn't apply — flush immediately. Carrying them would be
+    # unbounded: the whole tail shares ONE pos_key. Later all-sentinel
+    # chunks must pass the repeat check, but any MAPPED key after the
+    # tail is a sort violation and must trip it.
+    if keys[-1] == UNMAPPED_POS_KEY:
+        return len(keys), UNMAPPED_POS_KEY - 1
+    last = keys[-1]
+    keep = np.nonzero(keys != last)[0]
+    if len(keep) == 0:
+        return 0, prev_last
+    cut = int(keep[-1]) + 1
+    return cut, keys[cut - 1]
 
 
 def iter_record_chunks(path: str, chunk_reads: int):
@@ -252,50 +331,101 @@ def iter_record_chunks(path: str, chunk_reads: int):
             if carry is not None and len(carry):
                 recs = _concat_records(carry, recs)
             batch_pos = _rec_pos_keys(recs)
-            if len(batch_pos) > 1 and (np.diff(batch_pos) < 0).any():
-                i = int(np.nonzero(np.diff(batch_pos) < 0)[0][0])
-                raise ValueError(
-                    "input violates the streaming sort contract: pos_key "
-                    f"decreases at record ~{i} ({batch_pos[i]} -> "
-                    f"{batch_pos[i+1]}). Streaming needs non-decreasing "
-                    "fragment keys (template-coordinate order for paired "
-                    "data); use whole-file mode (--chunk-reads 0) for "
-                    "unsorted input."
-                )
-            if prev_last is not None and len(batch_pos) and batch_pos[0] <= prev_last:
-                raise ValueError(
-                    "input violates the streaming sort contract across a "
-                    "chunk boundary (pos_key repeats after being flushed)"
-                )
-            # Unmapped EOF tail: sentinel-key records are never groupable
-            # (the FLAG filter invalidates them downstream), so family
-            # integrity doesn't apply — flush the chunk immediately.
-            # Carrying them would be unbounded: the whole tail shares ONE
-            # pos_key, so the hold-back logic below would accumulate it
-            # in `carry` with quadratic re-concatenation.
-            if batch_pos[-1] == UNMAPPED_POS_KEY:
-                carry = None
-                # later all-sentinel chunks must pass the repeat check,
-                # but any MAPPED key after the tail is a sort violation
-                # and must trip it (mapped-after-unmapped would split a
-                # family with no hold-back)
-                prev_last = UNMAPPED_POS_KEY - 1
-                yield header, recs
-                continue
-            # hold back the final pos group (pos of the last record)
-            last = batch_pos[-1]
-            keep = np.nonzero(batch_pos != last)[0]
-            if len(keep) == 0:
+            cut, prev_last = _resolve_chunk_boundary(batch_pos, prev_last)
+            if cut == 0:
                 carry = recs  # entire chunk is one group; keep growing
                 continue
-            cut = int(keep[-1]) + 1
+            if cut == len(recs):  # sentinel tail: flush, no hold-back
+                carry = None
+                yield header, recs
+                continue
             carry = _slice_records(recs, cut, len(recs))
-            prev_last = batch_pos[cut - 1]
             yield header, _slice_records(recs, 0, cut)
     finally:
         reader.close()
 
 
+
+
+def _header_shell(header: BamHeader) -> bytes:
+    shell = bytearray()
+    shell += b"BAM\x01"
+    text = header.text.encode()
+    shell += struct.pack("<i", len(text)) + text
+    shell += struct.pack("<i", len(header.ref_names))
+    for name, length in zip(header.ref_names, header.ref_lengths):
+        nb = name.encode() + b"\x00"
+        shell += struct.pack("<i", len(nb)) + nb + struct.pack("<i", length)
+    return bytes(shell)
+
+
+def iter_batch_chunks(path: str, chunk_reads: int, duplex: bool):
+    """Yield (header, ReadBatch, info) chunks with the family-integrity
+    hold-back of iter_record_chunks, but parsed NATIVELY: record fields
+    go straight from raw BAM bytes into NumPy arrays (io/native_reader),
+    bypassing the per-record Python loop — the difference between the
+    host starving the device and keeping up at 200M-read scale.
+
+    Chunk boundaries are byte-identical to iter_record_chunks' (same
+    hold-back and sentinel-flush rules on the same pos_keys), so
+    checkpoint manifests remain valid whichever path produced them.
+    Falls back to the pure-Python iterator when the native library is
+    unavailable or DUT_NO_NATIVE is set.
+    """
+    lib = None
+    if not os.environ.get("DUT_NO_NATIVE"):
+        from duplexumiconsensusreads_tpu.native import get_lib
+
+        lib = get_lib()
+    if lib is None:
+        for header, recs in iter_record_chunks(path, chunk_reads):
+            batch, info = records_to_readbatch(recs, duplex=duplex)
+            yield header, batch, info
+        return
+
+    from duplexumiconsensusreads_tpu.io.native_reader import (
+        batch_from_offsets,
+        region_pos_keys,
+        scan_region,
+    )
+
+    nt = min(os.cpu_count() or 1, 16)
+    reader = BamStreamReader(path)
+    header = reader.header
+    shell = _header_shell(header)
+    carry = b""
+    prev_last = None
+    try:
+        while True:
+            raw = reader.read_raw_records(chunk_reads)
+            if raw is None:
+                if carry:
+                    data = np.frombuffer(shell + carry, np.uint8)
+                    he, lm, rm, off = scan_region(lib, data, path)
+                    yield header, *batch_from_offsets(
+                        lib, data, off, lm, rm, duplex=duplex, n_threads=nt
+                    )
+                return
+            buf = carry + raw
+            data = np.frombuffer(shell + buf, np.uint8)
+            he, lm, rm, rec_off = scan_region(lib, data, path)
+            keys = region_pos_keys(data, rec_off)
+            cut, prev_last = _resolve_chunk_boundary(keys, prev_last)
+            if cut == 0:
+                carry = buf  # entire buffer is one group; keep growing
+                continue
+            if cut == len(keys):  # sentinel tail: flush, no hold-back
+                carry = b""
+                yield header, *batch_from_offsets(
+                    lib, data, rec_off, lm, rm, duplex=duplex, n_threads=nt
+                )
+                continue
+            carry = buf[int(rec_off[cut]) - len(shell):]
+            yield header, *batch_from_offsets(
+                lib, data, rec_off[:cut], lm, rm, duplex=duplex, n_threads=nt
+            )
+    finally:
+        reader.close()
 
 
 def _slice_records(recs: BamRecords, a: int, b: int) -> BamRecords:
@@ -505,7 +635,9 @@ def stream_call_consensus(
 
     n_skipped = 0
     try:
-        for k, (header, recs) in enumerate(iter_record_chunks(in_path, chunk_reads)):
+        for k, (header, batch, info) in enumerate(
+            iter_batch_chunks(in_path, chunk_reads, duplex)
+        ):
             header_out = header_out or header
             rep.n_chunks += 1
             if ckpt and str(k) in ckpt.done:
@@ -516,8 +648,7 @@ def stream_call_consensus(
             # run's report is internally consistent (n_records matches
             # n_valid_reads + drops); skipped chunks show up in
             # n_chunks_skipped and the final n_consensus instead
-            rep.n_records += len(recs)
-            batch, info = records_to_readbatch(recs, duplex=duplex)
+            rep.n_records += info["n_records"]
             rep.n_valid_reads += info["n_valid"]
             rep.n_dropped += (
                 info["n_dropped_no_umi"]

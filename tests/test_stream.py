@@ -93,6 +93,40 @@ class TestStreamReader:
         assert len(chunks) == 1  # one giant group, one chunk
         assert len(chunks[0][1]) == len(recs)
 
+    @pytest.mark.parametrize("paired_end", [False, True])
+    def test_iter_batch_chunks_native_matches_python(
+        self, tmp_path, monkeypatch, paired_end
+    ):
+        """The native chunk iterator must produce bit-identical batches
+        AND identical chunk boundaries to the per-record Python path
+        (checkpoint manifests depend on the boundary equivalence)."""
+        from duplexumiconsensusreads_tpu.native import native_available
+        from duplexumiconsensusreads_tpu.runtime.stream import iter_batch_chunks
+
+        if not native_available():
+            pytest.skip("native loader unavailable")
+        path = str(tmp_path / "in.bam")
+        cfg = SimConfig(n_molecules=90, n_positions=10, umi_error=0.02, seed=7)
+        simulated_bam(cfg, path=path, sort=True, paired_end=paired_end)
+
+        def drain():
+            return [
+                (b, i) for _, b, i in iter_batch_chunks(path, 83, duplex=True)
+            ]
+
+        nat = drain()
+        monkeypatch.setenv("DUT_NO_NATIVE", "1")
+        py = drain()
+        assert len(nat) == len(py)
+        for (bn, infn), (bp, infp) in zip(nat, py):
+            assert infn["n_valid"] == infp["n_valid"]
+            np.testing.assert_array_equal(bn.pos_key, bp.pos_key)
+            np.testing.assert_array_equal(bn.umi, bp.umi)
+            np.testing.assert_array_equal(bn.bases, bp.bases)
+            np.testing.assert_array_equal(bn.quals, bp.quals)
+            np.testing.assert_array_equal(bn.strand_ab, bp.strand_ab)
+            np.testing.assert_array_equal(bn.valid, bp.valid)
+
 
 class TestStreamedCall:
     def _call(self, path, out, **kw):
