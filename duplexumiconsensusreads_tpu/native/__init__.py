@@ -59,6 +59,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _c_u8p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
         ctypes.POINTER(ctypes.c_long),
     ]
+    lib.dut_bam_chain_offsets.restype = ctypes.c_long
+    lib.dut_bam_chain_offsets.argtypes = [
+        _c_u8p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long), ctypes.c_void_p,
+    ]
     lib.dut_bam_scan.restype = ctypes.c_long
     lib.dut_bam_scan.argtypes = [
         _c_u8p, ctypes.c_long,

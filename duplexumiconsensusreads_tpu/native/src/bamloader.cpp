@@ -233,20 +233,28 @@ long dut_bgzf_compress(const uint8_t* data, long n, uint8_t* out,
 // Sets *end_off to the byte offset just past the last complete record.
 // Returns the record count, or -1 on a malformed block_size. The
 // streaming reader uses this to slice whole-record byte runs off its
-// rolling buffer without a per-record Python loop.
-long dut_bam_chain(const uint8_t* data, long n, long off, long max_records,
-                   long* end_off) {
+// rolling buffer without a per-record Python loop. rec_off, when
+// non-null (capacity >= max_records), receives each record's offset —
+// the linear indexer's per-record walk.
+long dut_bam_chain_offsets(const uint8_t* data, long n, long off,
+                           long max_records, long* end_off, long* rec_off) {
   long count = 0;
   while (count < max_records && off + 4 <= n) {
     int32_t bsz;
     std::memcpy(&bsz, data + off, 4);
     if (bsz < 33) { *end_off = off; return -1; }  // report the bad record
     if (off + 4 + (long)bsz > n) break;  // trailing partial record
+    if (rec_off) rec_off[count] = off;
     off += 4 + bsz;
     count++;
   }
   *end_off = off;
   return count;
+}
+
+long dut_bam_chain(const uint8_t* data, long n, long off, long max_records,
+                   long* end_off) {
+  return dut_bam_chain_offsets(data, n, off, max_records, end_off, nullptr);
 }
 
 // Scan decompressed BAM: locate end of header, count records, find max

@@ -96,6 +96,30 @@ def spec_for_buckets(
     )
 
 
+def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
+    """Executed matmul FLOPs of ONE fused_pipeline call on an (r, l)
+    bucket with b UMI code columns — the denominator-side input of the
+    benchmark's MFU accounting. Counts the three MXU-heavy terms
+    (Hamming one-hot GEMM, reachability closure squarings, ssc segment
+    GEMM); elementwise/VPU work is excluded by design, so the number is
+    a lower bound on executed work and MFU is conservative.
+    """
+    g, c = spec.grouping, spec.consensus
+    u = spec.u_max or r
+    fl = 0.0
+    if g.strategy == "adjacency":
+        fl += 2.0 * u * u * 4 * b  # matches = onehot @ onehot.T
+        fl += max(1, (u - 1).bit_length()) * 2.0 * float(u) ** 3  # closure
+    passes = 2 if c.error_model == "cycle" else 1
+    if spec.ssc_method == "matmul":
+        f = (spec.f_max or r) + 1
+        fl += passes * 2.0 * f * r * (5 * l + 1)  # dense one-hot GEMM
+    else:
+        # pallas/segment perform ~the useful reduction FLOPs only
+        fl += passes * 2.0 * r * (5 * l + 1)
+    return fl
+
+
 @partial(jax.jit, static_argnames=("spec",))
 def fused_pipeline(
     pos: jnp.ndarray,  # (R,) i32 bucket-local dense position ids
@@ -172,15 +196,32 @@ def fused_pipeline(
     else:
         raise ValueError(f"unknown consensus mode {c.mode!r}")
 
+    # Per-family depth stats computed ON DEVICE: the writers only need
+    # cD (max depth) and cM (min positive depth) per consensus, so the
+    # executors fetch two (F,) vectors instead of the padded (F, L)
+    # depth matrix — on a tunneled chip the transfer is the bottleneck.
+    d_max = out_d.max(axis=1)
+    pos_d = out_d > 0
+    d_min_pos = jnp.where(
+        pos_d.any(axis=1),
+        jnp.where(pos_d, out_d, jnp.iinfo(jnp.int32).max).min(axis=1),
+        0,
+    )
     return {
         "family_id": fam,
         "molecule_id": mol,
         "n_families": n_fam,
         "n_molecules": n_mol,
         "n_overflow": n_over,
-        "cons_base": out_b,
-        "cons_qual": out_q,
+        # u8/u16 on device: base codes fit u8 (0..5), quals fit u8
+        # (<= max_qual), depth stats fit u16-range values but stay i32
+        # vectors (tiny) — 8x fewer bytes over the wire than the i32
+        # (F, L) tensors they replace
+        "cons_base": out_b.astype(jnp.uint8),
+        "cons_qual": out_q.astype(jnp.uint8),
         "cons_depth": out_d,
+        "depth_max": d_max,
+        "depth_min_pos": d_min_pos,
         "cons_valid": out_v,
     }
 

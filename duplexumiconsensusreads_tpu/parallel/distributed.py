@@ -72,3 +72,82 @@ def host_tile_range(
     n = jax.process_count() if num_processes is None else num_processes
     per = -(-n_tiles // n)
     return range(min(pid * per, n_tiles), min((pid + 1) * per, n_tiles))
+
+
+def host_input_range(
+    index,
+    process_id: int | None = None,
+    num_processes: int | None = None,
+):
+    """This host's share of a BAM, as a streaming input_range.
+
+    The BamLinearIndex's sampled entries are the tiles host_tile_range
+    partitions; each host's tile run maps to (start_voffset, key_lo,
+    key_hi) — a BGZF seek point plus a pos_key half-open interval —
+    consumable by stream_call_consensus(input_range=...). Returns None
+    for an idle host (empty or degenerate share). The ranges of all
+    hosts partition the key space exactly: every family lands on
+    exactly one host (families never span pos_keys).
+    """
+    n_tiles = len(index.pos_key)
+    if n_tiles == 0:
+        pid = jax.process_index() if process_id is None else process_id
+        # record-less file: host 0 runs the normal (no-seek) path so the
+        # output still gets a header; everyone else is idle
+        return (None, None, None) if pid == 0 else None
+    r = host_tile_range(n_tiles, process_id, num_processes)
+    if r.start >= r.stop:
+        return None
+    key_lo = int(index.pos_key[r.start]) if r.start > 0 else None
+    key_hi = int(index.pos_key[r.stop]) if r.stop < n_tiles else None
+    if key_lo is not None and key_hi is not None and key_lo >= key_hi:
+        return None  # a giant same-key run swallowed this host's share
+    start = index.start_voffset(key_lo)
+    return (start, key_lo, key_hi)
+
+
+def multihost_call(
+    in_path: str,
+    out_path: str,
+    grouping,
+    consensus,
+    index_path: str | None = None,
+    process_id: int | None = None,
+    num_processes: int | None = None,
+    index_every: int = 100_000,
+    **stream_kw,
+):
+    """Run this host's partition of a consensus call.
+
+    Each host writes ``out_path`` (conventionally suffixed with the
+    host id by the caller); concatenating the per-host outputs in host
+    order yields the whole-file result. Builds/loads the linear index
+    on demand (host 0 of a pod should pre-build it; building is a
+    sequential scan).
+    """
+    from duplexumiconsensusreads_tpu.io.index import (
+        INDEX_SUFFIX,
+        BamLinearIndex,
+        build_linear_index,
+    )
+    from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+
+    idx_path = index_path or in_path + INDEX_SUFFIX
+    if os.path.exists(idx_path):
+        index = BamLinearIndex.load(idx_path)
+    else:
+        index = build_linear_index(in_path, every=index_every)
+        index.save(idx_path)
+    rng = host_input_range(index, process_id, num_processes)
+    pid = jax.process_index() if process_id is None else process_id
+    if rng is None:
+        return None  # idle host: no records in range
+    return stream_call_consensus(
+        in_path,
+        out_path,
+        grouping,
+        consensus,
+        input_range=rng,
+        name_tag=f"h{pid}_",
+        **stream_kw,
+    )

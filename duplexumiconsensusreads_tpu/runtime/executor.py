@@ -109,10 +109,12 @@ def scatter_bucket_outputs(
 ):
     """Map per-bucket device outputs back to source-batch coordinates.
 
-    Returns (cons_base, cons_qual, cons_depth, fam_pos, fam_umi)
+    Returns (cons_base, cons_qual, cons_dstats, fam_pos, fam_umi)
     concatenated over buckets, containing only valid consensus rows
     (rows past each bucket's real family/molecule count are dropped even
-    if a permissive min_reads left them flagged valid).
+    if a permissive min_reads left them flagged valid). cons_dstats is
+    the (n, 2) [cD, cM] table the writers need — the full (F, L) depth
+    matrix never leaves the device in production.
     Shared by the whole-file and streaming executors so their outputs
     cannot drift.
     """
@@ -137,7 +139,12 @@ def scatter_bucket_outputs(
         )
         all_b.append(out["cons_base"][bi][keep])
         all_q.append(out["cons_qual"][bi][keep])
-        all_d.append(out["cons_depth"][bi][keep])
+        all_d.append(
+            np.stack(
+                [out["depth_max"][bi][keep], out["depth_min_pos"][bi][keep]],
+                axis=1,
+            )
+        )
         all_pos.append(fam_pos[keep])
         all_umi.append(fam_umi[keep])
     return (
@@ -147,6 +154,40 @@ def scatter_bucket_outputs(
         np.concatenate(all_pos),
         np.concatenate(all_umi),
     )
+
+
+# Device outputs the executors actually consume. cons_depth (the padded
+# (F, L) matrix) and n_overflow are deliberately absent: on a tunneled
+# chip the transfer, not the compute, is the streaming bottleneck.
+FETCH_KEYS = (
+    "family_id",
+    "molecule_id",
+    "n_families",
+    "n_molecules",
+    "cons_valid",
+    "cons_base",
+    "cons_qual",
+    "depth_max",
+    "depth_min_pos",
+)
+
+
+def start_fetch(out: dict) -> dict:
+    """Select FETCH_KEYS and start their device->host copies NOW, so
+    every transfer is in flight before any is awaited (per-fetch tunnel
+    latency would otherwise serialise)."""
+    sel = {k: out[k] for k in FETCH_KEYS}
+    for v in sel.values():
+        try:
+            v.copy_to_host_async()
+        except AttributeError:  # already a NumPy array (CPU tests)
+            pass
+    return sel
+
+
+def fetch_outputs(out: dict) -> dict:
+    """start_fetch + blocking conversion to host NumPy arrays."""
+    return {k: np.asarray(v) for k, v in start_fetch(out).items()}
 
 
 def partition_buckets(
@@ -249,13 +290,15 @@ def call_batch_tpu(
     pending = []
     for cbuckets, cspec in part:
         stacked = stack_buckets(cbuckets, multiple_of=n_data)
-        pending.append((cbuckets, sharded_pipeline(stacked, cspec, mesh)))
+        pending.append(
+            (cbuckets, start_fetch(sharded_pipeline(stacked, cspec, mesh)))
+        )
     rep.seconds["device_dispatch"] = round(time.time() - t0, 4)
 
     t0 = time.time()
     parts = []
     for cbuckets, out in pending:
-        out = {k: np.asarray(v) for k, v in out.items()}
+        out = fetch_outputs(out)
         n_real = len(cbuckets)
         rep.n_families += int(out["n_families"][:n_real].sum())
         rep.n_molecules += int(out["n_molecules"][:n_real].sum())
@@ -296,10 +339,12 @@ def call_batch_cpu(
         n_fam=n_out,
     )
     cv = np.asarray(cons.valid, bool)
+    from duplexumiconsensusreads_tpu.io.convert import depth_stats
+
     return (
         np.asarray(cons.bases)[cv],
         np.asarray(cons.quals)[cv],
-        np.asarray(cons.depth)[cv],
+        depth_stats(np.asarray(cons.depth))[cv],
         np.ones(int(cv.sum()), bool),
         fam_pos[cv],
         fam_umi[cv],

@@ -343,6 +343,136 @@ def test_shards_cleaned_without_checkpoint(tmp_path):
     assert len(recs) > 0
 
 
+class TestFaultRecovery:
+    def _sim(self, tmp_path):
+        return _sorted_bam(tmp_path, n_mol=80, n_positions=8)
+
+    def test_transient_dispatch_failures_recovered(self, tmp_path, monkeypatch):
+        """Kill several device dispatches; the run must complete with
+        output identical to a fault-free run (VERDICT r1 item 10)."""
+        import duplexumiconsensusreads_tpu.parallel.sharded as sharded
+
+        path, _, _ = self._sim(tmp_path)
+        gp = GroupingParams(strategy="adjacency", paired=True)
+        cp = ConsensusParams(mode="duplex")
+        kw = dict(capacity=128, chunk_reads=120, max_retries=2)
+
+        ref = str(tmp_path / "ref.bam")
+        rep0 = stream_call_consensus(path, ref, gp, cp, **kw)
+
+        real = sharded.sharded_pipeline
+        calls = {"n": 0}
+
+        def flaky(*a, **k):
+            calls["n"] += 1
+            if calls["n"] in (2, 3, 5):  # transient outage
+                raise RuntimeError("injected device failure")
+            return real(*a, **k)
+
+        monkeypatch.setattr(sharded, "sharded_pipeline", flaky)
+        monkeypatch.setattr(
+            "duplexumiconsensusreads_tpu.runtime.stream.time.sleep",
+            lambda s: None,
+            raising=False,
+        )
+        out = str(tmp_path / "faulty.bam")
+        rep = stream_call_consensus(path, out, gp, cp, **kw)
+        assert rep.n_retries >= 1
+        assert rep.n_consensus == rep0.n_consensus
+        _, r_ref = read_bam(ref)
+        _, r_out = read_bam(out)
+        np.testing.assert_array_equal(r_ref.pos, r_out.pos)
+        np.testing.assert_array_equal(r_ref.seq, r_out.seq)
+        np.testing.assert_array_equal(r_ref.qual, r_out.qual)
+
+    def test_poisoned_class_isolated_per_bucket(self, tmp_path, monkeypatch):
+        """A class whose stacked dispatch always fails must fall back to
+        bucket-by-bucket dispatch and still finish."""
+        import duplexumiconsensusreads_tpu.parallel.sharded as sharded
+
+        path, _, _ = self._sim(tmp_path)
+        gp = GroupingParams(strategy="adjacency", paired=True)
+        cp = ConsensusParams(mode="duplex")
+        real = sharded.sharded_pipeline
+
+        def multi_bucket_fails(stacked, spec, mesh, *a, **k):
+            if stacked["pos"].shape[0] > 1:
+                raise RuntimeError("injected: stacked dispatch down")
+            return real(stacked, spec, mesh, *a, **k)
+
+        monkeypatch.setattr(sharded, "sharded_pipeline", multi_bucket_fails)
+        monkeypatch.setattr(
+            "duplexumiconsensusreads_tpu.runtime.stream.time.sleep",
+            lambda s: None,
+            raising=False,
+        )
+        out = str(tmp_path / "iso.bam")
+        rep = stream_call_consensus(
+            path, out, gp, cp, capacity=128, chunk_reads=120,
+            max_retries=1, n_devices=1,
+        )
+        assert rep.n_retries >= 1
+        _, recs = read_bam(out)
+        assert len(recs) == rep.n_consensus > 0
+
+    def test_permanent_failure_raises(self, tmp_path, monkeypatch):
+        import duplexumiconsensusreads_tpu.parallel.sharded as sharded
+
+        path, _, _ = self._sim(tmp_path)
+        gp = GroupingParams(strategy="exact", paired=True)
+        cp = ConsensusParams(mode="duplex")
+
+        def dead(*a, **k):
+            raise RuntimeError("injected: device gone")
+
+        monkeypatch.setattr(sharded, "sharded_pipeline", dead)
+        monkeypatch.setattr(
+            "duplexumiconsensusreads_tpu.runtime.stream.time.sleep",
+            lambda s: None,
+            raising=False,
+        )
+        with pytest.raises(RuntimeError, match="giving up"):
+            stream_call_consensus(
+                path, str(tmp_path / "x.bam"), gp, cp,
+                capacity=128, chunk_reads=120, max_retries=1,
+            )
+
+    def test_auto_checkpoint_resume_after_crash(self, tmp_path, monkeypatch):
+        """Chunked runs checkpoint by default: crash mid-run, rerun with
+        resume=True and no explicit checkpoint path -> finished chunks
+        are skipped and output is complete."""
+        import os
+
+        path, _, _ = _sorted_bam(tmp_path, n_mol=120, n_positions=12)
+        gp = GroupingParams(strategy="adjacency", paired=True)
+        cp = ConsensusParams(mode="duplex")
+        out = str(tmp_path / "auto.bam")
+        kw = dict(capacity=128, chunk_reads=100)
+
+        boom = {"after": 2}
+
+        def crashing_progress(k, rep):
+            if rep.n_chunks >= boom["after"]:
+                raise KeyboardInterrupt("injected crash")
+
+        with pytest.raises(KeyboardInterrupt):
+            stream_call_consensus(
+                path, out, gp, cp, progress=crashing_progress, **kw
+            )
+        assert os.path.exists(out + ".ckpt")  # auto checkpoint persisted
+
+        rep = stream_call_consensus(path, out, gp, cp, resume=True, **kw)
+        assert rep.n_chunks_skipped >= 1
+        assert not os.path.exists(out + ".ckpt")  # cleaned on success
+        assert not os.path.exists(out + ".shards")
+        ref = str(tmp_path / "ref.bam")
+        rep0 = stream_call_consensus(path, ref, gp, cp, **kw)
+        _, r_ref = read_bam(ref)
+        _, r_out = read_bam(out)
+        assert rep.n_consensus == rep0.n_consensus
+        np.testing.assert_array_equal(r_ref.seq, r_out.seq)
+
+
 def test_cli_stream_and_validate(tmp_path):
     bam = str(tmp_path / "s.bam")
     truth = str(tmp_path / "t.npz")
