@@ -128,7 +128,22 @@ def build_buckets(
     pos = np.asarray(batch.pos_key)[idx_all]
     words = pack_umi_words64(np.asarray(batch.umi)[idx_all])  # any UMI length
     w = words.shape[1]
-    order = np.lexsort((*[words[:, i] for i in range(w - 1, -1, -1)], pos))
+    order = None
+    if w == 1 and len(pos) and (np.diff(pos) >= 0).all():
+        # fast path for streaming chunks (pos already non-decreasing,
+        # single-word UMIs): one packed-key argsort instead of a
+        # multi-key lexsort. Dense pos ids come from run boundaries;
+        # the UMI word's payload sits in the TOP 2*31 bits, so shift it
+        # down to its true width before packing beside the dense id.
+        dense = np.cumsum(np.r_[True, pos[1:] != pos[:-1]]) - 1
+        u_bits = 2 * batch.umi_len
+        if u_bits + int(dense[-1] + 1).bit_length() <= 63:
+            keyv = (dense.astype(np.int64) << u_bits) | (
+                words[:, 0] >> (62 - u_bits) if u_bits else 0
+            )
+            order = np.argsort(keyv, kind="stable")
+    if order is None:
+        order = np.lexsort((*[words[:, i] for i in range(w - 1, -1, -1)], pos))
     idx_sorted = idx_all[order]
     pos_s = pos[order]
     words_s = words[order]

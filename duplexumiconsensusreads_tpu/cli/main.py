@@ -43,21 +43,27 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("input", help="input BAM (or ReadBatch .npz)")
     c.add_argument("-o", "--output", required=True, help="output consensus BAM")
     c.add_argument("--config", choices=sorted(CONFIG_PRESETS), help="benchmark preset")
-    c.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
+    c.add_argument(
+        "--config-file",
+        help="TOML or JSON file of call settings (same keys as the "
+        "flags, underscored); precedence: explicit flag > file > "
+        "--config preset > default",
+    )
+    c.add_argument("--backend", choices=["tpu", "cpu"], default=None)
     c.add_argument("--grouping", choices=["exact", "adjacency"], default=None)
     c.add_argument("--mode", choices=["ss", "duplex"], default=None)
     c.add_argument("--error-model", choices=["none", "cycle"], default=None)
-    c.add_argument("--max-hamming", type=int, default=1)
-    c.add_argument("--min-reads", type=int, default=1)
-    c.add_argument("--min-duplex-reads", type=int, default=1)
-    c.add_argument("--max-qual", type=int, default=90)
-    c.add_argument("--max-input-qual", type=int, default=50)
+    c.add_argument("--max-hamming", type=int, default=None)
+    c.add_argument("--min-reads", type=int, default=None)
+    c.add_argument("--min-duplex-reads", type=int, default=None)
+    c.add_argument("--max-qual", type=int, default=None)
+    c.add_argument("--max-input-qual", type=int, default=None)
     c.add_argument("--capacity", type=int, default=None, help="bucket read capacity")
     c.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     c.add_argument(
         "--cycle-shards",
         type=int,
-        default=1,
+        default=None,
         help="shard the read-length axis this many ways (long reads); "
         "devices must be divisible by it",
     )
@@ -66,7 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument(
         "--chunk-reads",
         type=int,
-        default=0,
+        default=None,
         help="stream the input in chunks of this many records (0 = whole "
         "file in memory); requires coordinate-sorted input",
     )
@@ -79,8 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument(
         "--max-inflight",
         type=int,
-        default=4,
-        help="chunks dispatched to the device ahead of scatter-back",
+        default=None,
+        help="chunks dispatched to the device ahead of scatter-back "
+        "(default 4)",
     )
 
     s = sub.add_parser("simulate", help="write a truth-aware synthetic BAM")
@@ -95,6 +102,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--base-error", type=float, default=0.01)
     s.add_argument("--cycle-error-slope", type=float, default=0.0)
     s.add_argument("--umi-error", type=float, default=0.0)
+    s.add_argument(
+        "--indel-error",
+        type=float,
+        default=0.0,
+        help="per-read 1bp indel prob (exercises the modal-CIGAR filter)",
+    )
     s.add_argument("--single-strand", action="store_true", help="no duplex pairing")
     s.add_argument(
         "--sorted",
@@ -139,33 +152,103 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _load_config_file(path: str) -> dict:
+    """TOML (.toml) or JSON call settings; keys match the CLI flags
+    with underscores. Unknown keys are rejected — a typo must not
+    silently fall back to a default."""
+    if path.endswith(".toml"):
+        import tomllib
+
+        with open(path, "rb") as f:
+            conf = tomllib.load(f)
+    else:
+        with open(path) as f:
+            conf = json.load(f)
+    allowed = {
+        "backend", "grouping", "mode", "error_model", "max_hamming",
+        "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
+        "capacity", "devices", "cycle_shards", "chunk_reads",
+        "max_inflight", "config",
+    }
+    unknown = set(conf) - allowed
+    if unknown:
+        raise SystemExit(
+            f"unknown config-file keys: {sorted(unknown)} "
+            f"(allowed: {sorted(allowed)})"
+        )
+    return conf
+
+
 def _cmd_call(args) -> int:
     from duplexumiconsensusreads_tpu.runtime.executor import call_consensus_file
     from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+    from duplexumiconsensusreads_tpu.utils.compile_cache import enable_compile_cache
 
-    preset = dict(CONFIG_PRESETS.get(args.config, {}))
-    grouping = args.grouping or preset.get("grouping", "exact")
-    mode = args.mode or preset.get("mode", "ss")
-    error_model = args.error_model or preset.get("error_model", "none")
-    capacity = args.capacity or preset.get("capacity", 2048)
+    enable_compile_cache()
+
+    fileconf = _load_config_file(args.config_file) if args.config_file else {}
+    preset = dict(
+        CONFIG_PRESETS.get(args.config or fileconf.get("config"), {})
+    )
+
+    def opt(name, default):
+        """Precedence: explicit flag (None = unset, so --capacity 0 or
+        any falsy value is still an explicit override) > config file >
+        preset > default."""
+        v = getattr(args, name)
+        if v is not None:
+            return v
+        if name in fileconf:
+            return fileconf[name]
+        if name in preset:
+            return preset[name]
+        return default
+
+    grouping = opt("grouping", "exact")
+    mode = opt("mode", "ss")
+    error_model = opt("error_model", "none")
+    capacity = opt("capacity", 2048)
+    backend = opt("backend", "tpu")
+    chunk_reads = opt("chunk_reads", 0)
+    cycle_shards = opt("cycle_shards", 1)
+    devices = opt("devices", None)
+    max_inflight = opt("max_inflight", 4)
+
+    # config-file values bypass argparse's choices= validation; a value
+    # typo must fail loudly, not silently select a default behaviour
+    _check = {
+        "grouping": {"exact", "adjacency"},
+        "mode": {"ss", "duplex"},
+        "error_model": {"none", "cycle"},
+        "backend": {"tpu", "cpu"},
+    }
+    for _k, _allowed in _check.items():
+        _v = {"grouping": grouping, "mode": mode, "error_model": error_model,
+              "backend": backend}[_k]
+        if _v not in _allowed:
+            raise SystemExit(f"invalid {_k} value {_v!r} (allowed: {sorted(_allowed)})")
+    if (args.config or fileconf.get("config")) and not preset:
+        raise SystemExit(
+            f"unknown config preset {args.config or fileconf.get('config')!r}"
+        )
 
     gp = GroupingParams(
         strategy=grouping,
-        max_hamming=args.max_hamming,
+        max_hamming=opt("max_hamming", 1),
         paired=(mode == "duplex"),
     )
     cp = ConsensusParams(
         mode="duplex" if mode == "duplex" else "single_strand",
-        min_reads=args.min_reads,
-        min_duplex_reads=args.min_duplex_reads,
-        max_qual=args.max_qual,
-        max_input_qual=args.max_input_qual,
+        min_reads=opt("min_reads", 1),
+        min_duplex_reads=opt("min_duplex_reads", 1),
+        max_qual=opt("max_qual", 90),
+        max_input_qual=opt("max_input_qual", 50),
         error_model=None if error_model == "none" else error_model,
     )
     if args.n_hosts > 0:
         if args.host_id is None:
             raise SystemExit("--n-hosts requires --host-id")
-        if args.chunk_reads <= 0:
+        if chunk_reads <= 0:
             raise SystemExit("multi-host mode streams: pass --chunk-reads")
         import os as _os
 
@@ -185,21 +268,21 @@ def _cmd_call(args) -> int:
             process_id=args.host_id,
             num_processes=args.n_hosts,
             capacity=capacity,
-            chunk_reads=args.chunk_reads,
-            n_devices=args.devices,
-            max_inflight=args.max_inflight,
+            chunk_reads=chunk_reads,
+            n_devices=devices,
+            max_inflight=max_inflight,
             checkpoint_path=args.checkpoint,
             resume=args.resume,
             report_path=args.report,
             profile_dir=args.profile,
-            cycle_shards=args.cycle_shards,
+            cycle_shards=cycle_shards,
         )
         if rep is None:
             print("[duplexumi] host has no records in range; idle", file=sys.stderr)
             return 0
         print(f"[duplexumi] host output → {host_out}", file=sys.stderr)
-    elif args.chunk_reads > 0:
-        if args.backend != "tpu":
+    elif chunk_reads > 0:
+        if backend != "tpu":
             raise SystemExit("--chunk-reads streaming requires --backend=tpu")
         from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
 
@@ -209,14 +292,14 @@ def _cmd_call(args) -> int:
             gp,
             cp,
             capacity=capacity,
-            chunk_reads=args.chunk_reads,
-            n_devices=args.devices,
-            max_inflight=args.max_inflight,
+            chunk_reads=chunk_reads,
+            n_devices=devices,
+            max_inflight=max_inflight,
             checkpoint_path=args.checkpoint,
             resume=args.resume,
             report_path=args.report,
             profile_dir=args.profile,
-            cycle_shards=args.cycle_shards,
+            cycle_shards=cycle_shards,
         )
     else:
         rep = call_consensus_file(
@@ -224,12 +307,12 @@ def _cmd_call(args) -> int:
             args.output,
             gp,
             cp,
-            backend=args.backend,
+            backend=backend,
             capacity=capacity,
-            n_devices=args.devices,
+            n_devices=devices,
             report_path=args.report,
             profile_dir=args.profile,
-            cycle_shards=args.cycle_shards,
+            cycle_shards=cycle_shards,
         )
     print(
         f"[duplexumi] {rep.n_valid_reads}/{rep.n_records} reads → "
@@ -257,6 +340,7 @@ def _cmd_simulate(args) -> int:
         base_error=args.base_error,
         cycle_error_slope=args.cycle_error_slope,
         umi_error=args.umi_error,
+        indel_error=args.indel_error,
         duplex=not args.single_strand,
         seed=args.seed,
     )

@@ -37,6 +37,7 @@ from duplexumiconsensusreads_tpu.io.bam import (
     make_aux_z,
 )
 from duplexumiconsensusreads_tpu.types import ReadBatch
+from duplexumiconsensusreads_tpu.utils.phred import pack_umi_words64
 
 UMI_SEP = "-"
 _POS_BITS = 36
@@ -127,14 +128,82 @@ def records_pos_keys(recs: BamRecords) -> np.ndarray:
     return pack_pos_key(recs.ref_id, coord)
 
 
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_CIGAR_OP_IDX = {c: i for i, c in enumerate("MIDNSHP=X")}
+
+
+def cigar_hashes(cigars) -> np.ndarray:
+    """FNV-1a64 over each record's BAM-encoded cigar op words — MUST
+    stay bit-identical to the native loader's fnv1a64 over the raw
+    cigar bytes (bamloader.cpp). 0 for cigar-less records."""
+    out = np.empty(len(cigars), np.uint64)
+    for i, cig in enumerate(cigars):
+        if not cig:
+            out[i] = 0
+            continue
+        h = _FNV_OFFSET
+        for n_op, op in cig:
+            v = (int(n_op) << 4) | _CIGAR_OP_IDX[op]
+            for b in v.to_bytes(4, "little"):
+                h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+        out[i] = h
+    return out
+
+
+def modal_cigar_keep(
+    pos_key: np.ndarray,  # (N,) i64
+    umi: np.ndarray,  # (N, U) u8 canonical codes
+    valid: np.ndarray,  # (N,) bool
+    cig_hash: np.ndarray,  # (N,) u64
+) -> np.ndarray:
+    """CIGAR/indel policy (VERDICT r1 item 6): within each EXACT family
+    (pos_key, canonical UMI), keep only reads carrying the family's
+    modal CIGAR (ties to the smaller hash). Consensus math operates on
+    raw cycles, so a read whose alignment differs from its family's
+    (indel, clipping) would misalign every downstream column; a true
+    indel-bearing molecule keeps its own family intact because ALL its
+    reads share the indel CIGAR. Exact-family granularity is chosen
+    over adjacency-cluster granularity so the filter can run at input
+    conversion, identically for the oracle and the device pipeline.
+    Returns the reduced validity mask."""
+    idx = np.nonzero(np.asarray(valid, bool))[0]
+    if not len(idx):
+        return np.asarray(valid, bool).copy()
+    # fast path: one CIGAR shape across the whole batch (the normal
+    # uniform-length case) — every read is trivially modal
+    ch_all = cig_hash[idx]
+    if (ch_all == ch_all[0]).all():
+        return np.asarray(valid, bool).copy()
+    words = pack_umi_words64(np.asarray(umi)[idx])
+    fam = np.column_stack([np.asarray(pos_key)[idx][:, None], words])
+    # flip the sign bit so int64 comparison reproduces UNSIGNED hash
+    # order ("ties to the smaller u64 hash" stays literally true)
+    ch = (cig_hash[idx] ^ np.uint64(1 << 63)).view(np.int64)
+    key = np.column_stack([fam, ch[:, None]])
+    uniq, inv, cnt = np.unique(key, axis=0, return_inverse=True, return_counts=True)
+    w = uniq.shape[1] - 1
+    order = np.lexsort((uniq[:, w], -cnt, *[uniq[:, j] for j in range(w - 1, -1, -1)]))
+    fam_sorted = uniq[order, :w]
+    first = np.nonzero(
+        np.r_[True, (fam_sorted[1:] != fam_sorted[:-1]).any(axis=1)]
+    )[0]
+    winner = np.zeros(len(uniq), bool)
+    winner[order[first]] = True
+    keep = np.asarray(valid, bool).copy()
+    keep[idx] = winner[inv]
+    return keep
+
+
 def records_to_readbatch(
     recs: BamRecords, duplex: bool = True
 ) -> tuple[ReadBatch, dict]:
     """Convert parsed BAM records into a padded ReadBatch.
 
-    Returns (batch, info); info counts reads dropped for missing/N UMIs
-    or inconsistent UMI length. Dropped reads occupy invalid slots so
-    read indices stay aligned with ``recs``.
+    Returns (batch, info); info counts reads dropped for missing/N UMIs,
+    inconsistent UMI length, excluded FLAGs, or a CIGAR differing from
+    the exact family's modal CIGAR. Dropped reads occupy invalid slots
+    so read indices stay aligned with ``recs``.
     """
     n = len(recs)
     l = recs.seq.shape[1] if n else 0
@@ -178,12 +247,21 @@ def records_to_readbatch(
     batch.quals[:] = recs.qual
     batch.pos_key[:] = pos_key
 
+    n_before = int(batch.valid.sum())
+    keep = modal_cigar_keep(
+        batch.pos_key, batch.umi, batch.valid, cigar_hashes(recs.cigars)
+    )
+    batch.valid &= keep
+    batch.strand_ab &= keep
+    n_cigar = n_before - int(batch.valid.sum())
+
     info = {
         "n_records": n,
         "n_valid": int(batch.valid.sum()),
         "n_dropped_no_umi": n_no_umi,
         "n_dropped_umi_len": n_bad_len,
         "n_dropped_flag": n_flag_excluded,
+        "n_dropped_cigar": n_cigar,
         "umi_len": umi_len,
     }
     return batch, info
@@ -372,6 +450,31 @@ def simulated_bam(
         )
     header = BamHeader.synthetic()
     recs = readbatch_to_records(batch, duplex=cfg.duplex, paired_end=paired_end)
+    if cfg.indel_error > 0:
+        inject_indels(recs, cfg.indel_error, seed=cfg.seed + 9999)
     if path is not None:
         write_bam(path, header, recs)
     return header, recs, batch, truth
+
+
+def inject_indels(recs: BamRecords, rate: float, seed: int = 0) -> np.ndarray:
+    """Give a random subset of records a 1bp indel: shifted sequence
+    content plus the matching CIGAR (pM 1I (l-p-1)M or pM 1D (l-p)M).
+    These reads are cycle-misaligned relative to their family — exactly
+    what the modal-CIGAR input filter must drop. Returns the mutated
+    record indices."""
+    rng = np.random.default_rng(seed)
+    sel = np.nonzero(rng.random(len(recs)) < rate)[0]
+    sel = sel[np.asarray(recs.lengths)[sel] >= 3]  # too short to cut
+    for i in sel:
+        l = int(recs.lengths[i])
+        p = int(rng.integers(1, l - 1))
+        if rng.random() < 0.5:  # insertion at cycle p
+            recs.cigars[i] = [(p, "M"), (1, "I"), (l - p - 1, "M")]
+            recs.seq[i, p + 1 : l] = recs.seq[i, p : l - 1].copy()
+            recs.seq[i, p] = rng.integers(0, 4)
+        else:  # 1bp deletion after cycle p
+            recs.cigars[i] = [(p, "M"), (1, "D"), (l - p, "M")]
+            recs.seq[i, p : l - 1] = recs.seq[i, p + 1 : l].copy()
+            recs.seq[i, l - 1] = rng.integers(0, 4)
+    return sel

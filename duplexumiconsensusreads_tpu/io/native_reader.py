@@ -169,10 +169,12 @@ def batch_from_offsets(
     seq = np.empty((n, l), np.uint8)
     qual = np.empty((n, l), np.uint8)
     rx = np.empty((n, rx_cap), np.uint8)
+    cig_hash = np.empty(n, np.uint64)
     rec_off = np.ascontiguousarray(rec_off)
     rc = lib.dut_bam_fill(
         data, len(data), rec_off, n, l, rx_cap, nt,
         flags, ref_id, pos, next_ref, next_pos, lseq, seq, qual, rx,
+        cig_hash,
     )
     if rc != 0:
         raise ValueError("BAM record fill failed")
@@ -235,6 +237,14 @@ def batch_from_offsets(
     coord = np.where(paired_ok, np.minimum(pos, next_pos), pos)
     pos_key = pack_pos_key(ref_id, coord)
 
+    # CIGAR/indel policy — must mirror records_to_readbatch exactly
+    from duplexumiconsensusreads_tpu.io.convert import modal_cigar_keep
+
+    valid_pre = valid  # pre-CIGAR mask: keeps the drop counters disjoint
+    keep = modal_cigar_keep(pos_key, umi_codes, valid, cig_hash)
+    valid = valid & keep
+    n_cigar = int(valid_pre.sum()) - int(valid.sum())
+
     batch = ReadBatch(
         bases=seq,
         quals=qual,
@@ -247,8 +257,9 @@ def batch_from_offsets(
         "n_records": n,
         "n_valid": int(valid.sum()),
         "n_dropped_no_umi": int((~parseable & ~excluded).sum()),
-        "n_dropped_umi_len": int((counted & ~valid).sum()),
+        "n_dropped_umi_len": int((counted & ~valid_pre).sum()),
         "n_dropped_flag": int(excluded.sum()),
+        "n_dropped_cigar": n_cigar,
         "umi_len": umi_len,
         "native": True,
     }

@@ -76,6 +76,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         _c_u16p, _c_i32p, _c_i32p, _c_i32p, _c_i32p, _c_i32p,
         _c_u8p, _c_u8p, _c_u8p,
+        np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
     ]
     return lib
 

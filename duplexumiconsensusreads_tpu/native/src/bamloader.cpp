@@ -353,14 +353,28 @@ long dut_bam_scan(const uint8_t* data, long n, long* header_end, int* l_max,
 static const uint8_t kNibbleToCode[16] = {4, 0, 1, 4, 2, 4, 4, 4,
                                           3, 4, 4, 4, 4, 4, 4, 4};
 
+// FNV-1a64 over the raw BAM cigar op words — the per-read CIGAR
+// signature the modal-CIGAR input filter groups on. The Python codec
+// computes the identical hash over its re-packed op words.
+static uint64_t fnv1a64(const uint8_t* p, long len) {
+  uint64_t h = 0xcbf29ce484222325ULL;
+  for (long i = 0; i < len; i++) {
+    h ^= p[i];
+    h *= 0x100000001b3ULL;
+  }
+  return h;
+}
+
 // Fill caller-allocated arrays from record offsets. seq gets framework
 // base codes padded with 5 (BASE_PAD); qual padded with 0; rx gets the
-// raw RX:Z characters zero-padded to rx_cap. Parallel over records.
+// raw RX:Z characters zero-padded to rx_cap; cig_hash gets the FNV-1a64
+// CIGAR signature (0 for cigar-less records). Parallel over records.
 int dut_bam_fill(const uint8_t* data, long n, const long* rec_off,
                  long n_records, int l_cap, int rx_cap, int n_threads,
                  uint16_t* flags, int32_t* ref_id, int32_t* pos,
                  int32_t* next_ref_id, int32_t* next_pos, int32_t* lseq,
-                 uint8_t* seq, uint8_t* qual, uint8_t* rx) {
+                 uint8_t* seq, uint8_t* qual, uint8_t* rx,
+                 uint64_t* cig_hash) {
   std::atomic<long> next{0};
   std::atomic<bool> failed{false};
   const long kChunk = 1024;
@@ -392,6 +406,7 @@ int dut_bam_fill(const uint8_t* data, long n, const long* rec_off,
         next_pos[i] = npos;
         lseq[i] = l_seq;
         if (l_seq > l_cap) { failed = true; return; }
+        cig_hash[i] = n_cig ? fnv1a64(r + 32 + l_rn, 4L * n_cig) : 0;
         const uint8_t* sp = r + 32 + l_rn + 4L * n_cig;
         uint8_t* srow = seq + (long)i * l_cap;
         std::memset(srow, 5, l_cap);  // BASE_PAD

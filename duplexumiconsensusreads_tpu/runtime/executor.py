@@ -399,6 +399,7 @@ def call_consensus_file(
             info["n_dropped_no_umi"]
             + info["n_dropped_umi_len"]
             + info.get("n_dropped_flag", 0)
+            + info.get("n_dropped_cigar", 0)
         )
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
     rep.seconds["read_input"] = round(time.time() - t0, 4)

@@ -804,6 +804,7 @@ def stream_call_consensus(
                 info["n_dropped_no_umi"]
                 + info["n_dropped_umi_len"]
                 + info.get("n_dropped_flag", 0)
+                + info.get("n_dropped_cigar", 0)
             )
             buckets = build_buckets(batch, capacity=capacity, grouping=grouping)
             rep.n_buckets += len(buckets)

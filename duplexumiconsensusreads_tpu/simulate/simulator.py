@@ -30,6 +30,9 @@ class SimConfig:
     base_error: float = 0.01   # per-base sequencing error prob (flat component)
     cycle_error_slope: float = 0.0  # extra error prob per cycle (config 5 exercises >0)
     umi_error: float = 0.0     # per-UMI-base error prob (exercises adjacency grouping)
+    indel_error: float = 0.0   # per-read prob of a 1bp indel (CIGAR I/D; exercises
+    #                            the modal-CIGAR input filter — simulated_bam only,
+    #                            since indels live in BAM CIGARs, not ReadBatch)
     qual_lo: int = 20
     qual_hi: int = 40
     duplex: bool = True

@@ -146,6 +146,44 @@ def test_validate_unmatched_classification(tmp_path, capsys):
         assert cls["over_split"] + cls["seed_mismatch"] > 0
 
 
+def test_config_file_layer(tmp_path):
+    """--config-file supplies call settings; explicit flags override it;
+    unknown keys are rejected (VERDICT r1 weak #6)."""
+    bam, truth = _simulate(tmp_path, molecules=40, seed=21)
+    out = str(tmp_path / "o.bam")
+    conf = str(tmp_path / "c.json")
+    with open(conf, "w") as f:
+        json.dump(
+            {"config": "config3", "capacity": 256, "min_duplex_reads": 1}, f
+        )
+    rep_path = str(tmp_path / "r.json")
+    assert main(
+        ["call", bam, "-o", out, "--config-file", conf, "--report", rep_path]
+    ) == 0
+    rep = json.load(open(rep_path))
+    assert rep["n_consensus"] > 0
+    # file can be TOML too
+    conf_t = str(tmp_path / "c.toml")
+    with open(conf_t, "w") as f:
+        f.write('config = "config3"\ncapacity = 256\n')
+    assert main(["call", bam, "-o", out, "--config-file", conf_t]) == 0
+    # unknown keys must be rejected, not ignored
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump({"capcity": 256}, f)
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit, match="unknown config-file keys"):
+        main(["call", bam, "-o", out, "--config-file", bad])
+    # explicit flag beats file: min-reads 3 shrinks the call set
+    rep2_path = str(tmp_path / "r2.json")
+    assert main(
+        ["call", bam, "-o", out, "--config-file", conf, "--min-reads", "3",
+         "--report", rep2_path]
+    ) == 0
+    assert json.load(open(rep2_path))["n_consensus"] < rep["n_consensus"]
+
+
 def test_npz_input(tmp_path):
     from duplexumiconsensusreads_tpu.io import save_readbatch
     from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
