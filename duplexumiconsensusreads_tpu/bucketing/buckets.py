@@ -83,6 +83,7 @@ def _fill_bucket(
     r: int,
     umi_override: np.ndarray | None = None,
     preclustered: bool = False,
+    n_unique: int | None = None,
 ) -> Bucket:
     l, b = batch.read_len, batch.umi_len
     bk = _empty_bucket(r, l, b)
@@ -96,10 +97,16 @@ def _fill_bucket(
     bk.quals[:n] = np.asarray(batch.quals)[idx]
     bk.read_index[:n] = idx
     bk.preclustered = preclustered
-    key = np.column_stack(
-        [np.asarray(batch.pos_key)[idx], pack_umi_words64(umi)]
-    )
-    bk.n_unique_umi = len(np.unique(key, axis=0))
+    if n_unique is not None:
+        # caller derived the unique-(pos, UMI) count from the chunk's
+        # family-run boundaries — per-bucket pack+unique was a top host
+        # cost at scale
+        bk.n_unique_umi = n_unique
+    else:
+        key = np.column_stack(
+            [np.asarray(batch.pos_key)[idx], pack_umi_words64(umi)]
+        )
+        bk.n_unique_umi = len(np.unique(key, axis=0))
     return bk
 
 
@@ -158,18 +165,19 @@ def build_buckets(
         ]
     )[0]
 
-    buckets: list[np.ndarray] = []
-    # (idx, umi_override|None, capacity, preclustered) — buckets carved
-    # out of oversized position groups, possibly with jumbo capacities
+    # plain buckets as contiguous [start, end) ranges of idx_sorted —
+    # their unique-(pos, UMI) counts come from fam_start (no per-bucket
+    # pack+unique, which was a top host cost at scale)
+    ranges: list[tuple] = []
+    # (idx, umi_override|None, capacity, preclustered, n_unique)
     special: list[tuple] = []
-    cur: list[np.ndarray] = []
-    cur_n = 0
+    cur_start = cur_end = 0
 
     def flush():
-        nonlocal cur, cur_n
-        if cur:
-            buckets.append(np.concatenate(cur))
-            cur, cur_n = [], 0
+        nonlocal cur_start, cur_end
+        if cur_end > cur_start:
+            ranges.append((cur_start, cur_end))
+            cur_start = cur_end
 
     # Jumbo buckets keep a whole >capacity family in one piece, but the
     # geometry must stay bounded (stack_buckets pads the class with
@@ -182,8 +190,21 @@ def build_buckets(
         """Greedy-pack whole families (runs delimited by ``bounds``,
         local offsets into ``idx_g``) into capacity-sized buckets; a
         family larger than the capacity gets a jumbo pow2 bucket."""
+
+        def emit(a, b, cap, n_uni):
+            special.append(
+                (
+                    idx_g[a:b],
+                    None if umi_rows is None else umi_rows[a:b],
+                    cap,
+                    preclustered,
+                    n_uni,
+                )
+            )
+
         run_s = 0
         run_n = 0
+        run_fi = 0
         for fi in range(len(bounds) - 1):
             fs, fe = int(bounds[fi]), int(bounds[fi + 1])
             fsize = fe - fs
@@ -194,66 +215,24 @@ def build_buckets(
                     "(consensus will emit one record per split)"
                 )
                 if run_n:
-                    special.append(
-                        (
-                            idx_g[run_s:fs],
-                            None if umi_rows is None else umi_rows[run_s:fs],
-                            capacity,
-                            preclustered,
-                        )
-                    )
+                    emit(run_s, fs, capacity, fi - run_fi)
                 for cs in range(fs, fe, jumbo_max):
                     ce = min(cs + jumbo_max, fe)
-                    special.append(
-                        (
-                            idx_g[cs:ce],
-                            None if umi_rows is None else umi_rows[cs:ce],
-                            _pow2(ce - cs),
-                            preclustered,
-                        )
-                    )
-                run_s, run_n = fe, 0
+                    emit(cs, ce, _pow2(ce - cs), 1)
+                run_s, run_n, run_fi = fe, 0, fi + 1
                 continue
             if fsize > capacity:
                 if run_n:
-                    special.append(
-                        (
-                            idx_g[run_s:fs],
-                            None if umi_rows is None else umi_rows[run_s:fs],
-                            capacity,
-                            preclustered,
-                        )
-                    )
-                special.append(
-                    (
-                        idx_g[fs:fe],
-                        None if umi_rows is None else umi_rows[fs:fe],
-                        _pow2(fsize),
-                        preclustered,
-                    )
-                )
-                run_s, run_n = fe, 0
+                    emit(run_s, fs, capacity, fi - run_fi)
+                emit(fs, fe, _pow2(fsize), 1)
+                run_s, run_n, run_fi = fe, 0, fi + 1
                 continue
             if run_n + fsize > capacity:
-                special.append(
-                    (
-                        idx_g[run_s:fs],
-                        None if umi_rows is None else umi_rows[run_s:fs],
-                        capacity,
-                        preclustered,
-                    )
-                )
-                run_s, run_n = fs, 0
+                emit(run_s, fs, capacity, fi - run_fi)
+                run_s, run_n, run_fi = fs, 0, fi
             run_n += fsize
         if run_n:
-            special.append(
-                (
-                    idx_g[run_s:],
-                    None if umi_rows is None else umi_rows[run_s:],
-                    capacity,
-                    preclustered,
-                )
-            )
+            emit(run_s, len(idx_g), capacity, len(bounds) - 1 - run_fi)
 
     pos_bounds = np.r_[pos_start, n]
     for gi in range(len(pos_start)):
@@ -277,38 +256,57 @@ def build_buckets(
                     )
                     fs_ = fam_start[(fam_start >= s) & (fam_start < e)]
                     pack_family_runs(sel, np.r_[fs_, e] - s, None, False)
-                    continue
-                from duplexumiconsensusreads_tpu.oracle.grouping import (
-                    directional_seeds,
-                )
+                    # NO early continue: fall through to the shared
+                    # range reset below — skipping it would let the
+                    # final flush re-emit these reads in a plain bucket
+                else:
+                    from duplexumiconsensusreads_tpu.oracle.grouping import (
+                        directional_seeds,
+                    )
 
-                seed_of = directional_seeds(
-                    uu, cnt, g.max_hamming, g.count_ratio
-                )
-                new_umi = uu[seed_of][inv]  # (size, B) seed-relabeled
-                w2 = pack_umi_words64(new_umi)
-                order_g = np.lexsort(
-                    tuple(w2[:, i] for i in range(w2.shape[1] - 1, -1, -1))
-                )
-                sel = sel[order_g]
-                new_umi = new_umi[order_g]
-                w2 = w2[order_g]
-                fam_b = np.nonzero(np.r_[True, (w2[1:] != w2[:-1]).any(axis=1)])[0]
-                pack_family_runs(sel, np.r_[fam_b, size], new_umi, True)
+                    seed_of = directional_seeds(
+                        uu, cnt, g.max_hamming, g.count_ratio
+                    )
+                    new_umi = uu[seed_of][inv]  # (size, B) seed-relabeled
+                    w2 = pack_umi_words64(new_umi)
+                    order_g = np.lexsort(
+                        tuple(w2[:, i] for i in range(w2.shape[1] - 1, -1, -1))
+                    )
+                    sel = sel[order_g]
+                    new_umi = new_umi[order_g]
+                    w2 = w2[order_g]
+                    fam_b = np.nonzero(
+                        np.r_[True, (w2[1:] != w2[:-1]).any(axis=1)]
+                    )[0]
+                    pack_family_runs(sel, np.r_[fam_b, size], new_umi, True)
             else:
                 fs_ = fam_start[(fam_start >= s) & (fam_start < e)]
                 pack_family_runs(sel, np.r_[fs_, e] - s, None, False)
+            cur_start = cur_end = e  # special paths consumed [s, e)
             continue
-        if cur_n + size > capacity:
+        if (cur_end - cur_start) + size > capacity:
             flush()
-        cur.append(idx_sorted[s:e])
-        cur_n += size
+            cur_start = s
+        cur_end = e
     flush()
 
-    out = [_fill_bucket(batch, b, capacity) for b in buckets]
+    out = [
+        _fill_bucket(
+            batch,
+            idx_sorted[a:b],
+            capacity,
+            n_unique=int(
+                np.searchsorted(fam_start, b, side="left")
+                - np.searchsorted(fam_start, a, side="left")
+            ),
+        )
+        for a, b in ranges
+    ]
     out.extend(
-        _fill_bucket(batch, idx, cap, umi_override=um, preclustered=pc)
-        for idx, um, cap, pc in special
+        _fill_bucket(
+            batch, idx, cap, umi_override=um, preclustered=pc, n_unique=nu
+        )
+        for idx, um, cap, pc, nu in special
     )
     return out
 

@@ -26,6 +26,7 @@ import numpy as np
 
 from duplexumiconsensusreads_tpu.constants import BASE_PAD, N_REAL_BASES
 from duplexumiconsensusreads_tpu.io.bam import (
+    _CIGAR_OPS,
     FLAG_PAIRED,
     FLAG_READ1,
     FLAG_READ2,
@@ -130,7 +131,9 @@ def records_pos_keys(recs: BamRecords) -> np.ndarray:
 
 _FNV_OFFSET = 0xCBF29CE484222325
 _FNV_PRIME = 0x100000001B3
-_CIGAR_OP_IDX = {c: i for i, c in enumerate("MIDNSHP=X")}
+# derived from io/bam.py's single spec constant — the FNV hash parity
+# between both codecs depends on this mapping staying identical
+_CIGAR_OP_IDX = {c: i for i, c in enumerate(_CIGAR_OPS)}
 
 
 def cigar_hashes(cigars) -> np.ndarray:

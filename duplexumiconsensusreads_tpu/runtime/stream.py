@@ -45,6 +45,7 @@ from duplexumiconsensusreads_tpu.io.convert import (
 from duplexumiconsensusreads_tpu.io.convert import records_pos_keys as _rec_pos_keys
 from duplexumiconsensusreads_tpu.runtime.executor import (
     RunReport,
+    fetch_outputs,
     partition_buckets,
     scatter_bucket_outputs,
     sort_consensus_outputs,
@@ -716,7 +717,7 @@ def stream_call_consensus(
             err: Exception = RuntimeError("device dispatch failed at submit")
         else:
             try:
-                return {key: np.asarray(v) for key, v in out.items()}
+                return fetch_outputs(out)
             except Exception as e:
                 err = e
         for attempt in range(max_retries):
@@ -729,8 +730,7 @@ def stream_call_consensus(
             )
             time.sleep(delay)
             try:
-                out = dispatch(cbuckets, cspec)
-                return {key: np.asarray(v) for key, v in out.items()}
+                return fetch_outputs(dispatch(cbuckets, cspec))
             except Exception as e:
                 err = e
         # class keeps failing: isolate per bucket so one bad bucket

@@ -158,6 +158,33 @@ def test_jumbo_cluster_adjacency_duplex():
         _assert_tpu_matches_cpu(batch, gp, cp, capacity=256)
 
 
+def test_precluster_fallback_does_not_duplicate_reads(monkeypatch):
+    """When an oversized group exceeds PRECLUSTER_MAX_UNIQUE (warned
+    fallback), the following plain groups must not re-emit the group's
+    reads (regression: a skipped range reset once merged them into the
+    next plain bucket)."""
+    import duplexumiconsensusreads_tpu.bucketing.buckets as bmod
+    from duplexumiconsensusreads_tpu.types import ReadBatch
+
+    monkeypatch.setattr(bmod, "PRECLUSTER_MAX_UNIQUE", 4)
+    rng = np.random.default_rng(3)
+    n1, n2, l, u = 40, 10, 16, 6
+    batch = ReadBatch(
+        bases=rng.integers(0, 4, size=(n1 + n2, l), dtype=np.uint8),
+        quals=np.full((n1 + n2, l), 30, np.uint8),
+        umi=rng.integers(0, 4, size=(n1 + n2, u), dtype=np.uint8),
+        pos_key=np.r_[np.full(n1, 1000, np.int64), np.full(n2, 2000, np.int64)],
+        strand_ab=np.ones(n1 + n2, bool),
+        valid=np.ones(n1 + n2, bool),
+    )
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    with pytest.warns(UserWarning, match="precluster limit"):
+        buckets = build_buckets(batch, capacity=16, grouping=gp)
+    seen = np.concatenate([bk.read_index[bk.read_index >= 0] for bk in buckets])
+    assert len(seen) == n1 + n2
+    assert len(np.unique(seen)) == n1 + n2  # every read exactly once
+
+
 @pytest.mark.parametrize("chunk_reads", [200])
 def test_streaming_oversized_group_matches_whole_file(tmp_path, chunk_reads):
     """Streaming path with an oversized position group: output must
