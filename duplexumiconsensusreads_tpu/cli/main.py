@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--min-duplex-reads", type=int, default=None)
     c.add_argument("--max-qual", type=int, default=None)
     c.add_argument("--max-input-qual", type=int, default=None)
+    c.add_argument(
+        "--min-input-qual",
+        type=int,
+        default=None,
+        help="mask input bases below this quality (fgbio-style "
+        "min-input-base-quality; masked bases add no evidence/depth)",
+    )
     c.add_argument("--capacity", type=int, default=None, help="bucket read capacity")
     c.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     c.add_argument(
@@ -131,6 +138,36 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--host-id", type=int, default=None, help="this host's id")
     c.add_argument("--index", help="linear index path (default: input + .dlix)")
 
+    f = sub.add_parser(
+        "filter",
+        help="post-filter a consensus BAM (the FilterConsensusReads "
+        "analogue): depth/quality thresholds + low-quality base masking",
+    )
+    f.add_argument("input", help="consensus BAM from `call`")
+    f.add_argument("-o", "--output", required=True, help="filtered BAM")
+    f.add_argument(
+        "--min-depth", type=int, default=0,
+        help="drop consensus with max depth (cD) below this",
+    )
+    f.add_argument(
+        "--min-min-depth", type=int, default=0,
+        help="drop consensus with min positive depth (cM) below this",
+    )
+    f.add_argument(
+        "--min-mean-qual", type=float, default=0.0,
+        help="drop consensus whose mean base quality is below this",
+    )
+    f.add_argument(
+        "--mask-qual", type=int, default=0,
+        help="mask bases below this quality to N (qual 2)",
+    )
+    f.add_argument(
+        "--max-n-frac", type=float, default=1.0,
+        help="drop consensus with more than this fraction of N bases "
+        "(evaluated after masking)",
+    )
+    f.add_argument("--chunk-records", type=int, default=200_000)
+
     x = sub.add_parser(
         "index", help="build the linear BGZF index for multi-host partitioning"
     )
@@ -167,8 +204,8 @@ def _load_config_file(path: str) -> dict:
     allowed = {
         "backend", "grouping", "mode", "error_model", "max_hamming",
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
-        "capacity", "devices", "cycle_shards", "chunk_reads",
-        "max_inflight", "config",
+        "min_input_qual", "capacity", "devices", "cycle_shards",
+        "chunk_reads", "max_inflight", "config",
     }
     unknown = set(conf) - allowed
     if unknown:
@@ -243,6 +280,7 @@ def _cmd_call(args) -> int:
         min_duplex_reads=opt("min_duplex_reads", 1),
         max_qual=opt("max_qual", 90),
         max_input_qual=opt("max_input_qual", 50),
+        min_input_qual=opt("min_input_qual", 0),
         error_model=None if error_model == "none" else error_model,
     )
     if args.n_hosts > 0:
@@ -466,6 +504,135 @@ def _cmd_validate(args) -> int:
     return 0
 
 
+def _cmd_filter(args) -> int:
+    """Streaming consensus post-filter (FilterConsensusReads analogue):
+    record-level thresholds on the cD/cM aux depth stats and mean base
+    quality, plus per-base low-quality masking to N. Streams in record
+    chunks (no family hold-back needed — filtering is per record)."""
+    import struct
+
+    import numpy as np
+
+    from duplexumiconsensusreads_tpu.constants import BASE_N, NO_CALL_QUAL
+    from duplexumiconsensusreads_tpu.io import bgzf
+    from duplexumiconsensusreads_tpu.io.bam import serialize_bam
+    from duplexumiconsensusreads_tpu.runtime.stream import (
+        BamStreamReader,
+        _empty_records,
+        _records_from_raw,
+    )
+
+    def aux_i(aux: bytes, tag: bytes) -> int:
+        """Walk the aux records properly (a raw substring scan could
+        match the tag pattern inside another field's VALUE bytes)."""
+        off, end = 0, len(aux)
+        while off + 3 <= end:
+            t, typ = aux[off : off + 2], aux[off + 2 : off + 3]
+            off += 3
+            if typ in (b"A", b"c", b"C"):
+                vlen = 1
+            elif typ in (b"s", b"S"):
+                vlen = 2
+            elif typ in (b"i", b"I", b"f"):
+                if t == tag and typ == b"i":
+                    return struct.unpack_from("<i", aux, off)[0]
+                vlen = 4
+            elif typ in (b"Z", b"H"):
+                z = aux.find(b"\x00", off)
+                if z < 0:
+                    return -1
+                vlen = z - off + 1
+            elif typ == b"B":
+                sub = aux[off : off + 1]
+                cnt = struct.unpack_from("<I", aux, off + 1)[0]
+                esz = 1 if sub in b"cC" else 2 if sub in b"sS" else 4
+                vlen = 5 + cnt * esz
+            else:
+                return -1
+            off += vlen
+        return -1
+
+    reader = BamStreamReader(args.input)
+    header = reader.header
+    shell = serialize_bam(header, _empty_records())
+    n_in = n_kept = n_masked = 0
+    try:
+        with open(args.output, "wb") as out_f:
+            out_f.write(bgzf.compress_fast(shell, eof=False))
+            while True:
+                raw = reader.read_raw_records(args.chunk_records)
+                if raw is None:
+                    break
+                recs = _records_from_raw(header, raw)
+                n = len(recs)
+                n_in += n
+                need_mask = (
+                    args.mask_qual > 0
+                    or args.min_mean_qual > 0
+                    or args.max_n_frac < 1.0
+                )
+                if need_mask:
+                    lens = np.asarray(recs.lengths)
+                    in_read = (
+                        np.arange(recs.qual.shape[1])[None, :] < lens[:, None]
+                    )
+                if args.mask_qual > 0:
+                    low = (recs.qual < args.mask_qual) & in_read
+                    n_masked += int(low.sum())
+                    recs.seq[low] = BASE_N
+                    recs.qual[low] = NO_CALL_QUAL
+                keep = np.ones(n, bool)
+                if args.min_depth > 0 or args.min_min_depth > 0:
+                    cd = np.fromiter(
+                        (aux_i(a, b"cD") for a in recs.aux_raw), np.int64, n
+                    )
+                    cm = np.fromiter(
+                        (aux_i(a, b"cM") for a in recs.aux_raw), np.int64, n
+                    )
+                    keep &= cd >= args.min_depth
+                    keep &= cm >= args.min_min_depth
+                if args.min_mean_qual > 0:
+                    qsum = (recs.qual * in_read).sum(axis=1)
+                    keep &= qsum >= args.min_mean_qual * np.maximum(lens, 1)
+                if args.max_n_frac < 1.0:
+                    n_count = ((recs.seq == BASE_N) & in_read).sum(axis=1)
+                    keep &= n_count <= args.max_n_frac * np.maximum(lens, 1)
+                kept_idx = np.nonzero(keep)[0]
+                n_kept += len(kept_idx)
+                if len(kept_idx):
+                    sub = (
+                        recs
+                        if len(kept_idx) == n
+                        else _take_records(recs, kept_idx)
+                    )
+                    payload = serialize_bam(header, sub)[len(shell):]
+                    out_f.write(bgzf.compress_fast(payload, eof=False))
+            out_f.write(bgzf.BGZF_EOF)
+    finally:
+        reader.close()
+    print(
+        f"[duplexumi] filter: kept {n_kept}/{n_in} consensus reads"
+        + (f", masked {n_masked} bases" if args.mask_qual > 0 else ""),
+        file=sys.stderr,
+    )
+    return 0
+
+
+def _take_records(recs, idx):
+    import dataclasses as _dc
+
+    from duplexumiconsensusreads_tpu.io.bam import BamRecords
+
+    out = {}
+    for fld in _dc.fields(BamRecords):
+        v = getattr(recs, fld.name)
+        if isinstance(v, list):
+            out[fld.name] = [v[i] for i in idx]
+        else:
+            out[fld.name] = v[idx]
+    return BamRecords(**out)
+
+
 def _cmd_index(args) -> int:
     from duplexumiconsensusreads_tpu.io.index import INDEX_SUFFIX, build_linear_index
 
@@ -503,6 +670,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_validate(args)
     if args.cmd == "index":
         return _cmd_index(args)
+    if args.cmd == "filter":
+        return _cmd_filter(args)
     if args.cmd == "bench":
         return _cmd_bench(args)
     raise AssertionError(args.cmd)

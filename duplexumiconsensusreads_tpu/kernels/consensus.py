@@ -38,12 +38,16 @@ def _phred_from_err(err: jnp.ndarray, max_qual: int) -> jnp.ndarray:
     return jnp.clip(q, 2, max_qual).astype(jnp.int32)
 
 
-def _contributions(bases, quals, valid, max_input_qual):
-    """Per-read per-cycle evidence rows, zeroed for N/PAD/invalid.
+def _contributions(bases, quals, valid, max_input_qual, min_input_qual=0):
+    """Per-read per-cycle evidence rows, zeroed for N/PAD/invalid and
+    for bases below min_input_qual (masked like N, per fgbio's
+    min-input-base-quality).
 
     Returns (contrib (R, L, 4) f32, real (R, L) f32).
     """
     real = (bases < N_REAL_BASES) & valid[:, None]
+    if min_input_qual > 0:
+        real = real & (quals >= min_input_qual)
     q = jnp.minimum(quals.astype(jnp.float32), float(max_input_qual))
     e = jnp.power(10.0, -q / 10.0)
     e = jnp.maximum(e, MIN_ERROR_PROB)
@@ -59,7 +63,10 @@ def _contributions(bases, quals, valid, max_input_qual):
 
 @partial(
     jax.jit,
-    static_argnames=("f_max", "min_reads", "max_qual", "max_input_qual", "method"),
+    static_argnames=(
+        "f_max", "min_reads", "max_qual", "max_input_qual",
+        "min_input_qual", "method",
+    ),
 )
 def ssc_kernel(
     bases: jnp.ndarray,  # (R, L) u8
@@ -71,6 +78,7 @@ def ssc_kernel(
     min_reads: int = 1,
     max_qual: int = 90,
     max_input_qual: int = 50,
+    min_input_qual: int = 0,
     method: str = "matmul",
 ):
     """Single-strand consensus for all families at once.
@@ -84,7 +92,7 @@ def ssc_kernel(
     ok = valid & (family_id >= 0)
     fid = jnp.where(ok, family_id, f_max)  # overflow row, sliced off below
 
-    contrib, real = _contributions(bases, quals, ok, max_input_qual)
+    contrib, real = _contributions(bases, quals, ok, max_input_qual, min_input_qual)
 
     if method in ("matmul", "pallas", "pallas_interpret"):
         # (R, 4L | L | 1): loglik contributions, depth indicators, read count

@@ -89,6 +89,7 @@ class ConsensusCaller:
                 min_reads=p.min_reads,
                 max_qual=p.max_qual,
                 max_input_qual=p.max_input_qual,
+                min_input_qual=p.min_input_qual,
                 method=self.method,
             )
 

@@ -166,6 +166,7 @@ def fused_pipeline(
             min_reads=c.min_reads,
             max_qual=c.max_qual,
             max_input_qual=c.max_input_qual,
+            min_input_qual=c.min_input_qual,
             method=spec.ssc_method,
         )
 

@@ -53,6 +53,8 @@ def single_strand_consensus(
             b = bases[i, c]
             if b >= N_REAL_BASES:  # N or PAD: no evidence
                 continue
+            if int(quals[i, c]) < params.min_input_qual:  # masked base
+                continue
             e = phred_to_error(min(int(quals[i, c]), params.max_input_qual))
             ll += np.log(e / 3.0)
             ll[b] += np.log1p(-e) - np.log(e / 3.0)

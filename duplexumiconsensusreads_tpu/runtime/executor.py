@@ -149,13 +149,9 @@ def scatter_bucket_outputs(
     )
     fam_pos = fam_pos.reshape(nb, f)
     fam_umi = fam_umi.reshape(nb, f, -1)
-    # cons tensors may arrive sliced to m <= F rows (fetch_outputs);
-    # keep[] rows past m are all False (n_out <= m by construction)
-    m = out["cons_base"].shape[1]
-    keep_m = keep[:, :m]
     return (
-        out["cons_base"][:nb][keep_m],
-        out["cons_qual"][:nb][keep_m],
+        out["cons_base"][:nb][keep],
+        out["cons_qual"][:nb][keep],
         np.stack(
             [out["depth_max"][:nb][keep], out["depth_min_pos"][:nb][keep]],
             axis=1,

@@ -164,6 +164,9 @@ class ConsensusParams:
     min_duplex_reads: minimum reads on EACH strand for a duplex call
     max_qual:        cap on emitted consensus quality
     max_input_qual:  cap applied to input qualities before the math
+    min_input_qual:  bases below this quality contribute NO evidence
+                     (masked like N, excluded from depth) — the
+                     fgbio-style min-input-base-quality filter
     error_model:     None, or "cycle" to apply a fitted per-cycle
                      quality cap before consensus (benchmark config 5)
     """
@@ -173,4 +176,5 @@ class ConsensusParams:
     min_duplex_reads: int = 1
     max_qual: int = 90
     max_input_qual: int = 50
+    min_input_qual: int = 0
     error_model: str | None = None

@@ -1,0 +1,165 @@
+"""min-input-base-quality masking + the consensus post-filter
+(FilterConsensusReads analogue) + multi-chromosome input."""
+
+import json
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.cli import main
+from duplexumiconsensusreads_tpu.io import read_bam
+from duplexumiconsensusreads_tpu.oracle import call_consensus, group_reads
+from duplexumiconsensusreads_tpu.ops import ConsensusCaller
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+def test_min_input_qual_masks_evidence_and_depth():
+    """A base below the threshold contributes nothing — including to
+    depth — on both backends, bit-identically."""
+    cfg = SimConfig(n_molecules=30, duplex=False, qual_lo=10, qual_hi=40, seed=3)
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(strategy="exact")
+    fams = group_reads(batch, gp)
+    for miq in (0, 25):
+        cp = ConsensusParams(mode="single_strand", min_input_qual=miq)
+        cpu = ConsensusCaller(cp, backend="cpu")(batch, fams)
+        tpu = ConsensusCaller(cp, backend="tpu")(batch, fams)
+        cv = np.asarray(cpu.valid, bool)
+        np.testing.assert_array_equal(
+            np.asarray(cpu.depth)[cv], np.asarray(tpu.depth)[: len(cv)][cv]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cpu.bases)[cv], np.asarray(tpu.bases)[: len(cv)][cv]
+        )
+    # with a high threshold, depth must strictly drop somewhere
+    lo = ConsensusCaller(
+        ConsensusParams(mode="single_strand"), backend="cpu"
+    )(batch, fams)
+    hi = ConsensusCaller(
+        ConsensusParams(mode="single_strand", min_input_qual=35), backend="cpu"
+    )(batch, fams)
+    assert np.asarray(hi.depth).sum() < np.asarray(lo.depth).sum()
+
+
+def _make_consensus(tmp_path, **sim_kw):
+    bam = str(tmp_path / "in.bam")
+    truth = str(tmp_path / "t.npz")
+    cons = str(tmp_path / "cons.bam")
+    args = [
+        "simulate", "-o", bam, "--truth", truth,
+        "--molecules", str(sim_kw.get("molecules", 120)),
+        "--read-len", "40", "--positions", "8",
+        "--base-error", "0.03", "--sorted", "--seed", "5",
+    ]
+    assert main(args) == 0
+    assert main(
+        ["call", bam, "-o", cons, "--config", "config3", "--capacity", "512"]
+    ) == 0
+    return cons
+
+
+def test_filter_min_depth(tmp_path, capsys):
+    cons = _make_consensus(tmp_path)
+    out = str(tmp_path / "f.bam")
+    assert main(["filter", cons, "-o", out, "--min-depth", "4"]) == 0
+    _, before = read_bam(cons)
+    _, after = read_bam(out)
+    assert 0 < len(after) < len(before)
+    import struct
+
+    for a in after.aux_raw:
+        i = a.find(b"cDi")
+        assert struct.unpack_from("<i", a, i + 3)[0] >= 4
+    # records below threshold really existed
+    lows = 0
+    for a in before.aux_raw:
+        i = a.find(b"cDi")
+        lows += struct.unpack_from("<i", a, i + 3)[0] < 4
+    assert lows == len(before) - len(after)
+
+
+def test_filter_mask_and_nfrac(tmp_path):
+    cons = _make_consensus(tmp_path)
+    out = str(tmp_path / "m.bam")
+    assert main(
+        ["filter", cons, "-o", out, "--mask-qual", "60", "--max-n-frac", "0.5"]
+    ) == 0
+    _, after = read_bam(out)
+    # masked bases are N with qual 2
+    for i in range(len(after)):
+        l = int(after.lengths[i])
+        q = after.qual[i, :l]
+        s = after.seq[i, :l]
+        assert ((q >= 60) | ((s == 4) & (q == 2))).all()
+        assert (s == 4).sum() <= 0.5 * l
+
+
+def test_filter_passthrough_identity(tmp_path):
+    cons = _make_consensus(tmp_path)
+    out = str(tmp_path / "id.bam")
+    assert main(["filter", cons, "-o", out]) == 0
+    _, a = read_bam(cons)
+    _, b = read_bam(out)
+    assert len(a) == len(b)
+    np.testing.assert_array_equal(a.seq, b.seq)
+    np.testing.assert_array_equal(a.qual, b.qual)
+    assert a.names == b.names
+
+
+def test_multi_chromosome_grouping_and_call(tmp_path):
+    """Reads on different chromosomes at the same coordinate are
+    different families (pos_key packs ref_id); the whole pipeline and
+    BAM round-trip must respect it."""
+    from duplexumiconsensusreads_tpu.io.bam import BamHeader, write_bam
+    from duplexumiconsensusreads_tpu.io.convert import (
+        readbatch_to_records,
+        records_to_readbatch,
+        pack_pos_key,
+    )
+    from duplexumiconsensusreads_tpu.runtime.executor import (
+        call_batch_cpu,
+        call_batch_tpu,
+    )
+    from duplexumiconsensusreads_tpu.types import ReadBatch
+
+    rng = np.random.default_rng(9)
+    n, l, u = 60, 30, 6
+    half = n // 2
+    # per-chromosome true sequence + sparse errors (uniformly random
+    # bases would create plurality ties where f32/f64 argmax differ)
+    seq1 = rng.integers(0, 4, size=l, dtype=np.uint8)
+    seq2 = rng.integers(0, 4, size=l, dtype=np.uint8)
+    bases = np.r_[np.tile(seq1, (half, 1)), np.tile(seq2, (n - half, 1))]
+    err = rng.random((n, l)) < 0.05
+    bases[err] = (bases[err] + 1) % 4
+    batch = ReadBatch(
+        bases=bases,
+        quals=np.full((n, l), 30, np.uint8),
+        umi=np.tile(rng.integers(0, 4, size=u, dtype=np.uint8), (n, 1)),
+        pos_key=pack_pos_key(
+            np.r_[np.zeros(half, np.int64), np.ones(n - half, np.int64)],
+            np.full(n, 500, np.int64),
+        ),
+        strand_ab=np.ones(n, bool),
+        valid=np.ones(n, bool),
+    )
+    gp = GroupingParams(strategy="exact")
+    cp = ConsensusParams(mode="single_strand")
+    t = call_batch_tpu(batch, gp, cp, capacity=64)
+    c = call_batch_cpu(batch, gp, cp)
+    # same UMI + same coordinate, two chromosomes -> exactly 2 families
+    assert len(t[0]) == len(c[0]) == 2
+    np.testing.assert_array_equal(t[0], c[0])
+
+    # BAM round-trip keeps the two ref_ids distinct
+    recs = readbatch_to_records(batch, duplex=False)
+    header = BamHeader.synthetic(
+        ref_names=("chr1", "chr2"), ref_lengths=(10_000, 10_000)
+    )
+    p = str(tmp_path / "multi.bam")
+    write_bam(p, header, recs)
+    h2, recs2 = read_bam(p)
+    assert h2.ref_names == ["chr1", "chr2"]
+    batch2, _ = records_to_readbatch(recs2, duplex=False)
+    assert len(np.unique(np.asarray(batch2.pos_key))) == 2
