@@ -177,6 +177,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--every", type=int, default=100_000, help="sampling stride in records"
     )
 
+    st = sub.add_parser(
+        "stats",
+        help="input metrics: family-size histogram, strand balance, "
+        "position-group stats (GroupReadsByUmi-metrics analogue)",
+    )
+    st.add_argument("input", help="input BAM (or ReadBatch .npz)")
+    st.add_argument(
+        "--grouping", choices=["exact", "adjacency"], default="adjacency"
+    )
+    st.add_argument("--duplex", action="store_true", help="paired UMI mode")
+    st.add_argument("--json", action="store_true")
+
     v = sub.add_parser("validate", help="consensus error rate vs simulation truth")
     v.add_argument("consensus", help="consensus BAM from `call`")
     v.add_argument("--truth", required=True, help="truth npz from `simulate --truth`")
@@ -633,6 +645,62 @@ def _take_records(recs, idx):
     return BamRecords(**out)
 
 
+def _cmd_stats(args) -> int:
+    """Input metrics from the oracle grouper (the GroupReadsByUmi
+    metrics analogue): family/molecule counts, family-size histogram,
+    duplex strand balance, position-group sizes."""
+    import numpy as np
+
+    from duplexumiconsensusreads_tpu.io import load_input
+    from duplexumiconsensusreads_tpu.oracle import group_reads
+    from duplexumiconsensusreads_tpu.types import GroupingParams
+
+    _, batch, info = load_input(args.input, duplex=args.duplex)
+
+    gp = GroupingParams(strategy=args.grouping, paired=args.duplex)
+    fams = group_reads(batch, gp)
+    valid = np.asarray(batch.valid, bool)
+    fam_id = np.asarray(fams.family_id)[valid]
+    mol_id = np.asarray(fams.molecule_id)[valid]
+    pos = np.asarray(batch.pos_key)[valid]
+    strand = np.asarray(batch.strand_ab, bool)[valid]
+
+    sizes = np.bincount(fam_id[fam_id >= 0])
+    hist_edges = [1, 2, 3, 4, 5, 10, 20, 50, 100, 1000, 1 << 30]
+    hist = {}
+    prev = 1
+    for e in hist_edges[1:]:
+        label = f"{prev}" if e == prev + 1 else f"{prev}-{e - 1}"
+        hist[label] = int(((sizes >= prev) & (sizes < e)).sum())
+        prev = e
+    _, pg_sizes = np.unique(pos, return_counts=True)
+    n_mol = int(fams.n_molecules)
+    duplex_mols = 0
+    if args.duplex and n_mol:
+        ab = np.bincount(mol_id[strand], minlength=n_mol)
+        ba = np.bincount(mol_id[~strand], minlength=n_mol)
+        duplex_mols = int(((ab > 0) & (ba > 0)).sum())
+    out = {
+        "n_records": info["n_records"],
+        "n_valid_reads": int(valid.sum()),
+        "n_families": int(fams.n_families),
+        "n_molecules": n_mol,
+        "mean_family_size": round(float(sizes.mean()), 3) if len(sizes) else 0,
+        "max_family_size": int(sizes.max()) if len(sizes) else 0,
+        "family_size_hist": hist,
+        "n_position_groups": int(len(pg_sizes)),
+        "max_position_group": int(pg_sizes.max()) if len(pg_sizes) else 0,
+        "duplex_complete_molecules": duplex_mols,
+        "grouping": args.grouping,
+    }
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for k, v in out.items():
+            print(f"{k}: {v}")
+    return 0
+
+
 def _cmd_index(args) -> int:
     from duplexumiconsensusreads_tpu.io.index import INDEX_SUFFIX, build_linear_index
 
@@ -672,6 +740,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_index(args)
     if args.cmd == "filter":
         return _cmd_filter(args)
+    if args.cmd == "stats":
+        return _cmd_stats(args)
     if args.cmd == "bench":
         return _cmd_bench(args)
     raise AssertionError(args.cmd)

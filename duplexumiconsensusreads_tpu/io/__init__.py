@@ -19,7 +19,30 @@ from duplexumiconsensusreads_tpu.io.convert import (
 )
 from duplexumiconsensusreads_tpu.io.npz import load_readbatch, save_readbatch
 
+
+def load_input(path: str, duplex: bool):
+    """ONE input loader for every consumer (call, stats, ...): .npz
+    ReadBatch interchange, else native BAM parse when available
+    (DUT_NO_NATIVE=1 forces the portable codec), else pure Python.
+    Returns (header, batch, info)."""
+    import os
+
+    if path.endswith(".npz"):
+        batch = load_readbatch(path)
+        return BamHeader.synthetic(), batch, {"n_records": batch.n_reads}
+    if not os.environ.get("DUT_NO_NATIVE"):
+        from duplexumiconsensusreads_tpu.io.native_reader import read_bam_native
+
+        res = read_bam_native(path, duplex=duplex)
+        if res is not None:
+            return res
+    header, recs = read_bam(path)
+    batch, info = records_to_readbatch(recs, duplex=duplex)
+    return header, batch, info
+
+
 __all__ = [
+    "load_input",
     "BamHeader",
     "BamRecords",
     "read_bam",

@@ -374,11 +374,8 @@ def call_consensus_file(
 ) -> RunReport:
     """End-to-end: read BAM/npz → consensus → write consensus BAM."""
     from duplexumiconsensusreads_tpu.io import (
-        BamHeader,
         consensus_to_records,
-        load_readbatch,
-        read_bam,
-        records_to_readbatch,
+        load_input,
         write_bam,
     )
 
@@ -386,30 +383,14 @@ def call_consensus_file(
     duplex = consensus.mode == "duplex"
 
     t0 = time.time()
-    if in_path.endswith(".npz"):
-        batch = load_readbatch(in_path)
-        header = BamHeader.synthetic()
-        rep.n_records = batch.n_reads
-    else:
-        import os
-
-        res = None
-        if not os.environ.get("DUT_NO_NATIVE"):
-            from duplexumiconsensusreads_tpu.io.native_reader import read_bam_native
-
-            res = read_bam_native(in_path, duplex=duplex)
-        if res is not None:
-            header, batch, info = res
-        else:
-            header, recs = read_bam(in_path)
-            batch, info = records_to_readbatch(recs, duplex=duplex)
-        rep.n_records = info["n_records"]
-        rep.n_dropped = (
-            info["n_dropped_no_umi"]
-            + info["n_dropped_umi_len"]
-            + info.get("n_dropped_flag", 0)
-            + info.get("n_dropped_cigar", 0)
-        )
+    header, batch, info = load_input(in_path, duplex=duplex)
+    rep.n_records = info["n_records"]
+    rep.n_dropped = (
+        info.get("n_dropped_no_umi", 0)
+        + info.get("n_dropped_umi_len", 0)
+        + info.get("n_dropped_flag", 0)
+        + info.get("n_dropped_cigar", 0)
+    )
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
     rep.seconds["read_input"] = round(time.time() - t0, 4)
 

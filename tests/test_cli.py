@@ -184,6 +184,18 @@ def test_config_file_layer(tmp_path):
     assert json.load(open(rep2_path))["n_consensus"] < rep["n_consensus"]
 
 
+def test_stats_subcommand(tmp_path, capsys):
+    bam, _ = _simulate(tmp_path, molecules=80, umi_error=0.02, seed=31)
+    assert main(["stats", bam, "--duplex", "--json"]) == 0
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["n_valid_reads"] > 0
+    assert res["n_molecules"] > 0
+    assert res["n_families"] >= res["n_molecules"]
+    assert sum(res["family_size_hist"].values()) == res["n_families"]
+    assert res["duplex_complete_molecules"] > 0
+    assert res["mean_family_size"] > 0
+
+
 def test_npz_input(tmp_path):
     from duplexumiconsensusreads_tpu.io import save_readbatch
     from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
