@@ -198,6 +198,57 @@ def modal_cigar_keep(
     return keep
 
 
+def warn_mixed_mates(flags: np.ndarray, pos_key, umi, strand_ab, valid) -> int:
+    """Detect families containing BOTH R1 and R2 mates and warn.
+
+    Cycle-space consensus assumes every family member covers the same
+    cycles; a template's two mates cover opposite fragment ends, so
+    merging them corrupts columns. Proper mate-aware calling (fgbio
+    emits consensus R1+R2 pairs) is future work — until then the tool
+    warns loudly instead of silently mixing. Standard preprocessing
+    (split by read number: samtools view -f 64 / -f 128) avoids it.
+    Returns the number of affected families.
+    """
+    import warnings as _warnings
+
+    v = np.asarray(valid, bool)
+    idx = np.nonzero(v)[0]
+    if not len(idx):
+        return 0
+    fl = np.asarray(flags)[idx]
+    paired = (fl & FLAG_PAIRED) != 0
+    if not paired.any():
+        return 0
+    r1 = ((fl & FLAG_READ1) != 0) & paired
+    r2 = ((fl & FLAG_READ2) != 0) & paired
+    # inputs split by read number (the recommended workflow) skip the
+    # family grouping entirely
+    if not (r1.any() and r2.any()):
+        return 0
+    words = pack_umi_words64(np.asarray(umi)[idx])
+    key = np.column_stack(
+        [
+            np.asarray(pos_key)[idx][:, None],
+            words,
+            np.asarray(strand_ab, bool)[idx][:, None].astype(np.int64),
+        ]
+    )
+    uniq, inv = np.unique(key, axis=0, return_inverse=True)
+    has_r1 = np.zeros(len(uniq), bool)
+    has_r2 = np.zeros(len(uniq), bool)
+    np.logical_or.at(has_r1, inv, r1)
+    np.logical_or.at(has_r2, inv, r2)
+    n_mixed = int((has_r1 & has_r2).sum())
+    if n_mixed:
+        _warnings.warn(
+            f"{n_mixed} famil{'y' if n_mixed == 1 else 'ies'} contain both "
+            "R1 and R2 mates: cycle-space consensus would mix opposite "
+            "fragment ends. Split the input by read number (samtools view "
+            "-f 64 / -f 128) and call each side separately."
+        )
+    return n_mixed
+
+
 def records_to_readbatch(
     recs: BamRecords, duplex: bool = True
 ) -> tuple[ReadBatch, dict]:
@@ -257,6 +308,9 @@ def records_to_readbatch(
     batch.valid &= keep
     batch.strand_ab &= keep
     n_cigar = n_before - int(batch.valid.sum())
+    n_mixed = warn_mixed_mates(
+        flags, batch.pos_key, batch.umi, batch.strand_ab, batch.valid
+    )
 
     info = {
         "n_records": n,
@@ -265,6 +319,7 @@ def records_to_readbatch(
         "n_dropped_umi_len": n_bad_len,
         "n_dropped_flag": n_flag_excluded,
         "n_dropped_cigar": n_cigar,
+        "n_mixed_mate_families": n_mixed,
         "umi_len": umi_len,
     }
     return batch, info

@@ -245,6 +245,10 @@ def batch_from_offsets(
     valid = valid & keep
     n_cigar = int(valid_pre.sum()) - int(valid.sum())
 
+    from duplexumiconsensusreads_tpu.io.convert import warn_mixed_mates
+
+    n_mixed = warn_mixed_mates(flags, pos_key, umi_codes, top & valid, valid)
+
     batch = ReadBatch(
         bases=seq,
         quals=qual,
@@ -260,6 +264,7 @@ def batch_from_offsets(
         "n_dropped_umi_len": int((counted & ~valid_pre).sum()),
         "n_dropped_flag": int(excluded.sum()),
         "n_dropped_cigar": n_cigar,
+        "n_mixed_mate_families": n_mixed,
         "umi_len": umi_len,
         "native": True,
     }

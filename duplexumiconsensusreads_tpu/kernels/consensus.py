@@ -48,6 +48,10 @@ def _contributions(bases, quals, valid, max_input_qual, min_input_qual=0):
     real = (bases < N_REAL_BASES) & valid[:, None]
     if min_input_qual > 0:
         real = real & (quals >= min_input_qual)
+    # NOTE: a 256-entry qual->loglik LUT gather was tried here and is
+    # ~15x SLOWER than the elementwise transcendentals — TPU gathers
+    # with per-element dynamic indices serialize; the VPU chews
+    # pow/log1p/log at full rate. Keep the elementwise form.
     q = jnp.minimum(quals.astype(jnp.float32), float(max_input_qual))
     e = jnp.power(10.0, -q / 10.0)
     e = jnp.maximum(e, MIN_ERROR_PROB)

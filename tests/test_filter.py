@@ -107,6 +107,44 @@ def test_filter_passthrough_identity(tmp_path):
     assert a.names == b.names
 
 
+def test_mixed_mates_warns():
+    """A family holding both R1 and R2 mates (opposite fragment ends)
+    must warn loudly — cycle-space consensus cannot mix them."""
+    import warnings
+
+    from duplexumiconsensusreads_tpu.io.bam import (
+        FLAG_MATE_REVERSE,
+        FLAG_PAIRED,
+        FLAG_READ1,
+        FLAG_READ2,
+        FLAG_REVERSE,
+    )
+    from duplexumiconsensusreads_tpu.io.convert import (
+        records_to_readbatch,
+        simulated_bam,
+    )
+
+    cfg = SimConfig(n_molecules=20, duplex=False, seed=8)
+    _, recs, _, _ = simulated_bam(cfg, sort=True)
+    n = len(recs)
+    # make half of each family's reads R2 mates of the same template:
+    # F1R2 — R1 forward and R2 reverse BOTH classify as top strand,
+    # so the two mates land in one family
+    flags = np.asarray(recs.flags)
+    flags[::2] = FLAG_PAIRED | FLAG_READ1 | FLAG_MATE_REVERSE
+    flags[1::2] = FLAG_PAIRED | FLAG_READ2 | FLAG_REVERSE
+    recs.flags = flags.astype(np.uint16)
+    with pytest.warns(UserWarning, match="R1 and R2 mates"):
+        records_to_readbatch(recs, duplex=False)
+    # simulator's own paired-end convention (one read per strand) must
+    # NOT warn
+    cfg2 = SimConfig(n_molecules=20, duplex=True, seed=9)
+    _, recs2, _, _ = simulated_bam(cfg2, sort=True, paired_end=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        records_to_readbatch(recs2, duplex=True)
+
+
 def test_multi_chromosome_grouping_and_call(tmp_path):
     """Reads on different chromosomes at the same coordinate are
     different families (pos_key packs ref_id); the whole pipeline and
