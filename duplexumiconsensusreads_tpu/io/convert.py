@@ -178,8 +178,7 @@ def modal_cigar_keep(
     ch_all = cig_hash[idx]
     if (ch_all == ch_all[0]).all():
         return np.asarray(valid, bool).copy()
-    words = pack_umi_words64(np.asarray(umi)[idx])
-    fam = np.column_stack([np.asarray(pos_key)[idx][:, None], words])
+    fam = _family_cols(pos_key, umi, idx)
     # flip the sign bit so int64 comparison reproduces UNSIGNED hash
     # order ("ties to the smaller u64 hash" stays literally true)
     ch = (cig_hash[idx] ^ np.uint64(1 << 63)).view(np.int64)
@@ -198,6 +197,15 @@ def modal_cigar_keep(
     return keep
 
 
+def _family_cols(pos_key, umi, idx) -> np.ndarray:
+    """THE exact-family key columns — (pos_key, packed UMI words) per
+    selected read. Single source of truth for every conversion-time
+    family grouping (modal-CIGAR filter, mixed-mate detection)."""
+    return np.column_stack(
+        [np.asarray(pos_key)[idx][:, None], pack_umi_words64(np.asarray(umi)[idx])]
+    )
+
+
 def warn_mixed_mates(flags: np.ndarray, pos_key, umi, strand_ab, valid) -> int:
     """Detect families containing BOTH R1 and R2 mates and warn.
 
@@ -207,7 +215,12 @@ def warn_mixed_mates(flags: np.ndarray, pos_key, umi, strand_ab, valid) -> int:
     emits consensus R1+R2 pairs) is future work — until then the tool
     warns loudly instead of silently mixing. Standard preprocessing
     (split by read number: samtools view -f 64 / -f 128) avoids it.
-    Returns the number of affected families.
+    Must run on the PRE-CIGAR-filter mask: mates often differ in
+    soft-clips, so the modal-CIGAR filter would hide exactly the
+    families this check exists to surface. Returns the number of
+    affected exact families — a LOWER bound under adjacency grouping
+    (a mate with an errored UMI joins its cluster but forms a distinct
+    exact key here).
     """
     import warnings as _warnings
 
@@ -225,11 +238,9 @@ def warn_mixed_mates(flags: np.ndarray, pos_key, umi, strand_ab, valid) -> int:
     # family grouping entirely
     if not (r1.any() and r2.any()):
         return 0
-    words = pack_umi_words64(np.asarray(umi)[idx])
     key = np.column_stack(
         [
-            np.asarray(pos_key)[idx][:, None],
-            words,
+            _family_cols(pos_key, umi, idx),
             np.asarray(strand_ab, bool)[idx][:, None].astype(np.int64),
         ]
     )
@@ -240,11 +251,13 @@ def warn_mixed_mates(flags: np.ndarray, pos_key, umi, strand_ab, valid) -> int:
     np.logical_or.at(has_r2, inv, r2)
     n_mixed = int((has_r1 & has_r2).sum())
     if n_mixed:
+        # stable text (no counts) so the warnings module dedups it on
+        # chunked runs; the count travels in info/run reports instead
         _warnings.warn(
-            f"{n_mixed} famil{'y' if n_mixed == 1 else 'ies'} contain both "
-            "R1 and R2 mates: cycle-space consensus would mix opposite "
-            "fragment ends. Split the input by read number (samtools view "
-            "-f 64 / -f 128) and call each side separately."
+            "input families contain both R1 and R2 mates: cycle-space "
+            "consensus would mix opposite fragment ends. Split the input "
+            "by read number (samtools view -f 64 / -f 128) and call each "
+            "side separately. See n_mixed_mate_families in the report."
         )
     return n_mixed
 
@@ -301,6 +314,11 @@ def records_to_readbatch(
     batch.quals[:] = recs.qual
     batch.pos_key[:] = pos_key
 
+    # mixed-mate detection BEFORE the CIGAR filter: mates often differ
+    # in soft-clips, so the modal filter would hide exactly these
+    n_mixed = warn_mixed_mates(
+        flags, batch.pos_key, batch.umi, batch.strand_ab, batch.valid
+    )
     n_before = int(batch.valid.sum())
     keep = modal_cigar_keep(
         batch.pos_key, batch.umi, batch.valid, cigar_hashes(recs.cigars)
@@ -308,9 +326,6 @@ def records_to_readbatch(
     batch.valid &= keep
     batch.strand_ab &= keep
     n_cigar = n_before - int(batch.valid.sum())
-    n_mixed = warn_mixed_mates(
-        flags, batch.pos_key, batch.umi, batch.strand_ab, batch.valid
-    )
 
     info = {
         "n_records": n,

@@ -240,14 +240,16 @@ def batch_from_offsets(
     # CIGAR/indel policy — must mirror records_to_readbatch exactly
     from duplexumiconsensusreads_tpu.io.convert import modal_cigar_keep
 
+    # mixed-mate detection BEFORE the CIGAR filter (mates often differ
+    # in soft-clips; the modal filter would hide exactly these)
+    from duplexumiconsensusreads_tpu.io.convert import warn_mixed_mates
+
+    n_mixed = warn_mixed_mates(flags, pos_key, umi_codes, top & valid, valid)
+
     valid_pre = valid  # pre-CIGAR mask: keeps the drop counters disjoint
     keep = modal_cigar_keep(pos_key, umi_codes, valid, cig_hash)
     valid = valid & keep
     n_cigar = int(valid_pre.sum()) - int(valid.sum())
-
-    from duplexumiconsensusreads_tpu.io.convert import warn_mixed_mates
-
-    n_mixed = warn_mixed_mates(flags, pos_key, umi_codes, top & valid, valid)
 
     batch = ReadBatch(
         bases=seq,

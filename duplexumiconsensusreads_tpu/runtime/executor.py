@@ -43,6 +43,7 @@ class RunReport:
     n_size_classes: int = 0
     n_pipeline_compiles: int = 0
     n_retries: int = 0  # streaming: chunks re-dispatched after a failure
+    n_mixed_mate_families: int = 0  # see io.convert.warn_mixed_mates
     backend: str = ""
     seconds: dict = dataclasses.field(default_factory=dict)
 
@@ -391,6 +392,7 @@ def call_consensus_file(
         + info.get("n_dropped_flag", 0)
         + info.get("n_dropped_cigar", 0)
     )
+    rep.n_mixed_mate_families = info.get("n_mixed_mate_families", 0)
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
     rep.seconds["read_input"] = round(time.time() - t0, 4)
 

@@ -806,6 +806,7 @@ def stream_call_consensus(
                 + info.get("n_dropped_flag", 0)
                 + info.get("n_dropped_cigar", 0)
             )
+            rep.n_mixed_mate_families += info.get("n_mixed_mate_families", 0)
             buckets = build_buckets(batch, capacity=capacity, grouping=grouping)
             rep.n_buckets += len(buckets)
             if not buckets:

@@ -38,6 +38,7 @@ def _random_case(rng):
         mode="duplex" if duplex else "single_strand",
         min_reads=int(rng.integers(1, 3)),
         min_duplex_reads=int(rng.integers(1, 3)),
+        min_input_qual=int(rng.choice([0, 0, 15, 25])),
         error_model=[None, "cycle"][rng.integers(0, 2)],
     )
     return cfg, gp, cp
@@ -119,6 +120,42 @@ def test_pipeline_matches_oracle_random(trial):
     # compared at least one row
     if int(np.asarray(oracle.valid).sum()) > 0:
         assert n_checked > 0
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_streamed_call_matches_wholefile_random(trial, tmp_path):
+    """Random configs (with indels): the streaming executor's output
+    must equal the whole-file executor's, byte for byte."""
+    from duplexumiconsensusreads_tpu.cli import main
+
+    rng = np.random.default_rng(7000 + trial)
+    cfg = SimConfig(
+        n_molecules=int(rng.integers(40, 150)),
+        read_len=int(rng.integers(25, 70)),
+        n_positions=int(rng.integers(2, 10)),
+        mean_family_size=int(rng.integers(1, 6)),
+        umi_error=float(rng.uniform(0, 0.03)),
+        indel_error=float(rng.choice([0.0, 0.05])),
+        duplex=True,
+        seed=int(rng.integers(0, 1 << 30)),
+    )
+    path = str(tmp_path / "in.bam")
+    simulated_bam(cfg, path=path, sort=True)
+    common = ["--config", "config3", "--capacity", "128"]
+    whole = str(tmp_path / "w.bam")
+    stream = str(tmp_path / "s.bam")
+    assert main(["call", path, "-o", whole, *common]) == 0
+    assert main(
+        ["call", path, "-o", stream, "--chunk-reads",
+         str(int(rng.integers(50, 400))), *common]
+    ) == 0
+    _, rw = read_bam(whole)
+    _, rs = read_bam(stream)
+    assert len(rw) == len(rs)
+    np.testing.assert_array_equal(rw.pos, rs.pos)
+    np.testing.assert_array_equal(rw.seq, rs.seq)
+    np.testing.assert_array_equal(rw.qual, rs.qual)
+    assert list(rw.umi) == list(rs.umi)
 
 
 def test_paired_end_flags_roundtrip(tmp_path):
