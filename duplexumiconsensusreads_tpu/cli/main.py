@@ -241,9 +241,10 @@ def _cmd_call(args) -> int:
     )
 
     def opt(name, default):
-        """Precedence: explicit flag (None = unset, so --capacity 0 or
-        any falsy value is still an explicit override) > config file >
-        preset > default."""
+        """Precedence: explicit flag (None = unset, so falsy values
+        like --min-input-qual 0 are still explicit overrides) > config
+        file > preset > default. Value validity is checked separately
+        (e.g. capacity must be >= 1)."""
         v = getattr(args, name)
         if v is not None:
             return v
@@ -280,6 +281,8 @@ def _cmd_call(args) -> int:
         raise SystemExit(
             f"unknown config preset {args.config or fileconf.get('config')!r}"
         )
+    if capacity < 1:
+        raise SystemExit(f"--capacity must be >= 1 (got {capacity})")
 
     gp = GroupingParams(
         strategy=grouping,

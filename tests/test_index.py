@@ -137,6 +137,41 @@ def test_cli_multihost_per_host_outputs(tmp_path):
     assert total > 0
 
 
+def test_ranged_checkpoint_not_resumed_across_iterator_flavors(
+    tmp_path, monkeypatch
+):
+    """A RANGED checkpoint manifest written by the native iterator must
+    not be resumed by the Python fallback (their chunk boundaries
+    differ in range mode); no-range manifests stay interchangeable."""
+    from duplexumiconsensusreads_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native loader unavailable")
+    path = _sorted_bam(tmp_path, n_mol=100, n_positions=10)
+    idx = build_linear_index(path, every=80)
+    rng = host_input_range(idx, process_id=1, num_processes=2)
+    assert rng is not None
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex")
+    out = str(tmp_path / "r.bam")
+    ck = str(tmp_path / "ck.json")
+    kw = dict(capacity=128, chunk_reads=80, checkpoint_path=ck)
+
+    rep1 = stream_call_consensus(path, out, gp, cp, input_range=rng, **kw)
+    assert rep1.n_chunks > 0
+    # same flavor: resume skips everything
+    rep2 = stream_call_consensus(
+        path, out, gp, cp, input_range=rng, resume=True, **kw
+    )
+    assert rep2.n_chunks_skipped == rep2.n_chunks > 0
+    # other flavor: fingerprint differs -> nothing skipped
+    monkeypatch.setenv("DUT_NO_NATIVE", "1")
+    rep3 = stream_call_consensus(
+        path, out, gp, cp, input_range=rng, resume=True, **kw
+    )
+    assert rep3.n_chunks_skipped == 0
+
+
 def test_fallback_range_filtering_matches_native(tmp_path, monkeypatch):
     """DUT_NO_NATIVE range mode must yield the same records (no seek,
     full scan + filter)."""
