@@ -312,6 +312,13 @@ def _cmd_call(args) -> int:
         # file, shard dir, and auto-checkpoint
         base, ext = _os.path.splitext(args.output)
         host_out = f"{base}.host{args.host_id}{ext or '.bam'}"
+        # an explicit --checkpoint needs the same per-host suffix as the
+        # output: hosts share pod storage but fingerprint different
+        # input ranges, so a shared manifest path would have each host
+        # overwrite the others' and defeat --resume on every host
+        host_ckpt = (
+            f"{args.checkpoint}.host{args.host_id}" if args.checkpoint else None
+        )
         rep = multihost_call(
             args.input,
             host_out,
@@ -324,7 +331,7 @@ def _cmd_call(args) -> int:
             chunk_reads=chunk_reads,
             n_devices=devices,
             max_inflight=max_inflight,
-            checkpoint_path=args.checkpoint,
+            checkpoint_path=host_ckpt,
             resume=args.resume,
             report_path=args.report,
             profile_dir=args.profile,
@@ -537,40 +544,60 @@ def _cmd_filter(args) -> int:
         _records_from_raw,
     )
 
-    def aux_i(aux: bytes, tag: bytes) -> int:
-        """Walk the aux records properly (a raw substring scan could
-        match the tag pattern inside another field's VALUE bytes)."""
+    _INT_FMT = {b"c": "<b", b"C": "<B", b"s": "<h", b"S": "<H",
+                b"i": "<i", b"I": "<I"}
+
+    def aux_i(aux: bytes, tag: bytes) -> int | None:
+        """Integer aux value for ``tag``, walking the aux records
+        properly (a raw substring scan could match the tag pattern
+        inside another field's VALUE bytes). Accepts every BAM integer
+        type (c/C/s/S/i/I) — consensus BAMs from other writers store
+        small depths as c/s (ADVICE r2). Returns None when the tag is
+        absent; raises on a malformed aux stream or a non-integer
+        value under the tag, so missing-tag and broken-record inputs
+        are distinguishable instead of both silently filtering."""
         off, end = 0, len(aux)
         while off + 3 <= end:
             t, typ = aux[off : off + 2], aux[off + 2 : off + 3]
             off += 3
-            if typ in (b"A", b"c", b"C"):
+            fmt = _INT_FMT.get(typ)
+            if fmt is not None:
+                if t == tag:
+                    return struct.unpack_from(fmt, aux, off)[0]
+                vlen = struct.calcsize(fmt)
+            elif typ in (b"A",):
                 vlen = 1
-            elif typ in (b"s", b"S"):
-                vlen = 2
-            elif typ in (b"i", b"I", b"f"):
-                if t == tag and typ == b"i":
-                    return struct.unpack_from("<i", aux, off)[0]
+            elif typ in (b"f",):
+                if t == tag:
+                    raise ValueError(
+                        f"aux tag {tag.decode()} has non-integer type 'f'"
+                    )
                 vlen = 4
             elif typ in (b"Z", b"H"):
                 z = aux.find(b"\x00", off)
                 if z < 0:
-                    return -1
+                    raise ValueError("malformed aux stream: unterminated Z/H")
                 vlen = z - off + 1
             elif typ == b"B":
+                if off + 5 > end:
+                    raise ValueError("malformed aux stream: truncated B array")
                 sub = aux[off : off + 1]
                 cnt = struct.unpack_from("<I", aux, off + 1)[0]
                 esz = 1 if sub in b"cC" else 2 if sub in b"sS" else 4
                 vlen = 5 + cnt * esz
             else:
-                return -1
+                raise ValueError(
+                    f"malformed aux stream: unknown type {typ!r}"
+                )
             off += vlen
-        return -1
+            if off > end:
+                raise ValueError("malformed aux stream: value past end")
+        return None
 
     reader = BamStreamReader(args.input)
     header = reader.header
     shell = serialize_bam(header, _empty_records())
-    n_in = n_kept = n_masked = 0
+    n_in = n_kept = n_masked = n_no_tag = 0
     try:
         with open(args.output, "wb") as out_f:
             out_f.write(bgzf.compress_fast(shell, eof=False))
@@ -598,12 +625,21 @@ def _cmd_filter(args) -> int:
                     recs.qual[low] = NO_CALL_QUAL
                 keep = np.ones(n, bool)
                 if args.min_depth > 0 or args.min_min_depth > 0:
-                    cd = np.fromiter(
-                        (aux_i(a, b"cD") for a in recs.aux_raw), np.int64, n
-                    )
-                    cm = np.fromiter(
-                        (aux_i(a, b"cM") for a in recs.aux_raw), np.int64, n
-                    )
+                    # a tag is only REQUIRED when its threshold is
+                    # active (a foreign BAM carrying just cD must still
+                    # be filterable on --min-depth). Records missing a
+                    # required tag are dropped but COUNTED and warned
+                    # about, never silently conflated with low depth
+                    cd = np.empty(n, np.int64)
+                    cm = np.empty(n, np.int64)
+                    for i, a in enumerate(recs.aux_raw):
+                        vd = aux_i(a, b"cD") if args.min_depth > 0 else 0
+                        vm = aux_i(a, b"cM") if args.min_min_depth > 0 else 0
+                        if vd is None or vm is None:
+                            n_no_tag += 1
+                            cd[i] = cm[i] = -1
+                        else:
+                            cd[i], cm[i] = vd, vm
                     keep &= cd >= args.min_depth
                     keep &= cm >= args.min_min_depth
                 if args.min_mean_qual > 0:
@@ -623,8 +659,27 @@ def _cmd_filter(args) -> int:
                     payload = serialize_bam(header, sub)[len(shell):]
                     out_f.write(bgzf.compress_fast(payload, eof=False))
             out_f.write(bgzf.BGZF_EOF)
+    except ValueError as e:
+        # a malformed record mid-stream must not leave a truncated,
+        # EOF-less output BAM behind for a later pipeline step to
+        # half-read — remove it and fail with a CLI error, not a
+        # traceback
+        import os as _os
+
+        try:
+            _os.remove(args.output)
+        except OSError:
+            pass
+        raise SystemExit(f"[duplexumi] filter: {e} (input record ~{n_in})")
     finally:
         reader.close()
+    if n_no_tag:
+        print(
+            f"[duplexumi] filter: WARNING: {n_no_tag} records lack the "
+            "cD/cM depth tags and were dropped by the depth filter "
+            "(input not produced by `duplexumi call`?)",
+            file=sys.stderr,
+        )
     print(
         f"[duplexumi] filter: kept {n_kept}/{n_in} consensus reads"
         + (f", masked {n_masked} bases" if args.mask_qual > 0 else ""),

@@ -159,16 +159,22 @@ def modal_cigar_keep(
     umi: np.ndarray,  # (N, U) u8 canonical codes
     valid: np.ndarray,  # (N,) bool
     cig_hash: np.ndarray,  # (N,) u64
+    strand_ab: np.ndarray | None = None,  # (N,) bool
 ) -> np.ndarray:
     """CIGAR/indel policy (VERDICT r1 item 6): within each EXACT family
-    (pos_key, canonical UMI), keep only reads carrying the family's
-    modal CIGAR (ties to the smaller hash). Consensus math operates on
-    raw cycles, so a read whose alignment differs from its family's
-    (indel, clipping) would misalign every downstream column; a true
-    indel-bearing molecule keeps its own family intact because ALL its
-    reads share the indel CIGAR. Exact-family granularity is chosen
-    over adjacency-cluster granularity so the filter can run at input
-    conversion, identically for the oracle and the device pipeline.
+    (pos_key, canonical UMI, strand), keep only reads carrying the
+    family's modal CIGAR (ties to the smaller hash). Consensus math
+    operates on raw cycles, so a read whose alignment differs from its
+    family's (indel, clipping) would misalign every downstream column;
+    a true indel-bearing molecule keeps its own family intact because
+    ALL its reads share the indel CIGAR. The A/B strand sub-families
+    are independent alignments that can legitimately differ in
+    soft-clipping, so the modal vote runs PER STRAND (ADVICE r2) —
+    keying on (pos, UMI) alone would silently drop a whole minority
+    strand and downgrade the molecule from duplex to single-strand.
+    Exact-family granularity is chosen over adjacency-cluster
+    granularity so the filter can run at input conversion, identically
+    for the oracle and the device pipeline.
     Returns the reduced validity mask."""
     idx = np.nonzero(np.asarray(valid, bool))[0]
     if not len(idx):
@@ -179,6 +185,10 @@ def modal_cigar_keep(
     if (ch_all == ch_all[0]).all():
         return np.asarray(valid, bool).copy()
     fam = _family_cols(pos_key, umi, idx)
+    if strand_ab is not None:
+        fam = np.column_stack(
+            [fam, np.asarray(strand_ab, bool)[idx][:, None].astype(np.int64)]
+        )
     # flip the sign bit so int64 comparison reproduces UNSIGNED hash
     # order ("ties to the smaller u64 hash" stays literally true)
     ch = (cig_hash[idx] ^ np.uint64(1 << 63)).view(np.int64)
@@ -321,7 +331,8 @@ def records_to_readbatch(
     )
     n_before = int(batch.valid.sum())
     keep = modal_cigar_keep(
-        batch.pos_key, batch.umi, batch.valid, cigar_hashes(recs.cigars)
+        batch.pos_key, batch.umi, batch.valid, cigar_hashes(recs.cigars),
+        batch.strand_ab,
     )
     batch.valid &= keep
     batch.strand_ab &= keep

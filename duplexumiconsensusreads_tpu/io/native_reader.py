@@ -247,7 +247,7 @@ def batch_from_offsets(
     n_mixed = warn_mixed_mates(flags, pos_key, umi_codes, top & valid, valid)
 
     valid_pre = valid  # pre-CIGAR mask: keeps the drop counters disjoint
-    keep = modal_cigar_keep(pos_key, umi_codes, valid, cig_hash)
+    keep = modal_cigar_keep(pos_key, umi_codes, valid, cig_hash, top)
     valid = valid & keep
     n_cigar = int(valid_pre.sum()) - int(valid.sum())
 

@@ -290,19 +290,14 @@ def _records_from_raw(header: BamHeader, raw: bytes) -> BamRecords:
     return recs
 
 
-def _resolve_chunk_boundary(keys: np.ndarray, prev_last):
-    """THE chunk-boundary rule, shared by the Python and native chunk
-    iterators (their boundaries must stay byte-identical — checkpoint
-    manifests key chunks by index). On the combined buffer's pos_keys,
-    returns (cut, new_prev_last):
+def _validate_sort_contract(keys: np.ndarray, prev_last) -> None:
+    """Raise on a streaming sort-contract violation (shared wording).
 
-      cut == 0         entire buffer is one position group: keep growing
-      cut == len(keys) unmapped sentinel tail: flush everything, no
-                       hold-back (sentinel keys are never groupable)
-      otherwise        yield records [:cut], hold back the final group
-
-    Raises on sort-contract violations (the one shared wording).
-    """
+    Factored out of _resolve_chunk_boundary so range-mode early-exit
+    paths (key_hi cut, EOF carry flush) can validate chunks that never
+    reach the boundary rule — an unsorted final in-range chunk must
+    fail loudly, not be silently mis-truncated by searchsorted
+    (ADVICE r2)."""
     if len(keys) > 1 and (np.diff(keys) < 0).any():
         i = int(np.nonzero(np.diff(keys) < 0)[0][0])
         raise ValueError(
@@ -318,6 +313,22 @@ def _resolve_chunk_boundary(keys: np.ndarray, prev_last):
             "input violates the streaming sort contract across a "
             "chunk boundary (pos_key repeats after being flushed)"
         )
+
+
+def _resolve_chunk_boundary(keys: np.ndarray, prev_last):
+    """THE chunk-boundary rule, shared by the Python and native chunk
+    iterators (their boundaries must stay byte-identical — checkpoint
+    manifests key chunks by index). On the combined buffer's pos_keys,
+    returns (cut, new_prev_last):
+
+      cut == 0         entire buffer is one position group: keep growing
+      cut == len(keys) unmapped sentinel tail: flush everything, no
+                       hold-back (sentinel keys are never groupable)
+      otherwise        yield records [:cut], hold back the final group
+
+    Raises on sort-contract violations (the one shared wording).
+    """
+    _validate_sort_contract(keys, prev_last)
     # Unmapped EOF tail: sentinel-key records are never groupable (the
     # FLAG filter invalidates them downstream), so family integrity
     # doesn't apply — flush immediately. Carrying them would be
@@ -472,6 +483,7 @@ def iter_batch_chunks(
                     he, lm, rm, off = scan_region(lib, data, path)
                     if key_hi is not None and len(off):
                         keys = region_pos_keys(data, off)
+                        _validate_sort_contract(keys, prev_last)
                         off = off[: int(np.searchsorted(keys, key_hi, side="left"))]
                     if len(off):
                         yield emit(data, off, lm, rm)
@@ -483,6 +495,10 @@ def iter_batch_chunks(
             he, lm, rm, rec_off = scan_region(lib, data, path)
             keys = region_pos_keys(data, rec_off)
             if not lo_done and len(keys):
+                # searchsorted assumes sorted keys; an unsorted chunk
+                # must raise here, not be silently mis-cut (the a ==
+                # len(keys) discard below would even swallow it whole)
+                _validate_sort_contract(keys, prev_last)
                 a = int(np.searchsorted(keys, key_lo, side="left"))
                 if a == len(keys):
                     carry = b""  # everything below the range: discard
@@ -490,6 +506,10 @@ def iter_batch_chunks(
                 rec_off, keys = rec_off[a:], keys[a:]
                 lo_done = True
             if key_hi is not None and len(keys) and keys[-1] >= key_hi:
+                # the boundary rule never sees this final chunk, so the
+                # sort contract must be validated here before the
+                # searchsorted cut (unsorted keys would mis-truncate)
+                _validate_sort_contract(keys, prev_last)
                 b = int(np.searchsorted(keys, key_hi, side="left"))
                 if b:
                     yield emit(data, rec_off[:b], lm, rm)

@@ -32,6 +32,24 @@ def test_modal_cigar_keep_drops_minority():
     np.testing.assert_array_equal(keep, [True, True, True, True, False, True])
 
 
+def test_modal_cigar_vote_is_per_strand():
+    """A/B strand sub-families are independent alignments: a minority
+    strand with its own (legitimately different) soft-clipping must NOT
+    be dropped by the other strand's modal vote (ADVICE r2)."""
+    pos = np.zeros(5, np.int64)
+    umi = np.zeros((5, 4), np.uint8)
+    valid = np.ones(5, bool)
+    # 3 top-strand reads share cigar 7; 2 bottom-strand reads share 9.
+    strand = np.array([True, True, True, False, False])
+    h = np.array([7, 7, 7, 9, 9], np.uint64)
+    keep = modal_cigar_keep(pos, umi, valid, h, strand)
+    np.testing.assert_array_equal(keep, [True] * 5)
+    # within one strand the minority cigar still loses
+    h2 = np.array([7, 7, 12, 9, 9], np.uint64)
+    keep2 = modal_cigar_keep(pos, umi, valid, h2, strand)
+    np.testing.assert_array_equal(keep2, [True, True, False, True, True])
+
+
 def test_modal_cigar_tie_deterministic():
     """2-2 tie: the smaller hash wins, deterministically."""
     pos = np.zeros(4, np.int64)

@@ -95,6 +95,46 @@ def test_filter_mask_and_nfrac(tmp_path):
         assert (s == 4).sum() <= 0.5 * l
 
 
+def test_filter_foreign_int_types_and_missing_tags(tmp_path, capsys):
+    """Depth filtering must accept every BAM integer aux type (other
+    writers store small depths as c/s/S), and records LACKING the depth
+    tags must be counted + warned about, not silently conflated with
+    low depth (ADVICE r2)."""
+    import struct
+
+    from duplexumiconsensusreads_tpu.io.bam import write_bam
+
+    cons = _make_consensus(tmp_path)
+    header, recs = read_bam(cons)
+    # rewrite aux: record 0 loses its depth tags entirely; the rest get
+    # cD as int16 's' and cM as uint8 'C' (foreign-writer flavour)
+    for i in range(len(recs)):
+        a = recs.aux_raw[i]
+        j = a.find(b"cDi")
+        cd = struct.unpack_from("<i", a, j + 3)[0]
+        k = a.find(b"cMi")
+        cm = struct.unpack_from("<i", a, k + 3)[0]
+        rx_end = a.find(b"cDi")
+        if i == 0:
+            recs.aux_raw[i] = a[:rx_end]
+        else:
+            recs.aux_raw[i] = (
+                a[:rx_end]
+                + b"cDs" + struct.pack("<h", cd)
+                + b"cMC" + struct.pack("<B", min(cm, 255))
+            )
+    foreign = str(tmp_path / "foreign.bam")
+    write_bam(foreign, header, recs)
+    out = str(tmp_path / "ff.bam")
+    assert main(["filter", foreign, "-o", out, "--min-depth", "1"]) == 0
+    err = capsys.readouterr().err
+    assert "1 records lack the cD/cM depth tags" in err
+    _, after = read_bam(out)
+    # every tagged record had cD >= 1 (they produced consensus), so only
+    # the tagless record is dropped
+    assert len(after) == len(recs) - 1
+
+
 def test_filter_passthrough_identity(tmp_path):
     cons = _make_consensus(tmp_path)
     out = str(tmp_path / "id.bam")

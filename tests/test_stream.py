@@ -330,6 +330,24 @@ def test_unsorted_input_rejected(tmp_path):
         list(iter_record_chunks(path, chunk_reads=50))
 
 
+def test_unsorted_final_range_chunk_rejected(tmp_path):
+    """Range mode's key_hi early-exit must validate the sort contract
+    BEFORE its searchsorted cut: an unsorted final in-range chunk has
+    to raise, not silently mis-truncate (ADVICE r2)."""
+    from duplexumiconsensusreads_tpu.runtime.stream import iter_batch_chunks
+
+    path = str(tmp_path / "unsorted.bam")
+    cfg = SimConfig(n_molecules=60, n_positions=8, seed=2)
+    simulated_bam(cfg, path=path, sort=False)  # simulator shuffles reads
+    # a key_hi below the max pos_key forces the early-exit path on the
+    # very first (unsorted) chunk
+    with pytest.raises(ValueError, match="sort contract"):
+        # keys are in [1000, 8000]; key_hi=999 guarantees the final
+        # chunk triggers the early exit (keys[-1] >= key_hi) where the
+        # old code would silently emit nothing
+        list(iter_batch_chunks(path, 10_000, duplex=True, key_hi=999))
+
+
 def test_shards_cleaned_without_checkpoint(tmp_path):
     import os
 
