@@ -49,6 +49,7 @@ class Bucket:
     pos: np.ndarray  # (R,) i32 bucket-local dense position ids
     umi: np.ndarray  # (R, B) u8
     strand_ab: np.ndarray  # (R,) bool
+    frag_end: np.ndarray  # (R,) bool
     valid: np.ndarray  # (R,) bool
     bases: np.ndarray  # (R, L) u8
     quals: np.ndarray  # (R, L) u8
@@ -69,6 +70,7 @@ def _empty_bucket(r: int, l: int, b: int) -> Bucket:
         pos=np.zeros(r, np.int32),
         umi=np.zeros((r, b), np.uint8),
         strand_ab=np.zeros(r, bool),
+        frag_end=np.zeros(r, bool),
         valid=np.zeros(r, bool),
         bases=np.full((r, l), BASE_PAD, np.uint8),
         quals=np.zeros((r, l), np.uint8),
@@ -92,6 +94,7 @@ def _fill_bucket(
     bk.pos[:n] = dense_pos_ids(np.asarray(batch.pos_key)[idx])
     bk.umi[:n] = umi
     bk.strand_ab[:n] = np.asarray(batch.strand_ab)[idx]
+    bk.frag_end[:n] = np.asarray(batch.frag_end)[idx]
     bk.valid[:n] = np.asarray(batch.valid)[idx]
     bk.bases[:n] = np.asarray(batch.bases)[idx]
     bk.quals[:n] = np.asarray(batch.quals)[idx]
@@ -326,6 +329,7 @@ def stack_buckets(buckets: list[Bucket], multiple_of: int = 1) -> dict:
         "pos": np.stack([x.pos for x in padded]),
         "umi": np.stack([x.umi for x in padded]),
         "strand_ab": np.stack([x.strand_ab for x in padded]),
+        "frag_end": np.stack([x.frag_end for x in padded]),
         "valid": np.stack([x.valid for x in padded]),
         "bases": np.stack([x.bases for x in padded]),
         "quals": np.stack([x.quals for x in padded]),

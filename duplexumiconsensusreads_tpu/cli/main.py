@@ -65,6 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="mask input bases below this quality (fgbio-style "
         "min-input-base-quality; masked bases add no evidence/depth)",
     )
+    c.add_argument(
+        "--mate-aware",
+        choices=["auto", "on", "off"],
+        default=None,
+        help="paired-end mate handling: split families by fragment end "
+        "and emit consensus R1+R2 pairs (fgbio-style). auto (default) "
+        "turns it on exactly when the input mixes R1 and R2 mates",
+    )
     c.add_argument("--capacity", type=int, default=None, help="bucket read capacity")
     c.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     c.add_argument(
@@ -125,6 +133,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--paired-end",
         action="store_true",
         help="emit paired-end style flags (F1R2/F2R1) with mate pointers",
+    )
+    s.add_argument(
+        "--paired-reads",
+        action="store_true",
+        help="simulate true R1+R2 mate pairs: each fragment end has its "
+        "own ground-truth sequence (exercises mate-aware calling)",
     )
     s.add_argument("--seed", type=int, default=0)
 
@@ -217,7 +231,7 @@ def _load_config_file(path: str) -> dict:
         "backend", "grouping", "mode", "error_model", "max_hamming",
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
         "min_input_qual", "capacity", "devices", "cycle_shards",
-        "chunk_reads", "max_inflight", "config",
+        "chunk_reads", "max_inflight", "config", "mate_aware",
     }
     unknown = set(conf) - allowed
     if unknown:
@@ -263,6 +277,7 @@ def _cmd_call(args) -> int:
     cycle_shards = opt("cycle_shards", 1)
     devices = opt("devices", None)
     max_inflight = opt("max_inflight", 4)
+    mate_aware = opt("mate_aware", "auto")
 
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour
@@ -271,10 +286,11 @@ def _cmd_call(args) -> int:
         "mode": {"ss", "duplex"},
         "error_model": {"none", "cycle"},
         "backend": {"tpu", "cpu"},
+        "mate_aware": {"auto", "on", "off"},
     }
     for _k, _allowed in _check.items():
         _v = {"grouping": grouping, "mode": mode, "error_model": error_model,
-              "backend": backend}[_k]
+              "backend": backend, "mate_aware": mate_aware}[_k]
         if _v not in _allowed:
             raise SystemExit(f"invalid {_k} value {_v!r} (allowed: {sorted(_allowed)})")
     if (args.config or fileconf.get("config")) and not preset:
@@ -336,6 +352,7 @@ def _cmd_call(args) -> int:
             report_path=args.report,
             profile_dir=args.profile,
             cycle_shards=cycle_shards,
+            mate_aware=mate_aware,
         )
         if rep is None:
             print("[duplexumi] host has no records in range; idle", file=sys.stderr)
@@ -360,6 +377,7 @@ def _cmd_call(args) -> int:
             report_path=args.report,
             profile_dir=args.profile,
             cycle_shards=cycle_shards,
+            mate_aware=mate_aware,
         )
     else:
         rep = call_consensus_file(
@@ -373,10 +391,12 @@ def _cmd_call(args) -> int:
             report_path=args.report,
             profile_dir=args.profile,
             cycle_shards=cycle_shards,
+            mate_aware=mate_aware,
         )
+    pairs = f", {rep.n_consensus_pairs} R1+R2 pairs" if rep.mate_aware else ""
     print(
         f"[duplexumi] {rep.n_valid_reads}/{rep.n_records} reads → "
-        f"{rep.n_consensus} consensus ({rep.n_molecules} molecules, "
+        f"{rep.n_consensus} consensus ({rep.n_molecules} molecules{pairs}, "
         f"{rep.n_buckets} buckets, backend={rep.backend}) "
         f"in {sum(rep.seconds.values()):.2f}s {rep.seconds}",
         file=sys.stderr,
@@ -402,12 +422,16 @@ def _cmd_simulate(args) -> int:
         umi_error=args.umi_error,
         indel_error=args.indel_error,
         duplex=not args.single_strand,
+        paired_reads=args.paired_reads,
         seed=args.seed,
     )
     _, recs, batch, truth = simulated_bam(
         cfg, path=args.output, sort=args.sorted, paired_end=args.paired_end
     )
     if args.truth:
+        extra = {}
+        if truth.mol_seq2 is not None:
+            extra["mol_seq2"] = truth.mol_seq2
         np.savez_compressed(
             args.truth,
             mol_seq=truth.mol_seq,
@@ -416,6 +440,7 @@ def _cmd_simulate(args) -> int:
             read_mol=truth.read_mol,
             read_strand=truth.read_strand,
             duplex=np.bool_(cfg.duplex),
+            **extra,
         )
     print(
         f"[duplexumi] simulated {len(recs)} reads / {args.molecules} molecules "
@@ -435,11 +460,16 @@ def _cmd_validate(args) -> int:
         unpack_pos_key,
     )
 
+    from duplexumiconsensusreads_tpu.io.bam import FLAG_READ2
+
     _, recs = read_bam(args.consensus)
     with np.load(args.truth) as z:
         mol_seq = z["mol_seq"]
         mol_pos_key = z["mol_pos_key"]
         mol_umi = z["mol_umi"]
+        # paired-reads truth: fragment end 2 has its own sequence;
+        # consensus R2 records validate against it
+        mol_seq2 = z["mol_seq2"] if "mol_seq2" in z.files else None
 
     # truth pos_key is the simulator's raw key; consensus BAM re-packs it
     # as (ref=0) << 36 | pos, so compare on the coordinate part
@@ -465,7 +495,8 @@ def _cmd_validate(args) -> int:
         n_match += 1
         l = int(recs.lengths[i])
         called = recs.seq[i, :l]
-        true = mol_seq[m][:l]
+        is_r2 = bool(recs.flags[i] & FLAG_READ2)
+        true = (mol_seq2 if (is_r2 and mol_seq2 is not None) else mol_seq)[m][:l]
         real = called != 4
         n_err += int((called[real] != true[real]).sum())
         n_base += int(real.sum())
@@ -503,9 +534,13 @@ def _cmd_validate(args) -> int:
         else:
             cls["other"] += 1
 
+    from duplexumiconsensusreads_tpu.runtime.executor import count_consensus_pairs
+
     rate = n_err / max(n_base, 1)
+    n_pairs = count_consensus_pairs(recs)
     out = {
         "n_consensus": len(recs),
+        "n_consensus_pairs": n_pairs,
         "n_matched_to_truth": n_match,
         "n_unmatched": len(unmatched_idx),
         "unmatched": cls,

@@ -20,24 +20,33 @@ from duplexumiconsensusreads_tpu.io.convert import (
 from duplexumiconsensusreads_tpu.io.npz import load_readbatch, save_readbatch
 
 
-def load_input(path: str, duplex: bool):
+def load_input(path: str, duplex: bool, warn_mixed: bool = True):
     """ONE input loader for every consumer (call, stats, ...): .npz
     ReadBatch interchange, else native BAM parse when available
     (DUT_NO_NATIVE=1 forces the portable codec), else pure Python.
-    Returns (header, batch, info)."""
+    Returns (header, batch, info). warn_mixed=False defers the
+    mixed-mate warning to the caller (mate-aware auto-resolution
+    decides whether it applies)."""
     import os
 
     if path.endswith(".npz"):
+        from duplexumiconsensusreads_tpu.io.convert import mixed_ends_present
+
         batch = load_readbatch(path)
-        return BamHeader.synthetic(), batch, {"n_records": batch.n_reads}
+        return BamHeader.synthetic(), batch, {
+            "n_records": batch.n_reads,
+            # same auto-detection semantics as the BAM codecs: on only
+            # when some family actually mixes fragment ends
+            "mixed_mates": mixed_ends_present(batch),
+        }
     if not os.environ.get("DUT_NO_NATIVE"):
         from duplexumiconsensusreads_tpu.io.native_reader import read_bam_native
 
-        res = read_bam_native(path, duplex=duplex)
+        res = read_bam_native(path, duplex=duplex, warn_mixed=warn_mixed)
         if res is not None:
             return res
     header, recs = read_bam(path)
-    batch, info = records_to_readbatch(recs, duplex=duplex)
+    batch, info = records_to_readbatch(recs, duplex=duplex, warn_mixed=warn_mixed)
     return header, batch, info
 
 

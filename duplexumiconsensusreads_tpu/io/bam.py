@@ -35,7 +35,9 @@ _NIBBLE_TO_CODE[8] = 3  # T
 _CODE_TO_NIBBLE = np.array([1, 2, 4, 8, 15, 15], np.uint8)  # A C G T N PAD→N
 
 FLAG_PAIRED = 0x1
+FLAG_PROPER_PAIR = 0x2
 FLAG_UNMAPPED = 0x4
+FLAG_MATE_UNMAPPED = 0x8
 FLAG_REVERSE = 0x10
 FLAG_MATE_REVERSE = 0x20
 FLAG_READ1 = 0x40

@@ -216,38 +216,57 @@ def _family_cols(pos_key, umi, idx) -> np.ndarray:
     )
 
 
-def warn_mixed_mates(flags: np.ndarray, pos_key, umi, strand_ab, valid) -> int:
-    """Detect families containing BOTH R1 and R2 mates and warn.
+MIXED_MATE_WARNING = (
+    "input families contain both R1 and R2 mates: cycle-space "
+    "consensus would mix opposite fragment ends. Use mate-aware "
+    "calling (--mate-aware on, the default auto resolution) or "
+    "split the input by read number (samtools view -f 64 / "
+    "-f 128). See n_mixed_mate_families in the report."
+)
+
+
+def warn_mixed_mates(
+    flags: np.ndarray, pos_key, umi, strand_ab, valid, warn: bool = True
+) -> tuple[int, bool]:
+    """Detect families containing BOTH R1 and R2 mates.
 
     Cycle-space consensus assumes every family member covers the same
     cycles; a template's two mates cover opposite fragment ends, so
-    merging them corrupts columns. Proper mate-aware calling (fgbio
-    emits consensus R1+R2 pairs) is future work — until then the tool
-    warns loudly instead of silently mixing. Standard preprocessing
-    (split by read number: samtools view -f 64 / -f 128) avoids it.
+    merging them corrupts columns. Mate-aware grouping
+    (GroupingParams.mate_aware, resolved automatically by the CLI)
+    handles this properly by splitting families on the fragment-end
+    bit and emitting consensus R1+R2 pairs; callers that run WITHOUT
+    mate-aware grouping leave ``warn`` on so the hazard stays loud.
     Must run on the PRE-CIGAR-filter mask: mates often differ in
     soft-clips, so the modal-CIGAR filter would hide exactly the
-    families this check exists to surface. Returns the number of
-    affected exact families — a LOWER bound under adjacency grouping
-    (a mate with an errored UMI joins its cluster but forms a distinct
-    exact key here).
+    families this check exists to surface. Returns (n_mixed,
+    mixed_present): the number of affected exact families — a LOWER
+    bound under adjacency grouping (a mate with an errored UMI joins
+    its cluster but forms a distinct exact key here) — and whether any
+    family actually mixes the two mates (the CLI's mate-aware
+    auto-detection signal). Mere R1+R2 flag PRESENCE is deliberately
+    not the signal: classic one-read-per-strand F1R2/F2R1 inputs carry
+    both flags yet every strand-keyed family is single-mate, and
+    mate-aware grouping must stay off there (it provably changes
+    nothing for such inputs, but the emitted records would gain paired
+    flags).
     """
     import warnings as _warnings
 
     v = np.asarray(valid, bool)
     idx = np.nonzero(v)[0]
     if not len(idx):
-        return 0
+        return 0, False
     fl = np.asarray(flags)[idx]
     paired = (fl & FLAG_PAIRED) != 0
     if not paired.any():
-        return 0
+        return 0, False
     r1 = ((fl & FLAG_READ1) != 0) & paired
     r2 = ((fl & FLAG_READ2) != 0) & paired
     # inputs split by read number (the recommended workflow) skip the
     # family grouping entirely
     if not (r1.any() and r2.any()):
-        return 0
+        return 0, False
     key = np.column_stack(
         [
             _family_cols(pos_key, umi, idx),
@@ -260,27 +279,53 @@ def warn_mixed_mates(flags: np.ndarray, pos_key, umi, strand_ab, valid) -> int:
     np.logical_or.at(has_r1, inv, r1)
     np.logical_or.at(has_r2, inv, r2)
     n_mixed = int((has_r1 & has_r2).sum())
-    if n_mixed:
+    if n_mixed and warn:
         # stable text (no counts) so the warnings module dedups it on
         # chunked runs; the count travels in info/run reports instead
-        _warnings.warn(
-            "input families contain both R1 and R2 mates: cycle-space "
-            "consensus would mix opposite fragment ends. Split the input "
-            "by read number (samtools view -f 64 / -f 128) and call each "
-            "side separately. See n_mixed_mate_families in the report."
-        )
-    return n_mixed
+        _warnings.warn(MIXED_MATE_WARNING)
+    return n_mixed, n_mixed > 0
+
+
+def mixed_ends_present(batch) -> bool:
+    """True iff some exact (pos_key, UMI, strand) family holds reads of
+    BOTH fragment ends — the batch-level twin of warn_mixed_mates'
+    mixed-mate detection, for inputs that carry no BAM flags (npz).
+    Mere presence of second-end reads is NOT the signal: a
+    split-by-read-number file has end-2 reads (bottom-strand R1) in
+    every family, yet each family is single-end and mate-aware grouping
+    must stay off for it."""
+    v = np.asarray(batch.valid, bool)
+    idx = np.nonzero(v)[0]
+    if not len(idx):
+        return False
+    e2 = np.asarray(batch.frag_end, bool)[idx]
+    if not e2.any() or e2.all():
+        return False
+    key = np.column_stack(
+        [
+            _family_cols(batch.pos_key, batch.umi, idx),
+            np.asarray(batch.strand_ab, bool)[idx][:, None].astype(np.int64),
+        ]
+    )
+    uniq, inv = np.unique(key, axis=0, return_inverse=True)
+    has1 = np.zeros(len(uniq), bool)
+    has2 = np.zeros(len(uniq), bool)
+    np.logical_or.at(has1, inv, ~e2)
+    np.logical_or.at(has2, inv, e2)
+    return bool((has1 & has2).any())
 
 
 def records_to_readbatch(
-    recs: BamRecords, duplex: bool = True
+    recs: BamRecords, duplex: bool = True, warn_mixed: bool = True
 ) -> tuple[ReadBatch, dict]:
     """Convert parsed BAM records into a padded ReadBatch.
 
     Returns (batch, info); info counts reads dropped for missing/N UMIs,
     inconsistent UMI length, excluded FLAGs, or a CIGAR differing from
     the exact family's modal CIGAR. Dropped reads occupy invalid slots
-    so read indices stay aligned with ``recs``.
+    so read indices stay aligned with ``recs``. ``warn_mixed=False``
+    suppresses the mixed-mate warning (mate-aware callers handle those
+    families; the counter still fills).
     """
     n = len(recs)
     l = recs.seq.shape[1] if n else 0
@@ -313,12 +358,18 @@ def records_to_readbatch(
         if len(codes) != umi_len:
             n_bad_len += 1
             continue
-        top = read_is_top_strand(int(flags[i]))
+        fl = int(flags[i])
+        top = read_is_top_strand(fl)
         if duplex and not top:
             h = umi_len // 2
             codes = np.concatenate([codes[h:], codes[:h]])
         batch.umi[i] = codes
         batch.strand_ab[i] = top
+        # fragment-end bit: top-R1 and bottom-R2 observe end 1 (the
+        # cross-mate duplex partners); single-end records are end 1
+        batch.frag_end[i] = bool(fl & FLAG_PAIRED) and (
+            bool(fl & FLAG_READ2) == top
+        )
         batch.valid[i] = True
     batch.bases[:] = recs.seq
     batch.quals[:] = recs.qual
@@ -326,8 +377,9 @@ def records_to_readbatch(
 
     # mixed-mate detection BEFORE the CIGAR filter: mates often differ
     # in soft-clips, so the modal filter would hide exactly these
-    n_mixed = warn_mixed_mates(
-        flags, batch.pos_key, batch.umi, batch.strand_ab, batch.valid
+    n_mixed, mixed_present = warn_mixed_mates(
+        flags, batch.pos_key, batch.umi, batch.strand_ab, batch.valid,
+        warn=warn_mixed,
     )
     n_before = int(batch.valid.sum())
     keep = modal_cigar_keep(
@@ -336,6 +388,7 @@ def records_to_readbatch(
     )
     batch.valid &= keep
     batch.strand_ab &= keep
+    batch.frag_end &= keep
     n_cigar = n_before - int(batch.valid.sum())
 
     info = {
@@ -346,6 +399,7 @@ def records_to_readbatch(
         "n_dropped_flag": n_flag_excluded,
         "n_dropped_cigar": n_cigar,
         "n_mixed_mate_families": n_mixed,
+        "mixed_mates": mixed_present,
         "umi_len": umi_len,
     }
     return batch, info
@@ -362,10 +416,13 @@ def readbatch_to_records(
     de-canonicalised (swapped back for BA reads).
 
     paired_end=False emits single-end records (reverse flag = strand).
-    paired_end=True emits paired-style flags instead — top strand as
-    F1R2 (read1 forward, mate reverse), bottom as F2R1 — with a mate
-    pointer at the same position, exercising the full paired strand
-    derivation and min(pos, next_pos) pos_key path end-to-end.
+    paired_end=True emits paired-style flags instead, derived from the
+    strand AND fragment-end bits: read number = frag_end XOR
+    bottom-strand, reverse iff the read number equals the top-strand
+    bit (so a frag_end-free batch reproduces the classic F1R2/F2R1
+    one-read-per-strand convention) — with a mate pointer at the same
+    position, exercising the full paired strand/mate derivation and
+    min(pos, next_pos) pos_key path end-to-end.
     """
     from duplexumiconsensusreads_tpu.io.bam import FLAG_MATE_REVERSE
 
@@ -377,9 +434,15 @@ def readbatch_to_records(
     ref_id, pos = unpack_pos_key(np.asarray(batch.pos_key)[idx])
     strand = np.asarray(batch.strand_ab, bool)[idx]
     if paired_end:
-        top_flag = FLAG_PAIRED | FLAG_READ1 | FLAG_MATE_REVERSE  # F1R2
-        bot_flag = FLAG_PAIRED | FLAG_READ2 | FLAG_MATE_REVERSE  # F2(R1)
-        flags = np.where(strand, top_flag, bot_flag).astype(np.uint16)
+        e2 = np.asarray(batch.frag_end, bool)[idx]
+        r2 = e2 ^ ~strand
+        rev = r2 == strand
+        flags = (
+            FLAG_PAIRED
+            | np.where(r2, FLAG_READ2, FLAG_READ1)
+            | np.where(rev, FLAG_REVERSE, 0)
+            | np.where(rev, 0, FLAG_MATE_REVERSE)
+        ).astype(np.uint16)
     else:
         flags = np.where(strand, 0, FLAG_REVERSE).astype(np.uint16)
 
@@ -451,17 +514,76 @@ def consensus_to_records(
     fam_umi: np.ndarray,  # (F, U) u8 representative canonical UMI per family
     duplex: bool,
     name_prefix: str = "cons",
+    cons_mate: np.ndarray | None = None,  # (F,) second-mate bit
+    cons_pair: np.ndarray | None = None,  # (F,) i64 template link
+    paired_out: bool = False,
 ) -> BamRecords:
     """Build consensus BAM records from (scattered-back) pipeline output.
 
     Emitted per valid family/molecule: a mapped record at the family's
     canonical position with RX (canonical UMI), cD (max depth) and cM
     (min positive depth) aux tags — the fgbio-style consensus metadata.
+
+    paired_out=True (mate-aware runs) re-links output rows into
+    consensus R1/R2 mates: two rows sharing a cons_pair value with
+    opposite cons_mate bits become a proper read pair — shared qname,
+    FLAG_PAIRED|PROPER|READ1/READ2, mate pointer at the shared
+    canonical position. Rows whose partner emitted no consensus (e.g.
+    one fragment end failed min_duplex_reads) stay single-end records.
     """
     idx = np.nonzero(np.asarray(cons_valid, bool))[0]
     n = len(idx)
     l = cons_base.shape[1]
     ref_id, pos = unpack_pos_key(fam_pos_key[idx])
+
+    # -------- mate-pair linking (mate-aware emission) --------
+    flags_v = np.zeros(n, np.uint16)
+    next_ref = np.full(n, -1, np.int32)
+    next_pos_v = np.full(n, -1, np.int32)
+    tlen_v = np.zeros(n, np.int32)
+    pair_gid = np.full(n, -1, np.int64)  # rows in a complete pair share it
+    if paired_out and cons_pair is not None and n:
+        mate = np.asarray(cons_mate)[idx].astype(np.int64)
+        pairk = np.asarray(cons_pair)[idx].astype(np.int64)
+        order = np.lexsort((mate, pairk))
+        pk_s = pairk[order]
+        mate_s = mate[order]
+        new_grp = np.r_[True, pk_s[1:] != pk_s[:-1]]
+        gid_s = np.cumsum(new_grp) - 1
+        grp_start = np.nonzero(new_grp)[0]
+        grp_size = np.diff(np.r_[grp_start, len(pk_s)])
+        # complete = exactly two rows whose (mate-sorted) mates are 0, 1
+        comp_grp = grp_size == 2
+        two = grp_start[comp_grp]
+        comp_grp[comp_grp] = (
+            (mate_s[two] == 0) & (mate_s[two + 1] == 1) & (pk_s[two] >= 0)
+        )
+        row_complete = comp_grp[gid_s]
+        inv = np.empty(n, np.int64)
+        inv[order] = np.arange(n)
+        row_complete_n = row_complete[inv]
+        mate_n = mate
+        pair_gid = np.where(row_complete_n, gid_s[inv], -1)
+        from duplexumiconsensusreads_tpu.io.bam import (
+            FLAG_MATE_UNMAPPED,
+            FLAG_PROPER_PAIR,
+        )
+
+        # every mate-aware row keeps its read-number flag — a row whose
+        # partner emitted no consensus is still the R1 (or R2) side of
+        # its template, and validators/downstream tools need that bit;
+        # the missing partner is declared via FLAG_MATE_UNMAPPED
+        rnum = np.where(mate_n == 1, FLAG_READ2, FLAG_READ1)
+        flags_v = (
+            FLAG_PAIRED
+            | rnum
+            | np.where(row_complete_n, FLAG_PROPER_PAIR, FLAG_MATE_UNMAPPED)
+        ).astype(np.uint16)
+        next_ref = np.where(row_complete_n, ref_id, -1).astype(np.int32)
+        next_pos_v = np.where(row_complete_n, pos, -1).astype(np.int32)
+        tlen_v = np.where(
+            row_complete_n, np.where(mate_n == 1, -l, l), 0
+        ).astype(np.int32)
     # vectorised RX strings: code matrix -> ASCII bytes (+ separator
     # column for duplex pairs), one decode per batch instead of a
     # Python join per record
@@ -479,10 +601,20 @@ def consensus_to_records(
     cm_bytes = ds[:, 1].astype("<i4").tobytes()
     names, aux = [], []
     rid_l, pos_l, idx_l = ref_id.tolist(), pos.tolist(), idx.tolist()
+    gid_l = pair_gid.tolist()
     for k in range(n):
         # fixed-width fields -> identical record layout -> uniform
-        # vectorised serializer (io/bam.py)
-        names.append(f"{name_prefix}:{rid_l[k]}:{pos_l[k]:010d}:{idx_l[k]:07d}")
+        # vectorised serializer (io/bam.py). Mate pairs share a qname
+        # (their pair-group id); the s/p suffix keeps the single-record
+        # and pair id spaces from colliding at equal width.
+        if gid_l[k] >= 0:
+            names.append(
+                f"{name_prefix}:{rid_l[k]}:{pos_l[k]:010d}:{gid_l[k]:07d}p"
+            )
+        else:
+            names.append(
+                f"{name_prefix}:{rid_l[k]}:{pos_l[k]:010d}:{idx_l[k]:07d}s"
+            )
         aux.append(
             b"RXZ"
             + umis[k].encode("ascii")
@@ -493,13 +625,13 @@ def consensus_to_records(
         )
     return BamRecords(
         names=names,
-        flags=np.zeros(n, np.uint16),
+        flags=flags_v,
         ref_id=ref_id,
         pos=pos,
         mapq=np.full(n, 60, np.uint8),
-        next_ref_id=np.full(n, -1, np.int32),
-        next_pos=np.full(n, -1, np.int32),
-        tlen=np.zeros(n, np.int32),
+        next_ref_id=next_ref,
+        next_pos=next_pos_v,
+        tlen=tlen_v,
         lengths=np.full(n, l, np.int32),
         seq=np.where(cons_base[idx] == BASE_PAD, 4, cons_base[idx]).astype(np.uint8),
         qual=cons_qual[idx].astype(np.uint8),
@@ -530,10 +662,18 @@ def simulated_bam(
         order = np.argsort(np.asarray(batch.pos_key), kind="stable")
         batch = batch.take(order)
         truth = _dc.replace(
-            truth, read_mol=truth.read_mol[order], read_strand=truth.read_strand[order]
+            truth,
+            read_mol=truth.read_mol[order],
+            read_strand=truth.read_strand[order],
+            read_end2=(
+                None if truth.read_end2 is None else truth.read_end2[order]
+            ),
         )
     header = BamHeader.synthetic()
-    recs = readbatch_to_records(batch, duplex=cfg.duplex, paired_end=paired_end)
+    # true mate pairs only exist in BAM form as paired-end records
+    recs = readbatch_to_records(
+        batch, duplex=cfg.duplex, paired_end=paired_end or cfg.paired_reads
+    )
     if cfg.indel_error > 0:
         inject_indels(recs, cfg.indel_error, seed=cfg.seed + 9999)
     if path is not None:

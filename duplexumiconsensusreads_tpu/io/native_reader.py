@@ -23,6 +23,7 @@ import numpy as np
 from duplexumiconsensusreads_tpu.io.bam import (
     FLAG_PAIRED,
     FLAG_READ1,
+    FLAG_READ2,
     FLAG_REVERSE,
     BamHeader,
     consensus_excluded,
@@ -110,7 +111,10 @@ def region_pos_keys(data: np.ndarray, rec_off: np.ndarray) -> np.ndarray:
 
 
 def read_bam_native(
-    path: str, duplex: bool = True, n_threads: int | None = None
+    path: str,
+    duplex: bool = True,
+    n_threads: int | None = None,
+    warn_mixed: bool = True,
 ) -> tuple[BamHeader, ReadBatch, dict] | None:
     """Parse a BAM file via the native loader. None if lib unavailable."""
     from duplexumiconsensusreads_tpu.native import get_lib
@@ -136,7 +140,8 @@ def read_bam_native(
     header_end, l_max, rx_max, rec_off = scan_region(lib, data, path)
     header = _parse_header_region(data[:header_end].tobytes(), header_end)
     batch, info = batch_from_offsets(
-        lib, data, rec_off, l_max, rx_max, duplex=duplex, n_threads=nt
+        lib, data, rec_off, l_max, rx_max, duplex=duplex, n_threads=nt,
+        warn_mixed=warn_mixed,
     )
     return header, batch, info
 
@@ -149,6 +154,7 @@ def batch_from_offsets(
     rx_max: int,
     duplex: bool,
     n_threads: int,
+    warn_mixed: bool = True,
 ) -> tuple[ReadBatch, dict]:
     """Native fill + vectorised ReadBatch assembly for the records at
     ``rec_off`` within ``data`` (uncompressed BAM bytes). l_max/rx_max
@@ -224,7 +230,10 @@ def batch_from_offsets(
     paired = (f & FLAG_PAIRED) != 0
     rev = (f & FLAG_REVERSE) != 0
     r1 = (f & FLAG_READ1) != 0
+    r2 = (f & FLAG_READ2) != 0
     top = np.where(paired, r1 != rev, ~rev)
+    # fragment-end bit — must mirror records_to_readbatch exactly
+    frag_end = paired & (r2 == top)
 
     if duplex and umi_len:
         h = umi_len // 2
@@ -244,7 +253,9 @@ def batch_from_offsets(
     # in soft-clips; the modal filter would hide exactly these)
     from duplexumiconsensusreads_tpu.io.convert import warn_mixed_mates
 
-    n_mixed = warn_mixed_mates(flags, pos_key, umi_codes, top & valid, valid)
+    n_mixed, mixed_present = warn_mixed_mates(
+        flags, pos_key, umi_codes, top & valid, valid, warn=warn_mixed
+    )
 
     valid_pre = valid  # pre-CIGAR mask: keeps the drop counters disjoint
     keep = modal_cigar_keep(pos_key, umi_codes, valid, cig_hash, top)
@@ -257,6 +268,7 @@ def batch_from_offsets(
         umi=umi_codes,
         pos_key=pos_key,
         strand_ab=top & valid,  # invalid rows keep the codec's False default
+        frag_end=frag_end & valid,
         valid=valid,
     )
     info = {
@@ -267,6 +279,7 @@ def batch_from_offsets(
         "n_dropped_flag": int(excluded.sum()),
         "n_dropped_cigar": n_cigar,
         "n_mixed_mate_families": n_mixed,
+        "mixed_mates": mixed_present,
         "umi_len": umi_len,
         "native": True,
     }

@@ -11,7 +11,7 @@ import numpy as np
 
 from duplexumiconsensusreads_tpu.types import ReadBatch
 
-_FIELDS = ("bases", "quals", "umi", "pos_key", "strand_ab", "valid")
+_FIELDS = ("bases", "quals", "umi", "pos_key", "strand_ab", "frag_end", "valid")
 
 
 def save_readbatch(path: str, batch: ReadBatch) -> None:
@@ -22,4 +22,12 @@ def save_readbatch(path: str, batch: ReadBatch) -> None:
 
 def load_readbatch(path: str) -> ReadBatch:
     with np.load(path) as z:
-        return ReadBatch(**{name: z[name] for name in _FIELDS})
+        fields = {}
+        for name in _FIELDS:
+            if name in z.files:
+                fields[name] = z[name]
+            elif name == "frag_end":  # pre-mate-aware npz files
+                fields[name] = np.zeros(z["valid"].shape, bool)
+            else:
+                raise KeyError(f"ReadBatch npz missing field {name!r}")
+        return ReadBatch(**fields)

@@ -116,27 +116,40 @@ def _directional_cluster(
 @partial(
     jax.jit,
     static_argnames=(
-        "strategy", "max_hamming", "count_ratio", "paired", "u_max", "presorted",
+        "strategy", "max_hamming", "count_ratio", "paired", "mate_aware",
+        "u_max", "presorted",
     ),
 )
 def group_kernel(
     pos: jnp.ndarray,  # (R,) i32 bucket-local dense position key
     umi_codes: jnp.ndarray,  # (R, B) u8 codes in {0..3} (N-UMI reads pre-dropped)
     strand_ab: jnp.ndarray,  # (R,) bool
+    frag_end: jnp.ndarray,  # (R,) bool (see ReadBatch.frag_end)
     valid: jnp.ndarray,  # (R,) bool
     *,
     strategy: str = "exact",
     max_hamming: int = 1,
     count_ratio: int = 2,
     paired: bool = False,
+    mate_aware: bool = False,
     u_max: int | None = None,
     presorted: bool = False,
 ):
-    """Returns (family_id, molecule_id, n_families, n_molecules, n_overflow).
+    """Returns (family_id, molecule_id, pair_id, n_families, n_molecules,
+    n_overflow).
 
-    family_id / molecule_id are (R,) i32 in original read order with
-    NO_FAMILY on invalid or overflowed reads; ids are dense and ordered
-    exactly like the oracle's (sorted (pos, cluster_umi[, strand])).
+    family_id / molecule_id / pair_id are (R,) i32 in original read
+    order with NO_FAMILY on invalid or overflowed reads; ids are dense
+    and ordered exactly like the oracle's (sorted (pos,
+    cluster_umi[, frag_end][, strand])). Under mate-aware grouping the
+    fragment-end bit joins the family identity, molecule_id becomes the
+    dense (molecule, frag_end) consensus-unit id (each unit emits its
+    own duplex call — top-R1 with bottom-R2), and pair_id carries the
+    true molecule so the two units of one template can be re-linked as
+    consensus R1/R2 mates at emission. Without mate_aware (or with no
+    second-end reads present) molecule_id == pair_id and ids are
+    bit-identical to the pre-mate-aware kernel.
+
     n_overflow counts reads dropped because the unique-(pos, UMI) table
     exceeded u_max slots — BOTH strategies route ids through the table,
     so size u_max >= the unique-key count (u_max=None defaults to R,
@@ -146,7 +159,9 @@ def group_kernel(
     already in ascending (pos, UMI-words) order AND invalid reads sit
     only at the tail (an interleaved invalid row would split a run).
     The bucketing layer guarantees exactly this, letting the kernel
-    skip every read-length device sort.
+    skip every read-length device sort. The frag_end/strand bits need
+    no sort of their own: family/unit ids come from order-independent
+    presence scatters over (molecule, bits) keys.
     """
     if strategy not in ("exact", "adjacency"):
         raise ValueError(f"unknown grouping strategy {strategy!r}")
@@ -222,36 +237,58 @@ def group_kernel(
         )
 
     slot_c = jnp.minimum(uid, u_max - 1)
-    mid_sorted = jnp.where(ok_sorted, jnp.take(mid_of_slot, slot_c), NO_FAMILY)
+    mid_raw = jnp.take(mid_of_slot, slot_c)
+    mid_sorted = jnp.where(ok_sorted, mid_raw, NO_FAMILY)
 
-    if paired:
-        sba = jnp.where(
-            (~strand_ab if presorted else ~strand_ab[order]), 1, 0
-        ).astype(jnp.int32)
-        # family key = (molecule, strand_ba); femb is monotone in that
-        # key, so a presence cumsum yields dense ids in oracle order
-        # (AB before BA) with zero sorts
-        femb = jnp.where(
-            ok_sorted, jnp.take(mid_of_slot, slot_c) * 2 + sba, 2 * u_max
+    def dense_rank(key_raw, k):
+        """Dense ids over present (molecule*k + bits) keys via a
+        presence scatter + cumsum — keys are monotone in the oracle's
+        sort order, so the ranks match np.unique with zero sorts."""
+        emb = jnp.where(ok_sorted, key_raw, k * u_max)
+        pres = jnp.zeros((k * u_max,), jnp.int32).at[emb].set(1, mode="drop")
+        rank = jnp.cumsum(pres) - 1
+        ids = jnp.where(
+            ok_sorted, jnp.take(rank, jnp.minimum(emb, k * u_max - 1)), NO_FAMILY
         )
-        pres = jnp.zeros((2 * u_max,), jnp.int32).at[femb].set(1, mode="drop")
-        fam_rank = jnp.cumsum(pres) - 1  # dense rank at each present key
-        fid_sorted = jnp.where(
-            ok_sorted, jnp.take(fam_rank, jnp.minimum(femb, 2 * u_max - 1)), NO_FAMILY
-        )
-        n_fam = jnp.sum(pres).astype(jnp.int32)
+        return ids, jnp.sum(pres).astype(jnp.int32)
+
+    sba = jnp.where(
+        (~strand_ab if presorted else ~strand_ab[order]), 1, 0
+    ).astype(jnp.int32)
+    e2 = jnp.where(
+        (frag_end if presorted else frag_end[order]), 1, 0
+    ).astype(jnp.int32)
+
+    # family key = (molecule[, frag_end][, strand_ba]); the embedding is
+    # monotone in the oracle's sorted key, so a presence cumsum yields
+    # dense ids in oracle order (end1 before end2, AB before BA)
+    if mate_aware and paired:
+        fid_sorted, n_fam = dense_rank(mid_raw * 4 + e2 * 2 + sba, 4)
+    elif mate_aware:
+        fid_sorted, n_fam = dense_rank(mid_raw * 2 + e2, 2)
+    elif paired:
+        fid_sorted, n_fam = dense_rank(mid_raw * 2 + sba, 2)
     else:
-        fid_sorted = mid_sorted
-        n_fam = n_mol
+        fid_sorted, n_fam = mid_sorted, n_mol
+
+    # mate-aware paired: the consensus output unit is (molecule,
+    # frag_end) — duplex merges its AB and BA families, which hold the
+    # opposite-mate reads covering the SAME fragment end
+    pair_sorted = mid_sorted
+    if mate_aware and paired:
+        mid_out_sorted, n_mol_out = dense_rank(mid_raw * 2 + e2, 2)
+    else:
+        mid_out_sorted, n_mol_out = mid_sorted, n_mol
 
     if presorted:
-        family_id, molecule_id = fid_sorted, mid_sorted
+        family_id, molecule_id, pair_id = fid_sorted, mid_out_sorted, pair_sorted
         ok = ok_sorted
     else:
         inv = jnp.zeros(r, jnp.int32).at[order].set(jnp.arange(r, dtype=jnp.int32))
         family_id = jnp.take(fid_sorted, inv)
-        molecule_id = jnp.take(mid_sorted, inv)
+        molecule_id = jnp.take(mid_out_sorted, inv)
+        pair_id = jnp.take(pair_sorted, inv)
         ok = jnp.take(ok_sorted, inv)
 
     n_overflow = jnp.sum(valid & ~ok).astype(jnp.int32)
-    return family_id, molecule_id, n_fam, n_mol, n_overflow
+    return family_id, molecule_id, pair_id, n_fam, n_mol_out, n_overflow

@@ -75,20 +75,23 @@ class UmiGrouper:
         )
         inv = np.empty_like(order)
         inv[order] = np.arange(len(order))
-        fam_s, mol_s, n_fam, n_mol, n_over = group_kernel(
+        fam_s, mol_s, pair_s, n_fam, n_mol, n_over = group_kernel(
             dense_pos_ids(batch.pos_key)[order],
             np.asarray(batch.umi)[order],
             np.asarray(batch.strand_ab)[order],
+            np.asarray(batch.frag_end)[order],
             valid_arr[order],
             strategy=p.strategy,
             max_hamming=p.max_hamming,
             count_ratio=p.count_ratio,
             paired=p.paired,
+            mate_aware=p.mate_aware,
             u_max=u_max,
             presorted=True,
         )
         fam = np.asarray(fam_s)[inv]
         mol = np.asarray(mol_s)[inv]
+        pair = np.asarray(pair_s)[inv]
         if int(n_over):
             import warnings
 
@@ -97,5 +100,6 @@ class UmiGrouper:
                 f"table (u_max={self.u_max}); size buckets larger or raise u_max"
             )
         return FamilyAssignment(
-            family_id=fam, molecule_id=mol, n_families=n_fam, n_molecules=n_mol
+            family_id=fam, molecule_id=mol, pair_id=pair,
+            n_families=n_fam, n_molecules=n_mol,
         )

@@ -84,13 +84,16 @@ def spec_for_buckets(
     r = buckets[0].capacity
     max_u = max(b.n_unique_umi for b in buckets)
     u_max = min(_pow2(max_u), r)
-    f_bound = 2 * max_u if grouping.paired else max_u
+    # family/unit multiplicity per unique (pos, UMI): strand doubles it,
+    # the mate-aware fragment-end bit doubles it again
+    f_mult = (2 if grouping.paired else 1) * (2 if grouping.mate_aware else 1)
+    m_mult = 2 if (grouping.mate_aware and grouping.paired) else 1
     return PipelineSpec(
         grouping=grouping,
         consensus=consensus,
         u_max=u_max,
-        f_max=min(_pow2(f_bound), r),
-        m_max=min(_pow2(max_u), r),
+        f_max=min(_pow2(f_mult * max_u), r),
+        m_max=min(_pow2(m_mult * max_u), r),
         ssc_method=ssc_method,
         presorted=True,  # bucketing's output contract
     )
@@ -125,6 +128,7 @@ def fused_pipeline(
     pos: jnp.ndarray,  # (R,) i32 bucket-local dense position ids
     umi: jnp.ndarray,  # (R, B) u8
     strand_ab: jnp.ndarray,  # (R,) bool
+    frag_end: jnp.ndarray,  # (R,) bool
     valid: jnp.ndarray,  # (R,) bool
     bases: jnp.ndarray,  # (R, L) u8
     quals: jnp.ndarray,  # (R, L) u8
@@ -135,20 +139,26 @@ def fused_pipeline(
       family_id, molecule_id (R,) i32; n_families, n_molecules,
       n_overflow scalars; cons_base/cons_qual/cons_depth (F, L);
       cons_valid (F,) — F = R rows, dense id order, padding rows invalid.
-      Duplex mode: the cons_* tensors are per-molecule; ss mode: per-family.
+      Duplex mode: the cons_* tensors are per-molecule (mate-aware: per
+      (molecule, frag_end) unit); ss mode: per-family.
+      cons_mate (F,) i32 marks second-mate output rows (R2 consensus);
+      cons_pair (F,) i32 links the R1/R2 rows of one template (-1 on
+      invalid rows) — both only meaningful under mate-aware grouping.
     """
     g, c = spec.grouping, spec.consensus
     r = pos.shape[0]
 
-    fam, mol, n_fam, n_mol, n_over = group_kernel(
+    fam, mol, pair, n_fam, n_mol, n_over = group_kernel(
         pos,
         umi,
         strand_ab,
+        frag_end,
         valid,
         strategy=g.strategy,
         max_hamming=g.max_hamming,
         count_ratio=g.count_ratio,
         paired=g.paired,
+        mate_aware=g.mate_aware,
         u_max=spec.u_max,
         presorted=spec.presorted,
     )
@@ -197,6 +207,40 @@ def fused_pipeline(
     else:
         raise ValueError(f"unknown consensus mode {c.mode!r}")
 
+    # Per-output-row mate/pair metadata (mate-aware emission): the
+    # second-mate bit and the template link, reduced from the read
+    # level with two tiny segment-mins (constant within a row's reads
+    # by construction, so min == the value).
+    duplex_out = c.mode == "duplex"
+    out_ids = mol if duplex_out else fam
+    n_rows = (m_max if duplex_out else f_max)
+    ok_r = valid & (out_ids >= 0)
+    seg = jnp.where(ok_r, jnp.minimum(out_ids, n_rows), n_rows)
+    e2_i = frag_end.astype(jnp.int32)
+    if duplex_out:
+        mate_read = e2_i  # unit rows: R2 output iff second fragment end
+        pair_read = pair
+    elif g.paired:
+        # ss family rows (molecule, end, strand): the member reads'
+        # read-number (frag_end XOR bottom-strand — constant, strand is
+        # in the key); pairs are (molecule, strand)
+        mate_read = e2_i ^ jnp.where(strand_ab, 0, 1)
+        pair_read = pair * 2 + jnp.where(strand_ab, 0, 1)
+    else:
+        # unpaired ss family rows (molecule, end) can mix strands, so
+        # the read-number is NOT constant within a row — label by the
+        # fragment end itself (end1 row emits as R1), paired by molecule
+        mate_read = e2_i
+        pair_read = pair
+    cons_mate = jax.ops.segment_min(
+        mate_read, seg, num_segments=n_rows + 1
+    )[:n_rows]
+    cons_pair = jax.ops.segment_min(
+        pair_read, seg, num_segments=n_rows + 1
+    )[:n_rows]
+    cons_mate = jnp.where(out_v, cons_mate, 0)
+    cons_pair = jnp.where(out_v, cons_pair, -1)
+
     # Per-family depth stats computed ON DEVICE: the writers only need
     # cD (max depth) and cM (min positive depth) per consensus, so the
     # executors fetch two (F,) vectors instead of the padded (F, L)
@@ -224,6 +268,8 @@ def fused_pipeline(
         "depth_max": d_max,
         "depth_min_pos": d_min_pos,
         "cons_valid": out_v,
+        "cons_mate": cons_mate.astype(jnp.uint8),
+        "cons_pair": cons_pair,
     }
 
 
@@ -234,6 +280,7 @@ def run_bucket(bucket, spec: PipelineSpec):
         bucket.pos,
         bucket.umi,
         bucket.strand_ab,
+        bucket.frag_end,
         bucket.valid,
         bucket.bases,
         bucket.quals,

@@ -75,12 +75,22 @@ def group_reads(batch: ReadBatch, params: GroupingParams) -> FamilyAssignment:
     mode a molecule has up to two single-strand families distinguished
     by strand_ab, ordered AB-before-BA in the dense family numbering.
     In unpaired mode family == molecule and strand is ignored.
+
+    Mate-aware mode (params.mate_aware) additionally splits families by
+    the fragment-end bit — a template's R1 and R2 mates cover opposite
+    fragment ends, so their cycles must never share a consensus column.
+    The reported molecule_id then becomes the dense (molecule,
+    frag_end) unit (each unit is one duplex output: its AB family holds
+    one mate's top-strand reads, its BA family the OTHER mate's
+    bottom-strand reads — the fgbio cross-mate pairing), and pair_id
+    keeps the true molecule for R1/R2 mate linking at emission.
     """
     n = batch.n_reads
     valid = np.asarray(batch.valid, bool)
     pos = np.asarray(batch.pos_key, np.int64)
     umi = np.asarray(batch.umi, np.uint8)
     strand = np.asarray(batch.strand_ab, bool)
+    e2 = np.asarray(batch.frag_end, bool)
 
     # Resolved per-read cluster UMI (packed words — any UMI length)
     # after exact/adjacency grouping.
@@ -105,24 +115,35 @@ def group_reads(batch: ReadBatch, params: GroupingParams) -> FamilyAssignment:
     # Dense molecule ids over (pos_key, cluster_umi), sorted.
     mol_key = np.column_stack([pos, cluster_umi])
     molecule_id = np.full(n, NO_FAMILY, np.int32)
+    pair_id = np.full(n, NO_FAMILY, np.int32)
     fam_id = np.full(n, NO_FAMILY, np.int32)
     if len(idx_valid):
         _, mol_inv = np.unique(mol_key[idx_valid], axis=0, return_inverse=True)
-        molecule_id[idx_valid] = mol_inv.astype(np.int32)
+        pair_id[idx_valid] = mol_inv.astype(np.int32)
+        bits = []
+        if params.mate_aware:
+            bits.append(e2[idx_valid].astype(np.int64))
         if params.paired:
-            fam_key = np.stack(
-                [mol_inv, (~strand[idx_valid]).astype(np.int64)], axis=1
-            )
+            bits.append((~strand[idx_valid]).astype(np.int64))
+        if bits:
+            fam_key = np.stack([mol_inv, *bits], axis=1)
             _, fam_inv = np.unique(fam_key, axis=0, return_inverse=True)
             fam_id[idx_valid] = fam_inv.astype(np.int32)
         else:
             fam_id[idx_valid] = mol_inv.astype(np.int32)
+        if params.mate_aware and params.paired:
+            unit_key = np.stack([mol_inv, e2[idx_valid].astype(np.int64)], axis=1)
+            _, unit_inv = np.unique(unit_key, axis=0, return_inverse=True)
+            molecule_id[idx_valid] = unit_inv.astype(np.int32)
+        else:
+            molecule_id[idx_valid] = mol_inv.astype(np.int32)
 
     n_mol = int(molecule_id.max() + 1) if len(idx_valid) else 0
     n_fam = int(fam_id.max() + 1) if len(idx_valid) else 0
     return FamilyAssignment(
         family_id=fam_id,
         molecule_id=molecule_id,
+        pair_id=pair_id,
         n_families=np.int32(n_fam),
         n_molecules=np.int32(n_mol),
     )

@@ -18,7 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from duplexumiconsensusreads_tpu.ops.pipeline import PipelineSpec, fused_pipeline
 
-_ARRAY_KEYS = ("pos", "umi", "strand_ab", "valid", "bases", "quals")
+_ARRAY_KEYS = ("pos", "umi", "strand_ab", "frag_end", "valid", "bases", "quals")
 
 
 def shard_stacked(stacked: dict, mesh: Mesh, axis: str = "data") -> dict:
@@ -40,10 +40,10 @@ def shard_stacked(stacked: dict, mesh: Mesh, axis: str = "data") -> dict:
 
 
 @partial(jax.jit, static_argnames=("spec",))
-def _vmapped(pos, umi, strand_ab, valid, bases, quals, spec):
+def _vmapped(pos, umi, strand_ab, frag_end, valid, bases, quals, spec):
     return jax.vmap(
         lambda *a: fused_pipeline(*a, spec)
-    )(pos, umi, strand_ab, valid, bases, quals)
+    )(pos, umi, strand_ab, frag_end, valid, bases, quals)
 
 
 def presharded_pipeline(args: dict, spec: PipelineSpec, mesh: Mesh) -> dict:
@@ -54,6 +54,7 @@ def presharded_pipeline(args: dict, spec: PipelineSpec, mesh: Mesh) -> dict:
             args["pos"],
             args["umi"],
             args["strand_ab"],
+            args["frag_end"],
             args["valid"],
             args["bases"],
             args["quals"],

@@ -44,6 +44,8 @@ class RunReport:
     n_pipeline_compiles: int = 0
     n_retries: int = 0  # streaming: chunks re-dispatched after a failure
     n_mixed_mate_families: int = 0  # see io.convert.warn_mixed_mates
+    n_consensus_pairs: int = 0  # mate-aware: consensus R1+R2 pairs emitted
+    mate_aware: bool = False  # resolved mate-aware mode of this run
     backend: str = ""
     seconds: dict = dataclasses.field(default_factory=dict)
 
@@ -107,15 +109,18 @@ def scatter_bucket_outputs(
     buckets,
     batch: ReadBatch,
     duplex: bool,
+    pair_base: int = 0,  # global bucket index of buckets[0] — see below
 ):
     """Map per-bucket device outputs back to source-batch coordinates.
 
-    Returns (cons_base, cons_qual, cons_dstats, fam_pos, fam_umi)
-    concatenated over buckets, containing only valid consensus rows
-    (rows past each bucket's real family/molecule count are dropped even
-    if a permissive min_reads left them flagged valid). cons_dstats is
-    the (n, 2) [cD, cM] table the writers need — the full (F, L) depth
-    matrix never leaves the device in production.
+    Returns (cons_base, cons_qual, cons_dstats, fam_pos, fam_umi,
+    cons_mate, cons_pair) concatenated over buckets, containing only
+    valid consensus rows (rows past each bucket's real family/molecule
+    count are dropped even if a permissive min_reads left them flagged
+    valid). cons_dstats is the (n, 2) [cD, cM] table the writers need —
+    the full (F, L) depth matrix never leaves the device in production.
+    cons_pair is globally unique across buckets (bucket-offset int64),
+    so mate re-linking at emission can never pair rows across buckets.
     Shared by the whole-file and streaming executors so their outputs
     cannot drift.
     """
@@ -150,6 +155,19 @@ def scatter_bucket_outputs(
     )
     fam_pos = fam_pos.reshape(nb, f)
     fam_umi = fam_umi.reshape(nb, f, -1)
+    # globally-unique pair keys: bucket-local links shifted into
+    # disjoint int64 blocks (a molecule's two fragment-end units always
+    # land in one bucket — bucketing keeps (pos, UMI) runs whole).
+    # pair_base makes the blocks unique across the CALLER'S scatter
+    # calls too — dispatch classes each restart bi at 0, and a
+    # collision would merge two unrelated molecules into a 4-row group
+    # that then fails pair completeness at emission
+    pair_local = out["cons_pair"][:nb].astype(np.int64)
+    pair_glob = np.where(
+        pair_local >= 0,
+        pair_local + ((pair_base + np.arange(nb, dtype=np.int64))[:, None] << 33),
+        -1,
+    )
     return (
         out["cons_base"][:nb][keep],
         out["cons_qual"][:nb][keep],
@@ -159,6 +177,8 @@ def scatter_bucket_outputs(
         ),
         fam_pos[keep],
         fam_umi[keep],
+        out["cons_mate"][:nb][keep],
+        pair_glob[keep],
     )
 
 
@@ -179,6 +199,8 @@ FETCH_KEYS = (
     "cons_qual",
     "depth_max",
     "depth_min_pos",
+    "cons_mate",
+    "cons_pair",
 )
 
 
@@ -236,13 +258,16 @@ def partition_buckets(
     return out
 
 
-def sort_consensus_outputs(cb, cq, cd, fp, fu):
+def sort_consensus_outputs(cb, cq, cd, fp, fu, mate, pair):
     """Order consensus rows by (pos_key, UMI) so the output BAM stays
     coordinate-sorted (class-wise dispatch visits buckets out of
     genomic order; downstream tools and our own streaming executor
     expect non-decreasing positions)."""
     order = np.lexsort((*reversed(umi_sort_keys(fu)), fp))
-    return cb[order], cq[order], cd[order], fp[order], fu[order]
+    return (
+        cb[order], cq[order], cd[order], fp[order], fu[order],
+        mate[order], pair[order],
+    )
 
 
 def call_batch_tpu(
@@ -256,8 +281,9 @@ def call_batch_tpu(
 ):
     """Run one host ReadBatch through the bucketed mesh pipeline.
 
-    Returns (cons_base, cons_qual, cons_depth, cons_valid, fam_pos,
-    fam_umi) concatenated over buckets in global dense-output order.
+    Returns (cons_base, cons_qual, cons_dstats, cons_valid, fam_pos,
+    fam_umi, cons_mate, cons_pair) concatenated over buckets in global
+    dense-output order.
     """
     import jax
 
@@ -282,6 +308,8 @@ def call_batch_tpu(
             z((0,), bool),
             z((0,), np.int64),
             z((0, u), np.uint8),
+            z((0,), np.uint8),
+            z((0,), np.int64),
         )
 
     n_dev = n_devices or len(jax.devices())
@@ -307,18 +335,26 @@ def call_batch_tpu(
 
     t0 = time.time()
     parts = []
+    pair_base = 0
     for cbuckets, out in pending:
         out = fetch_outputs(out)
         n_real = len(cbuckets)
         rep.n_families += int(out["n_families"][:n_real].sum())
         rep.n_molecules += int(out["n_molecules"][:n_real].sum())
-        parts.append(scatter_bucket_outputs(out, cbuckets, batch, duplex))
+        parts.append(
+            scatter_bucket_outputs(
+                out, cbuckets, batch, duplex, pair_base=pair_base
+            )
+        )
+        pair_base += n_real
     rep.seconds["device_pipeline_and_scatter"] = round(time.time() - t0, 4)
     rep.n_size_classes = len(part)
 
-    cb, cq, cd, fp, fu = (np.concatenate(x) for x in zip(*parts))
-    cb, cq, cd, fp, fu = sort_consensus_outputs(cb, cq, cd, fp, fu)
-    return (cb, cq, cd, np.ones(len(cb), bool), fp, fu)
+    cb, cq, cd, fp, fu, mate, pair = (np.concatenate(x) for x in zip(*parts))
+    cb, cq, cd, fp, fu, mate, pair = sort_consensus_outputs(
+        cb, cq, cd, fp, fu, mate, pair
+    )
+    return (cb, cq, cd, np.ones(len(cb), bool), fp, fu, mate, pair)
 
 
 def call_batch_cpu(
@@ -351,6 +387,31 @@ def call_batch_cpu(
     cv = np.asarray(cons.valid, bool)
     from duplexumiconsensusreads_tpu.io.convert import depth_stats
 
+    # per-output-row mate/pair metadata (host twin of the device
+    # pipeline's segment-min reduction — constant within a row's reads)
+    e2 = np.asarray(batch.frag_end, bool)
+    s = np.asarray(batch.strand_ab, bool)
+    pid = np.asarray(fams.pair_id).astype(np.int64)
+    if duplex:
+        mate_read = e2.astype(np.int64)
+        pair_read = pid
+    elif grouping.paired:
+        mate_read = (e2 ^ ~s).astype(np.int64)
+        pair_read = pid * 2 + (~s).astype(np.int64)
+    else:
+        # unpaired ss families (molecule, end) can mix strands: label
+        # rows by fragment end (mirrors the device pipeline exactly)
+        mate_read = e2.astype(np.int64)
+        pair_read = pid
+    sel = np.asarray(batch.valid, bool) & (ids >= 0)
+    big = np.iinfo(np.int64).max
+    mate = np.full(n_out, big, np.int64)
+    pair = np.full(n_out, big, np.int64)
+    np.minimum.at(mate, ids[sel], mate_read[sel])
+    np.minimum.at(pair, ids[sel], pair_read[sel])
+    mate = np.where(cv, np.minimum(mate, 1), 0).astype(np.uint8)
+    pair = np.where(cv & (pair < big), pair, -1)
+
     return (
         np.asarray(cons.bases)[cv],
         np.asarray(cons.quals)[cv],
@@ -358,7 +419,42 @@ def call_batch_cpu(
         np.ones(int(cv.sum()), bool),
         fam_pos[cv],
         fam_umi[cv],
+        mate[cv],
+        pair[cv],
     )
+
+
+def resolve_mate_aware(
+    grouping: GroupingParams, info: dict, setting: str = "auto"
+) -> GroupingParams:
+    """Resolve the CLI's --mate-aware setting against the loaded input.
+
+    auto = mate-aware exactly when the input's valid paired reads span
+    both read numbers (``info["mixed_mates"]``) — single-end and
+    split-by-read-number inputs keep the classic one-family-per-strand
+    semantics, which mate-aware grouping provably reproduces anyway
+    when no second-end reads exist.
+    """
+    if setting not in ("auto", "on", "off"):
+        raise ValueError(f"mate_aware must be auto/on/off, got {setting!r}")
+    on = bool(info.get("mixed_mates")) if setting == "auto" else setting == "on"
+    if on == grouping.mate_aware:
+        return grouping
+    return dataclasses.replace(grouping, mate_aware=on)
+
+
+def count_consensus_pairs(recs) -> int:
+    """Complete consensus R1+R2 pairs (singleton mates carry read-number
+    flags too, but with FLAG_MATE_UNMAPPED instead of PROPER_PAIR)."""
+    from duplexumiconsensusreads_tpu.io.bam import (
+        FLAG_PAIRED,
+        FLAG_PROPER_PAIR,
+        FLAG_READ1,
+    )
+
+    fl = np.asarray(recs.flags)
+    want = FLAG_PAIRED | FLAG_PROPER_PAIR | FLAG_READ1
+    return int(((fl & want) == want).sum())
 
 
 def call_consensus_file(
@@ -372,6 +468,7 @@ def call_consensus_file(
     report_path: str | None = None,
     profile_dir: str | None = None,
     cycle_shards: int = 1,
+    mate_aware: str = "auto",
 ) -> RunReport:
     """End-to-end: read BAM/npz → consensus → write consensus BAM."""
     from duplexumiconsensusreads_tpu.io import (
@@ -384,7 +481,13 @@ def call_consensus_file(
     duplex = consensus.mode == "duplex"
 
     t0 = time.time()
-    header, batch, info = load_input(in_path, duplex=duplex)
+    # the mixed-mate warning only applies when mate-aware stays off
+    # (auto-on and forced-on runs HANDLE those families)
+    header, batch, info = load_input(
+        in_path, duplex=duplex, warn_mixed=(mate_aware == "off")
+    )
+    grouping = resolve_mate_aware(grouping, info, mate_aware)
+    rep.mate_aware = grouping.mate_aware
     rep.n_records = info["n_records"]
     rep.n_dropped = (
         info.get("n_dropped_no_umi", 0)
@@ -404,12 +507,14 @@ def call_consensus_file(
         prof = profile_dir
     try:
         if backend == "tpu":
-            cb, cq, cd, cv, fp, fu = call_batch_tpu(
+            cb, cq, cd, cv, fp, fu, mate, pair = call_batch_tpu(
                 batch, grouping, consensus, capacity, n_devices, rep,
                 cycle_shards=cycle_shards,
             )
         elif backend == "cpu":
-            cb, cq, cd, cv, fp, fu = call_batch_cpu(batch, grouping, consensus, rep)
+            cb, cq, cd, cv, fp, fu, mate, pair = call_batch_cpu(
+                batch, grouping, consensus, rep
+            )
         else:
             raise ValueError(f"unknown backend {backend!r}")
     finally:
@@ -419,9 +524,13 @@ def call_consensus_file(
             jax.profiler.stop_trace()
 
     t0 = time.time()
-    out_recs = consensus_to_records(cb, cq, cd, cv, fp, fu, duplex=duplex)
+    out_recs = consensus_to_records(
+        cb, cq, cd, cv, fp, fu, duplex=duplex,
+        cons_mate=mate, cons_pair=pair, paired_out=grouping.mate_aware,
+    )
     write_bam(out_path, header, out_recs)
     rep.n_consensus = len(out_recs)
+    rep.n_consensus_pairs = count_consensus_pairs(out_recs)
     rep.seconds["write_output"] = round(time.time() - t0, 4)
 
     if report_path:

@@ -408,6 +408,7 @@ def iter_batch_chunks(
     start: tuple[int, int] | None = None,
     key_lo=None,
     key_hi=None,
+    warn_mixed: bool = True,
 ):
     """Yield (header, ReadBatch, info) chunks with the family-integrity
     hold-back of iter_record_chunks, but parsed NATIVELY: record fields
@@ -446,7 +447,9 @@ def iter_batch_chunks(
                     return
                 continue
             sub = recs if (a, b) == (0, len(recs)) else _slice_records(recs, a, b)
-            batch, info = records_to_readbatch(sub, duplex=duplex)
+            batch, info = records_to_readbatch(
+                sub, duplex=duplex, warn_mixed=warn_mixed
+            )
             yield header, batch, info
             if key_hi is not None and b < len(recs):
                 return
@@ -470,7 +473,8 @@ def iter_batch_chunks(
         return (
             header,
             *batch_from_offsets(
-                lib, data, offs, lm, rm, duplex=duplex, n_threads=nt
+                lib, data, offs, lm, rm, duplex=duplex, n_threads=nt,
+                warn_mixed=warn_mixed,
             ),
         )
 
@@ -608,8 +612,14 @@ class Checkpoint:
 
 
 def _fingerprint(
-    in_path: str, grouping, consensus, capacity, chunk_reads, input_range=None
+    in_path: str, grouping, consensus, capacity, chunk_reads, input_range=None,
+    mate_aware: str = "auto",
 ) -> str:
+    """The mate_aware SETTING (auto/on/off) joins the key rather than
+    the resolved boolean: resolution is a deterministic function of the
+    fingerprinted input file, and fingerprinting the setting lets the
+    manifest be initialised before any input byte is read (the
+    stale-manifest-clearing guarantee)."""
     st = os.stat(in_path)
     key = json.dumps(
         [
@@ -620,6 +630,7 @@ def _fingerprint(
             dataclasses.asdict(consensus),
             capacity,
             chunk_reads,
+            mate_aware,
             [list(x) if isinstance(x, tuple) else x for x in (input_range or [])],
             # range-mode chunk boundaries differ between the native and
             # Python iterators (the fallback ignores the seek and
@@ -662,6 +673,7 @@ def stream_call_consensus(
     max_retries: int = 3,
     input_range=None,  # (start_voffset, key_lo, key_hi) — multi-host partition
     name_tag: str = "",  # disambiguates consensus names across hosts
+    mate_aware: str = "auto",
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -672,30 +684,47 @@ def stream_call_consensus(
     shards after a successful finalise. Device failures retry with
     exponential backoff, then fall back to bucket-by-bucket re-dispatch
     so one poisoned bucket cannot take down a whole chunk class.
+
+    mate_aware="auto" resolves against the FIRST chunk (mates share a
+    canonical fragment pos_key, so any chunk holding paired templates
+    holds both their mates); the resolved mode is stable for the whole
+    run and joins the checkpoint fingerprint. If a later chunk turns
+    out mixed-mate under a resolved-off mode, the standard loud
+    warning fires and the counter fills — exactly the non-mate-aware
+    contract.
     """
+    import itertools
+    import warnings as _warnings
+
     import jax
 
     from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
     from duplexumiconsensusreads_tpu.io.bam import serialize_bam
     from duplexumiconsensusreads_tpu.parallel import make_mesh
     from duplexumiconsensusreads_tpu.parallel.sharded import sharded_pipeline
+    from duplexumiconsensusreads_tpu.runtime.executor import (
+        count_consensus_pairs,
+        resolve_mate_aware,
+    )
 
     rep = RunReport(backend="tpu-stream")
     duplex = consensus.mode == "duplex"
     t_start = time.time()
-    if profile_dir:
-        jax.profiler.start_trace(profile_dir)
 
     # auto-checkpoint: chunked runs are long; a crash mid-file must
     # always be resumable without the user having had the foresight to
-    # pass --checkpoint (VERDICT r1 item 10)
+    # pass --checkpoint (VERDICT r1 item 10). Initialised BEFORE any
+    # input is read (the mate-aware setting, not its resolution, joins
+    # the fingerprint) so a stale manifest can never survive an early
+    # crash.
     auto_ckpt = checkpoint_path is None
     if auto_ckpt:
         checkpoint_path = out_path + ".ckpt"
     ckpt = None
     if checkpoint_path:
         fp = _fingerprint(
-            in_path, grouping, consensus, capacity, chunk_reads, input_range
+            in_path, grouping, consensus, capacity, chunk_reads, input_range,
+            mate_aware=mate_aware,
         )
         ckpt = Checkpoint.load_or_create(checkpoint_path, fp)
         if not resume:
@@ -707,6 +736,25 @@ def stream_call_consensus(
             # whose content no longer matches its params
             ckpt.done = {}
             ckpt.save()
+
+    # ---- mate-aware resolution on the first chunk (mates share a
+    # canonical fragment pos_key, so any chunk holding paired templates
+    # holds both their mates; the resolved mode is stable for the run) ----
+    rng_start, rng_lo, rng_hi = input_range or (None, None, None)
+    chunk_iter = iter_batch_chunks(
+        in_path, chunk_reads, duplex,
+        start=rng_start, key_lo=rng_lo, key_hi=rng_hi,
+        warn_mixed=False,  # warning responsibility moves to the chunk loop
+    )
+    first = next(chunk_iter, None)
+    grouping = resolve_mate_aware(
+        grouping, first[2] if first is not None else {}, mate_aware
+    )
+    rep.mate_aware = grouping.mate_aware
+    chunk_iter = itertools.chain([] if first is None else [first], chunk_iter)
+
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
 
     n_dev = n_devices or len(jax.devices())
     mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
@@ -785,13 +833,20 @@ def stream_call_consensus(
         nonlocal rep
         k, entries, batch = inflight.popleft()
         parts = []
+        pair_base = 0
         for out, cbuckets, cspec in entries:
             out = materialize(out, cbuckets, cspec, k)
             rep.n_families += int(out["n_families"].sum())
             rep.n_molecules += int(out["n_molecules"].sum())
-            parts.append(scatter_bucket_outputs(out, cbuckets, batch, duplex))
+            parts.append(
+                scatter_bucket_outputs(
+                    out, cbuckets, batch, duplex, pair_base=pair_base
+                )
+            )
+            pair_base += len(cbuckets)
         shard = _finish_chunk(
-            k, parts, duplex, shard_dir, serialize_bam, header_out, name_tag
+            k, parts, duplex, shard_dir, serialize_bam, header_out, name_tag,
+            paired_out=grouping.mate_aware,
         )
         shards[k] = shard
         if ckpt:
@@ -801,13 +856,7 @@ def stream_call_consensus(
 
     n_skipped = 0
     try:
-        rng_start, rng_lo, rng_hi = input_range or (None, None, None)
-        for k, (header, batch, info) in enumerate(
-            iter_batch_chunks(
-                in_path, chunk_reads, duplex,
-                start=rng_start, key_lo=rng_lo, key_hi=rng_hi,
-            )
-        ):
+        for k, (header, batch, info) in enumerate(chunk_iter):
             header_out = header_out or header
             rep.n_chunks += 1
             if ckpt and str(k) in ckpt.done:
@@ -827,6 +876,15 @@ def stream_call_consensus(
                 + info.get("n_dropped_cigar", 0)
             )
             rep.n_mixed_mate_families += info.get("n_mixed_mate_families", 0)
+            if info.get("n_mixed_mate_families") and not grouping.mate_aware:
+                # the iterator was created with warn_mixed=False (auto
+                # resolution owns the decision); a resolved-off run
+                # keeps the loud non-mate-aware contract
+                from duplexumiconsensusreads_tpu.io.convert import (
+                    MIXED_MATE_WARNING,
+                )
+
+                _warnings.warn(MIXED_MATE_WARNING)
             buckets = build_buckets(batch, capacity=capacity, grouping=grouping)
             rep.n_buckets += len(buckets)
             if not buckets:
@@ -868,7 +926,11 @@ def stream_call_consensus(
                 data = s.read()
             if data:
                 f.write(bgzf.compress_fast(data, eof=False))
-            rep.n_consensus += _count_records(data)
+            n_rec, n_pairs = _count_records(data)
+            # counted from the shard BYTES (not per-chunk returns) so
+            # checkpoint-resumed chunks contribute to both totals
+            rep.n_consensus += n_rec
+            rep.n_consensus_pairs += n_pairs
         f.write(bgzf.BGZF_EOF)
     if auto_ckpt:
         # implicit checkpoint: after a successful finalise the shards
@@ -923,22 +985,39 @@ def _write_shard(shard_dir: str, k: int, payload: bytes) -> str:
     return path
 
 
-def _count_records(data: bytes) -> int:
-    n = 0
+def _count_records(data: bytes) -> tuple[int, int]:
+    """(record count, complete consensus R1+R2 pairs) of a raw record
+    stream — pairs are identified by PAIRED|PROPER_PAIR|READ1 exactly
+    as runtime.executor.count_consensus_pairs does on parsed records."""
+    from duplexumiconsensusreads_tpu.io.bam import (
+        FLAG_PAIRED,
+        FLAG_PROPER_PAIR,
+        FLAG_READ1,
+    )
+
+    want = FLAG_PAIRED | FLAG_PROPER_PAIR | FLAG_READ1
+    n = n_pairs = 0
     off = 0
     while off < len(data):
         (bsz,) = struct.unpack_from("<i", data, off)
+        # flag = high 16 bits of the flag_nc word at body offset 12
+        (flag,) = struct.unpack_from("<H", data, off + 4 + 14)
+        if (flag & want) == want:
+            n_pairs += 1
         off += 4 + bsz
         n += 1
-    return n
+    return n, n_pairs
 
 
 def _finish_chunk(
-    k, parts, duplex, shard_dir, serialize_bam, header, name_tag=""
+    k, parts, duplex, shard_dir, serialize_bam, header, name_tag="",
+    paired_out=False,
 ) -> str:
     """Merge one chunk's per-class scattered outputs and write its shard."""
-    cb, cq, cd, fp, fu = (np.concatenate(x) for x in zip(*parts))
-    cb, cq, cd, fp, fu = sort_consensus_outputs(cb, cq, cd, fp, fu)
+    cb, cq, cd, fp, fu, mate, pair = (np.concatenate(x) for x in zip(*parts))
+    cb, cq, cd, fp, fu, mate, pair = sort_consensus_outputs(
+        cb, cq, cd, fp, fu, mate, pair
+    )
     recs = consensus_to_records(
         cb,
         cq,
@@ -948,6 +1027,9 @@ def _finish_chunk(
         fu,
         duplex=duplex,
         name_prefix=f"cons{name_tag}{k}",
+        cons_mate=mate,
+        cons_pair=pair,
+        paired_out=paired_out,
     )
     # record stream only (header stripped) so shards concatenate
     full = serialize_bam(header, recs)

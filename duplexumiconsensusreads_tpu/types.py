@@ -43,6 +43,15 @@ class ReadBatch:
                           start[, mate start]); identical for all reads
                           of one source molecule
     strand_ab: bool (N,)  True = top (AB) strand read, False = bottom (BA)
+    frag_end:  bool (N,)  fragment-end bit: True iff the read observes
+                          the template's SECOND fragment end. For a
+                          paired record this is READ2==top-strand (so
+                          top-R1 and bottom-R2 share end 1 — the
+                          fgbio-style cross-mate duplex partners);
+                          single-end records are always end 1. Used by
+                          mate-aware grouping (GroupingParams.mate_aware)
+                          to keep opposite fragment ends in separate
+                          cycle-space families.
     valid:     bool (N,)  False marks padding slots in the batch
     """
 
@@ -51,6 +60,7 @@ class ReadBatch:
     umi: Any
     pos_key: Any
     strand_ab: Any
+    frag_end: Any
     valid: Any
 
     @property
@@ -75,6 +85,7 @@ class ReadBatch:
             umi=np.zeros((n, u), np.uint8),
             pos_key=np.zeros((n,), np.int64),
             strand_ab=np.zeros((n,), bool),
+            frag_end=np.zeros((n,), bool),
             valid=np.zeros((n,), bool),
         )
 
@@ -85,6 +96,7 @@ class ReadBatch:
             umi=self.umi[idx],
             pos_key=self.pos_key[idx],
             strand_ab=self.strand_ab[idx],
+            frag_end=self.frag_end[idx],
             valid=self.valid[idx],
         )
 
@@ -95,16 +107,26 @@ class FamilyAssignment:
     """Output of UmiGrouper: per-read family/molecule labels.
 
     family_id:   i32 (N,)  dense id of the (molecule, strand) single-strand
-                           family; NO_FAMILY for invalid/unassigned reads
-    molecule_id: i32 (N,)  dense id of the source molecule (duplex: the
-                           AB and BA families of one molecule share it;
-                           single-strand mode: == family_id)
+                           family; NO_FAMILY for invalid/unassigned reads.
+                           Mate-aware grouping splits families further by
+                           fragment end: (molecule, frag_end, strand)
+    molecule_id: i32 (N,)  dense id of the consensus OUTPUT unit: the
+                           source molecule (duplex: the AB and BA
+                           families of one molecule share it), or, under
+                           mate-aware grouping, the (molecule, frag_end)
+                           pair — each emits its own duplex consensus
+    pair_id:     i32 (N,)  dense id of the source molecule proper —
+                           equals molecule_id except under mate-aware
+                           grouping, where the two fragment-end units of
+                           one molecule share it (it links the emitted
+                           R1/R2 consensus mates)
     n_families:  i32 ()    number of distinct family ids in this batch
-    n_molecules: i32 ()    number of distinct molecule ids
+    n_molecules: i32 ()    number of distinct molecule (unit) ids
     """
 
     family_id: Any
     molecule_id: Any
+    pair_id: Any
     n_families: Any
     n_molecules: Any
 
@@ -113,6 +135,7 @@ class FamilyAssignment:
         return FamilyAssignment(
             family_id=np.full((n,), NO_FAMILY, np.int32),
             molecule_id=np.full((n,), NO_FAMILY, np.int32),
+            pair_id=np.full((n,), NO_FAMILY, np.int32),
             n_families=np.int32(0),
             n_molecules=np.int32(0),
         )
@@ -146,12 +169,22 @@ class GroupingParams:
                   (reference behaviour: 2)
     paired:       duplex mode — reads carry a canonicalised UMI pair and
                   strand_ab distinguishes top/bottom families
+    mate_aware:   paired-end mode — the fragment-end bit joins the
+                  family identity, so a template's R1 and R2 mates
+                  (opposite fragment ends, disjoint cycle spaces) form
+                  separate families, and each (molecule, fragment end)
+                  becomes its own duplex output unit — pairing the
+                  top-strand R1 family with the bottom-strand R2 family
+                  (the fgbio CallDuplexConsensusReads pairing). With no
+                  second-end reads present the grouping is identical to
+                  mate_aware=False by construction.
     """
 
     strategy: str = "exact"
     max_hamming: int = 1
     count_ratio: int = 2
     paired: bool = False
+    mate_aware: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

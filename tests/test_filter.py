@@ -220,6 +220,7 @@ def test_multi_chromosome_grouping_and_call(tmp_path):
             np.full(n, 500, np.int64),
         ),
         strand_ab=np.ones(n, bool),
+        frag_end=np.zeros(n, bool),
         valid=np.ones(n, bool),
     )
     gp = GroupingParams(strategy="exact")

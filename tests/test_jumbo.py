@@ -105,6 +105,7 @@ def test_jumbo_family_exact_matches_oracle():
         umi=np.tile(rng.integers(0, 4, size=u, dtype=np.uint8), (n, 1)),
         pos_key=np.full(n, 5000, np.int64),
         strand_ab=np.ones(n, bool),
+        frag_end=np.zeros(n, bool),
         valid=np.ones(n, bool),
     )
     # sprinkle errors so consensus actually has work to do
@@ -149,6 +150,7 @@ def test_jumbo_cluster_adjacency_duplex():
         umi=umi,
         pos_key=np.full(n, 9000, np.int64),
         strand_ab=rng.random(n) < 0.5,
+        frag_end=np.zeros(n, bool),
         valid=np.ones(n, bool),
     )
     gp = GroupingParams(strategy="adjacency", paired=True)
@@ -175,6 +177,7 @@ def test_precluster_fallback_does_not_duplicate_reads(monkeypatch):
         umi=rng.integers(0, 4, size=(n1 + n2, u), dtype=np.uint8),
         pos_key=np.r_[np.full(n1, 1000, np.int64), np.full(n2, 2000, np.int64)],
         strand_ab=np.ones(n1 + n2, bool),
+        frag_end=np.zeros(n1 + n2, bool),
         valid=np.ones(n1 + n2, bool),
     )
     gp = GroupingParams(strategy="adjacency", paired=True)

@@ -30,15 +30,17 @@ from duplexumiconsensusreads_tpu.ops.grouper import dense_pos_ids
 
 
 def _run_group_kernel(batch, params, u_max=None):
-    fam, mol, n_fam, n_mol, n_over = group_kernel(
+    fam, mol, _pair, n_fam, n_mol, n_over = group_kernel(
         dense_pos_ids(batch.pos_key),
         np.asarray(batch.umi),
         np.asarray(batch.strand_ab),
+        np.asarray(batch.frag_end),
         np.asarray(batch.valid),
         strategy=params.strategy,
         max_hamming=params.max_hamming,
         count_ratio=params.count_ratio,
         paired=params.paired,
+        mate_aware=params.mate_aware,
         u_max=u_max,
     )
     return (

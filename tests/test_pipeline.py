@@ -44,6 +44,7 @@ def _check_bucket_against_oracle(bucket, out, gp, cp):
         umi=bucket.umi,
         pos_key=bucket.pos.astype(np.int64),
         strand_ab=bucket.strand_ab,
+        frag_end=bucket.frag_end,
         valid=bucket.valid,
     )
     fams, cons = _oracle_pipeline(sub, gp, cp)
