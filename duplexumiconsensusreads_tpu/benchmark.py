@@ -88,6 +88,8 @@ def run_e2e(n_target: int) -> dict:
         os.remove(out_path)
     except OSError:
         pass
+    from duplexumiconsensusreads_tpu.runtime.executor import default_ssc_method
+
     return {
         "e2e_reads": rep.n_records,
         "e2e_wall_s": round(wall, 2),
@@ -95,6 +97,10 @@ def run_e2e(n_target: int) -> dict:
         "e2e_consensus": rep.n_consensus,
         "e2e_sim_s": round(sim_s, 1),
         "e2e_input_mb": round(os.path.getsize(in_path) / 1e6, 1),
+        # the streaming executor picks its own backend default —
+        # DUT_SSC_METHOD only steers the compute phase, and the JSON
+        # must not attribute e2e numbers to the wrong kernel
+        "e2e_ssc_method": default_ssc_method(),
     }
 
 
@@ -153,9 +159,15 @@ def main() -> None:
     # dispatch classes (capacity/preclustered/unique-count) exactly as
     # the production executor would — oversized position groups and
     # jumbo families get their own geometry + strategy
-    from duplexumiconsensusreads_tpu.runtime.executor import partition_buckets
+    from duplexumiconsensusreads_tpu.runtime.executor import (
+        default_ssc_method,
+        partition_buckets,
+    )
 
-    part = partition_buckets(buckets, gp, cp)
+    ssc_method = os.environ.get("DUT_SSC_METHOD", default_ssc_method())
+    if ssc_method not in ("matmul", "blockseg", "runsum", "segment", "pallas"):
+        raise SystemExit(f"DUT_SSC_METHOD: unknown method {ssc_method!r}")
+    part = partition_buckets(buckets, gp, cp, ssc_method)
     # device-put once (sharded); timed loop measures pure compute, not
     # host->device transfer of the input tensors
     classes = []
@@ -249,12 +261,20 @@ def main() -> None:
     # judged against this number too.
     from duplexumiconsensusreads_tpu.ops import run_bucket
 
+    import dataclasses as _dc
+
+    from duplexumiconsensusreads_tpu.runtime.executor import DEFAULT_SSC_METHOD_CPU
+
     cpu_dev = jax.devices("cpu")[0]
     target = int(os.environ.get("DUT_BENCH_VEC_SAMPLE", 30_000))
     sample, got = [], 0
     for cbuckets, cspec, _ in classes:
+        # the CPU baseline runs its own best-measured reduction (r3:
+        # blockseg, 4.2x faster than matmul on a scalar core) — a
+        # baseline hobbled with the TPU-optimal method would flatter us
+        cpu_spec = _dc.replace(cspec, ssc_method=DEFAULT_SSC_METHOD_CPU)
         for bk in cbuckets:
-            sample.append((bk, cspec))
+            sample.append((bk, cpu_spec))
             got += int(bk.valid.sum())
             if got >= target:
                 break
@@ -277,6 +297,7 @@ def main() -> None:
         "tflops": round(tflops, 2),
         "mfu": round(mfu, 4),
         "vs_vectorized_cpu": round(tpu_rps / vec_cpu_rps, 2),
+        "ssc_method": ssc_method,
     }
 
     # ---- end-to-end phase: wall-clock through the streaming pipeline
@@ -296,8 +317,9 @@ def main() -> None:
         f"tflops={tflops:.2f} mfu={mfu:.4f} (peak={peak/1e12:.0f}T) sim={sim_s:.1f}s "
         f"consensus_error_rate={err_rate:.2e} ({n_err}/{n_base} bases, "
         f"raw base_error={sim_cfg.base_error:g}) "
-        f"ssc_method=matmul (measured fastest in-pipeline on v5e vs "
-        f"segment 1.26x and pallas 1.59x slower)",
+        f"ssc_method={ssc_method} (r2 in-pipeline on v5e: matmul fastest "
+        f"vs segment 1.26x / pallas 1.59x slower; r3 adds blockseg/runsum "
+        f"— see DUT_SSC_METHOD and the BENCH_r03 journal)",
         file=sys.stderr,
     )
 

@@ -184,12 +184,27 @@ def parse_bam(data: bytes) -> tuple[BamHeader, BamRecords]:
 
     n_total = len(data)
     while off < n_total:
+        if off + 4 > n_total:
+            raise ValueError("truncated BAM: partial record length field")
         (block_size,) = struct.unpack_from("<i", data, off)
         off += 4
         rec_end = off + block_size
+        if block_size < 32 or rec_end > n_total:
+            raise ValueError(
+                f"truncated/corrupt BAM record at byte {off - 4} "
+                f"(block_size={block_size}, {n_total - off} bytes left)"
+            )
         (rid, p, l_rn, mq, _bin, n_cig, flag, l_seq, nrid, npos, tl) = struct.unpack_from(
             "<iiBBHHHiiii", data, off
         )
+        # l_rn >= 1: the spec's NUL terminator — l_read_name=0 would
+        # shift every later field onto garbage instead of failing here
+        if l_rn < 1 or l_seq < 0 or 32 + l_rn + 4 * n_cig + (l_seq + 1) // 2 + l_seq > block_size:
+            raise ValueError(
+                f"corrupt BAM record at byte {off - 4}: fixed fields "
+                f"(name {l_rn} + cigar {n_cig} ops + seq {l_seq}) overrun "
+                f"block_size {block_size}"
+            )
         off += 32
         names.append(data[off : off + l_rn - 1].decode("ascii"))
         off += l_rn

@@ -6,8 +6,13 @@ reduced into per-family tensors with ONE one-hot matmul on the MXU
 (``onehot_families.T @ contributions``), fusing the log-likelihood
 accumulation, per-cycle depth counting, and family sizing into a single
 (F+1, R) x (R, 5L+1) GEMM — no scatter, no ragged loops, no
-data-dependent shapes. The alternative ``segment`` method uses
-jax.ops.segment_sum (sorted scatter-add) for comparison/benchmarking.
+data-dependent shapes. Alternatives, all measured in-pipeline on v5e
+(journal: tools/tune_ssc.py): ``segment`` (jax.ops.segment_sum
+scatter-add), ``blockseg`` (family-sorted block-local one-hot GEMMs —
+16x fewer FLOPs, exact, 1.4x slower on TPU but 4.2x FASTER on XLA-CPU,
+hence the CPU-backend default), ``runsum`` (cumsum + boundary gather —
+rejected: prefix cancellation multiplies consensus error 4.8x), and
+``pallas`` (kernels/pallas_ssc.py, r2: 1.59x slower).
 
 Numerics mirror oracle/consensus.py exactly (float32 on device):
   loglik[b] = sum_i [ base_i==b ? log1p(-e_i) : log(e_i/3) ]
@@ -30,6 +35,11 @@ from duplexumiconsensusreads_tpu.constants import (
 )
 
 I32_MAX = jnp.iinfo(jnp.int32).max
+
+# blockseg tile height: rows per local one-hot GEMM. Read at trace
+# time; tools/tune_ssc.py sweeps it (with jax.clear_caches()) on the
+# real chip — see the journal in that file for measured values.
+BLOCKSEG_T = 128
 
 
 def _phred_from_err(err: jnp.ndarray, max_qual: int) -> jnp.ndarray:
@@ -134,6 +144,85 @@ def ssc_kernel(
         fam_size = jax.ops.segment_sum(
             ok.astype(jnp.float32), fid, num_segments=f_max + 1
         )[:f_max].astype(jnp.int32)
+    elif method in ("blockseg", "runsum"):
+        # Family ids are dense ranks (group_kernel contract), so after a
+        # stable sort by id every family is one contiguous run AND any T
+        # consecutive sorted rows span at most T distinct — hence
+        # CONSECUTIVE — id values (every id in [0, n_fam) has >= 1 read).
+        # The u8 inputs are permuted (cheap) so the f32 evidence rows are
+        # built directly in family order.
+        perm = jnp.argsort(fid, stable=True)
+        sfid = jnp.take(fid, perm)
+        sok = jnp.take(ok, perm)
+        scontrib, sreal = _contributions(
+            jnp.take(bases, perm, axis=0),
+            jnp.take(quals, perm, axis=0),
+            sok,
+            max_input_qual,
+            min_input_qual,
+        )
+        c = 5 * l + 1
+        big = jnp.concatenate(
+            [scontrib.reshape(r, 4 * l), sreal, sok.astype(jnp.float32)[:, None]],
+            axis=1,
+        )
+        if method == "runsum":
+            # VERDICT-r2 shape: one cumsum over the sorted evidence +
+            # a boundary gather per family. O(R*C) elementwise, zero
+            # GEMM — but each family sum is a difference of two large
+            # prefixes; the f32 cancellation measurably corrupts quals
+            # (4.8x consensus error on the bench sim — rejected, kept
+            # only as the measured refutation; tools/tune_ssc.py).
+            z = jnp.concatenate(
+                [jnp.zeros((1, c), jnp.float32), jnp.cumsum(big, axis=0)], axis=0
+            )
+            starts = jnp.searchsorted(
+                sfid, jnp.arange(f_max + 1, dtype=jnp.int32), side="left"
+            )
+            out = jnp.take(z, starts[1:], axis=0) - jnp.take(z, starts[:-1], axis=0)
+        else:
+            # blockseg: per-block local one-hot GEMMs. Within block k of
+            # T sorted rows, local = fid - fid[first] is in [0, T], so a
+            # (T, T+1) one-hot reduces the block exactly; block partials
+            # (at most 2 blocks share a family boundary) are scatter-
+            # added into the dense family rows. 2*R*(T+1)*C FLOPs vs the
+            # dense method's 2*R*(F+1)*C — an F/T reduction with no
+            # prefix cancellation.
+            t = min(BLOCKSEG_T, r)
+            nb = -(-r // t)
+            pad = nb * t - r
+            if pad:
+                big = jnp.concatenate([big, jnp.zeros((pad, c), jnp.float32)])
+                sfid = jnp.concatenate(
+                    [sfid, jnp.full((pad,), f_max, jnp.int32)]
+                )
+            sfid2 = sfid.reshape(nb, t)
+            f0 = sfid2[:, 0]
+            # rows whose id falls outside [f0, f0+T) are only the f_max
+            # padding/invalid rows — their evidence is all-zero (the ok
+            # mask zeroes every column), so clipping them anywhere is
+            # harmless
+            local = jnp.clip(sfid2 - f0[:, None], 0, t)
+            onehot = (
+                local[:, :, None] == jnp.arange(t + 1, dtype=jnp.int32)
+            ).astype(jnp.float32)
+            partials = jnp.einsum(
+                "btj,btc->bjc",
+                onehot,
+                big.reshape(nb, t, c),
+                preferred_element_type=jnp.float32,
+            )
+            dest = jnp.minimum(
+                f0[:, None] + jnp.arange(t + 1, dtype=jnp.int32)[None, :], f_max
+            )
+            out = (
+                jnp.zeros((f_max + 1, c), jnp.float32)
+                .at[dest.reshape(-1)]
+                .add(partials.reshape(-1, c), mode="drop")[:f_max]
+            )
+        loglik = out[:, : 4 * l].reshape(f_max, l, 4)
+        depth = out[:, 4 * l : 5 * l].astype(jnp.int32)
+        fam_size = out[:, 5 * l].astype(jnp.int32)
     else:
         raise ValueError(f"unknown ssc method {method!r}")
 

@@ -117,8 +117,13 @@ def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
     if spec.ssc_method == "matmul":
         f = (spec.f_max or r) + 1
         fl += passes * 2.0 * f * r * (5 * l + 1)  # dense one-hot GEMM
+    elif spec.ssc_method == "blockseg":
+        from duplexumiconsensusreads_tpu.kernels.consensus import BLOCKSEG_T
+
+        t = min(BLOCKSEG_T, r)
+        fl += passes * 2.0 * r * (t + 1) * (5 * l + 1)  # block-local GEMMs
     else:
-        # pallas/segment perform ~the useful reduction FLOPs only
+        # pallas/segment/runsum perform ~the useful reduction FLOPs only
         fl += passes * 2.0 * r * (5 * l + 1)
     return fl
 

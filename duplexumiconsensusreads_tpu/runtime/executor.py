@@ -45,6 +45,13 @@ class RunReport:
     n_retries: int = 0  # streaming: chunks re-dispatched after a failure
     n_mixed_mate_families: int = 0  # see io.convert.warn_mixed_mates
     n_consensus_pairs: int = 0  # mate-aware: consensus R1+R2 pairs emitted
+    # result-changing bucketing fallbacks (bucketing.FALLBACK_COUNTERS):
+    # nonzero means that many families/reads deviated from oracle
+    # semantics (missed adjacency merges / duplicate per-split records)
+    n_precluster_fallback_groups: int = 0
+    n_precluster_fallback_reads: int = 0
+    n_jumbo_hardcut_families: int = 0
+    n_jumbo_hardcut_splits: int = 0
     mate_aware: bool = False  # resolved mate-aware mode of this run
     backend: str = ""
     seconds: dict = dataclasses.field(default_factory=dict)
@@ -222,11 +229,33 @@ def fetch_outputs(out: dict) -> dict:
     return {k: np.asarray(v) for k, v in start_fetch(out).items()}
 
 
+# In-pipeline measurements on v5e (BENCH_r02/r03 stderr journals, full
+# bench geometry, 527k reads): matmul 2.39M reads/s > blockseg 1.70M >
+# runsum 1.43M (runsum also loses accuracy to prefix cancellation —
+# rejected outright) > segment/pallas (r2: 1.26x/1.59x slower). On
+# XLA-CPU the ranking INVERTS: blockseg 74.6k reads/s vs matmul 17.8k
+# (4.2x) — dense one-hot padding FLOPs are nearly free on the MXU but
+# real work on a scalar core. Hence per-backend defaults; see
+# tools/tune_ssc.py for the journal.
+DEFAULT_SSC_METHOD = "matmul"
+DEFAULT_SSC_METHOD_CPU = "blockseg"
+
+
+def default_ssc_method() -> str:
+    import jax
+
+    return (
+        DEFAULT_SSC_METHOD_CPU
+        if jax.default_backend() == "cpu"
+        else DEFAULT_SSC_METHOD
+    )
+
+
 def partition_buckets(
     buckets,
     grouping: GroupingParams,
     consensus: ConsensusParams,
-    ssc_method: str = "matmul",
+    ssc_method: str | None = None,
 ):
     """Split buckets into dispatch classes of identical geometry+strategy.
 
@@ -244,6 +273,8 @@ def partition_buckets(
 
     from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
 
+    if ssc_method is None:
+        ssc_method = default_ssc_method()
     classes: dict[tuple, list] = {}
     for bk in buckets:
         ucls = 1 << max(bk.n_unique_umi - 1, 0).bit_length()
@@ -295,7 +326,10 @@ def call_batch_tpu(
     duplex = consensus.mode == "duplex"
 
     t0 = time.time()
-    buckets = build_buckets(batch, capacity=capacity, grouping=grouping)
+    fb: dict = {}
+    buckets = build_buckets(batch, capacity=capacity, grouping=grouping, counters=fb)
+    for k, v in fb.items():
+        setattr(rep, k, getattr(rep, k) + v)
     rep.n_buckets = len(buckets)
     rep.seconds["bucketing"] = round(time.time() - t0, 4)
     if not buckets:

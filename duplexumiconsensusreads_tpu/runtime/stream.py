@@ -885,7 +885,12 @@ def stream_call_consensus(
                 )
 
                 _warnings.warn(MIXED_MATE_WARNING)
-            buckets = build_buckets(batch, capacity=capacity, grouping=grouping)
+            fb: dict = {}
+            buckets = build_buckets(
+                batch, capacity=capacity, grouping=grouping, counters=fb
+            )
+            for fk, fv in fb.items():
+                setattr(rep, fk, getattr(rep, fk) + fv)
             rep.n_buckets += len(buckets)
             if not buckets:
                 shards[k] = _write_shard(shard_dir, k, b"")

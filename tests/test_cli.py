@@ -139,9 +139,15 @@ def test_validate_unmatched_classification(tmp_path, capsys):
     assert sum(cls.values()) == res["n_unmatched"]
     # simulator only moves reads, never invents coordinates
     assert cls["position_miss"] == 0
-    # 4% per-base UMI error: 'other' (>=2-error UMIs, ~2% of reads as
-    # unmergeable singletons) must stay a small fraction of calls
-    assert cls["other"] <= max(3, 0.08 * res["n_consensus"])
+    # Per-class CEILINGS at these fixed sim parameters (VERDICT r2 item
+    # 8: a clustering regression that doubles a class must fail CI).
+    # Measured on this exact sim (seed 11, 150 molecules, 4% UMI error,
+    # deterministic): 156 consensus, 12 seed-mismatch, 7 other, 0
+    # over-split. Bounds are 1.5x the measured values.
+    assert res["n_consensus"] <= 170  # over-splitting inflates calls
+    assert cls["seed_mismatch"] <= 18
+    assert cls["other"] <= 10
+    assert cls["over_split"] <= 5
     if res["n_unmatched"]:
         assert cls["over_split"] + cls["seed_mismatch"] > 0
 

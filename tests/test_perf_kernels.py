@@ -103,6 +103,90 @@ def test_spec_for_buckets_bounds():
         assert not np.asarray(out_full["cons_valid"])[na:].any()
 
 
+class TestSortedSegmentMethods:
+    """blockseg / runsum: the family-sorted reduction paths. blockseg is
+    sum-order-exact per family (block partials accumulate in block
+    order); runsum differs only by prefix-cancellation, bounded to ±1
+    qual at f32 rounding boundaries."""
+
+    @pytest.mark.parametrize("method", ["blockseg", "runsum"])
+    @pytest.mark.parametrize("strategy", ["exact", "adjacency"])
+    def test_pipeline_parity(self, method, strategy):
+        # full fused pipeline: presorted buckets, paired + mate-aware
+        # bits make family ids NON-monotone in read order — the internal
+        # re-sort must recover contiguity; adjacency additionally
+        # reorders molecules by cluster seed
+        cfg = SimConfig(
+            n_molecules=150, duplex=True, umi_error=0.03, paired_reads=True,
+            seed=11,
+        )
+        batch, _ = simulate_batch(cfg)
+        gp = GroupingParams(strategy=strategy, paired=True, mate_aware=True)
+        cp = ConsensusParams(mode="duplex", error_model="cycle")
+        buckets = build_buckets(batch, capacity=512, grouping=gp)
+        ref_spec = spec_for_buckets(buckets, gp, cp, ssc_method="matmul")
+        new_spec = spec_for_buckets(buckets, gp, cp, ssc_method=method)
+        for bk in buckets:
+            a = run_bucket(bk, ref_spec)
+            b = run_bucket(bk, new_spec)
+            np.testing.assert_array_equal(
+                np.asarray(a["family_id"]), np.asarray(b["family_id"])
+            )
+            ba_, bb_ = np.asarray(a["cons_base"]), np.asarray(b["cons_base"])
+            if method == "blockseg":
+                np.testing.assert_array_equal(ba_, bb_)
+            else:
+                # a +-1 qual shift can flip the duplex agree/disagree
+                # tie-break (base <-> N); bound the rate
+                assert (ba_ != bb_).mean() < 1e-3
+            np.testing.assert_array_equal(
+                np.asarray(a["cons_depth"]), np.asarray(b["cons_depth"])
+            )
+            qa = np.asarray(a["cons_qual"]).astype(np.int32)
+            qb = np.asarray(b["cons_qual"]).astype(np.int32)
+            if method == "blockseg":
+                np.testing.assert_array_equal(qa, qb)
+            else:
+                # runsum: prefix sums reach ~24*R magnitude, so the
+                # boundary subtraction loses ~0.01-0.03 absolute loglik;
+                # quals shift at floor boundaries and the duplex q_ab+q_ba
+                # sum compounds the two strands (measured here: <=0.7% of
+                # elements off by >1, max 6). blockseg accumulates per
+                # family only: exact.
+                # (a +-1 deviation in the pass-1 consensus can move a
+                # per-cycle cap by 1, shifting a whole qual column —
+                # measured 5.9% off-by->=1 on one adjacency bucket)
+                diff = np.abs(qa - qb)
+                assert (diff > 0).mean() < 0.10 and diff.max() <= 15
+
+    @pytest.mark.parametrize("method", ["blockseg", "runsum"])
+    def test_unsorted_fid_and_ragged_r(self, method):
+        # operator-path contract: fids arrive in arbitrary read order;
+        # R not a multiple of the block size exercises the pad tail
+        from duplexumiconsensusreads_tpu.kernels.consensus import ssc_kernel
+        from duplexumiconsensusreads_tpu.oracle import group_reads
+
+        cfg = SimConfig(n_molecules=40, duplex=False, read_len=37, seed=4)
+        batch, _ = simulate_batch(cfg)
+        n = (batch.bases.shape[0] // 128) * 128 + 57  # force ragged tail
+        sub = batch.take(np.arange(min(n, batch.bases.shape[0])))
+        fams = group_reads(sub, GroupingParams(strategy="exact"))
+        args = (
+            np.asarray(sub.bases),
+            np.asarray(sub.quals),
+            np.asarray(fams.family_id),
+            np.asarray(sub.valid),
+        )
+        a = ssc_kernel(*args, f_max=128, method="matmul")
+        b = ssc_kernel(*args, f_max=128, method=method)
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(
+                np.asarray(x).astype(np.int64),
+                np.asarray(y).astype(np.int64),
+                atol=0 if method == "blockseg" else 3,
+            )
+
+
 class TestPallasSegmentGemm:
     def _ref(self, big, fid, f):
         ref = np.zeros((f, big.shape[1]), np.float32)

@@ -1,0 +1,108 @@
+"""Micro-tuner for the ssc reduction method (VERDICT r2 item 4).
+
+Times the FUSED pipeline (the only honest scope: isolated-kernel
+rankings invert in-pipeline, as the r2 pallas journal showed) on one
+representative dispatch-class geometry, sweeping ssc_method and the
+blockseg tile height. Run on the real chip:
+
+    python tools/tune_ssc.py
+
+Journal (v5e-1, axon tunnel, 2026-07-30):
+
+Full bench.py geometry (capacity=2048, duplex+adjacency+cycle error
+model, 527k reads, 283 buckets — the honest in-pipeline scope):
+  matmul    2.386M reads/s  step 0.221s  err 6.9e-5  <-- TPU default
+  blockseg  1.701M reads/s  step 0.310s  err 6.9e-5  (T=128, exact)
+  runsum    1.426M reads/s  step 0.370s  err 3.3e-4  REJECTED: the
+            prefix-cancellation noise is not just a qual wobble — it
+            multiplies the measured consensus error rate 4.8x.
+So the VERDICT-r2 hypothesis ("skip the one-hot padding FLOPs and MFU
+rises") is REFUTED with numbers, like the pallas kernel before it:
+blockseg cuts ssc FLOPs 16x (2R*129*C vs 2R*2049*C) yet loses 1.4x in
+wall — the dense GEMM's padding FLOPs ride idle MXU capacity while
+blockseg's argsort + row-gather + (T+1)-row scatter are real HBM/VPU
+work on the critical path. MFU accounting confirms: matmul shows
+11.55 analytic TFLOP/s (mfu 0.059) vs blockseg 3.14 (mfu 0.016) in
+nearly the same wall time — the "wasted" FLOPs were nearly free.
+
+This tuner's smaller workload (~190k reads, ~95 buckets) is dispatch-
+latency-dominated on a tunneled chip — every method lands within 10%
+(matmul 0.841M, blockseg T=64 0.883M / T=128 0.877M / T=256 0.843M /
+T=512 0.773M, runsum 0.870M, segment 0.858M reads/s) — which is why
+method decisions are made on the full bench, not this sweep.
+
+On XLA-CPU the ranking INVERTS: blockseg 74.6k reads/s vs matmul
+17.8k (4.2x) — the padding FLOPs are real work on a scalar core.
+blockseg is therefore the CPU-backend default
+(runtime/executor.py DEFAULT_SSC_METHOD_CPU).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    import duplexumiconsensusreads_tpu.kernels.consensus as kc
+    from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
+    from duplexumiconsensusreads_tpu.parallel import make_mesh
+    from duplexumiconsensusreads_tpu.parallel.sharded import (
+        presharded_pipeline,
+        shard_stacked,
+    )
+    from duplexumiconsensusreads_tpu.runtime.executor import partition_buckets
+    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+    from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex", error_model="cycle", min_duplex_reads=1)
+    cfg = SimConfig(
+        n_molecules=22_000,
+        read_len=150,
+        n_positions=460,
+        mean_family_size=4,
+        umi_error=0.01,
+        duplex=True,
+        seed=7,
+    )
+    batch, _ = simulate_batch(cfg)
+    n_reads = int(np.asarray(batch.valid).sum())
+    buckets = build_buckets(batch, capacity=2048, grouping=gp)
+    mesh = make_mesh(len(jax.devices()))
+
+    plans = [("matmul", None)] + [
+        ("blockseg", t) for t in (64, 128, 256, 512)
+    ] + [("runsum", None), ("segment", None)]
+    for method, t in plans:
+        jax.clear_caches()
+        if t is not None:
+            kc.BLOCKSEG_T = t
+        part = partition_buckets(buckets, gp, cp, method)
+        classes = [
+            (cspec, shard_stacked(stack_buckets(cb, multiple_of=1), mesh))
+            for cb, cspec in part
+        ]
+        jax.block_until_ready([c[1] for c in classes])
+
+        def run_all():
+            return [presharded_pipeline(args, cspec, mesh) for cspec, args in classes]
+
+        for o in run_all():
+            np.asarray(o["n_families"])  # compile + sync
+        reps = 6
+        t0 = time.time()
+        outs = [run_all() for _ in range(reps)]
+        for rep_outs in outs:
+            for o in rep_outs:
+                np.asarray(o["n_families"])
+        dt = (time.time() - t0) / reps
+        label = method if t is None else f"{method}(T={t})"
+        print(f"{label:16s} step={dt:.3f}s  {n_reads/dt/1e6:.3f}M reads/s")
+
+
+if __name__ == "__main__":
+    main()
