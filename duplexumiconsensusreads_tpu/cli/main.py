@@ -398,7 +398,12 @@ def _cmd_call(args) -> int:
         f"[duplexumi] {rep.n_valid_reads}/{rep.n_records} reads → "
         f"{rep.n_consensus} consensus ({rep.n_molecules} molecules{pairs}, "
         f"{rep.n_buckets} buckets, backend={rep.backend}) "
-        f"in {sum(rep.seconds.values()):.2f}s {rep.seconds}",
+        # "total" is the stream path's true wall; the whole-file path
+        # records disjoint phases whose sum is the wall. Never sum a
+        # dict that contains "total" — phase keys overlap it (and the
+        # threaded "dispatch" accrues concurrent worker time > wall)
+        f"in {rep.seconds.get('total', sum(rep.seconds.values())):.2f}s "
+        f"{rep.seconds}",
         file=sys.stderr,
     )
     return 0

@@ -251,11 +251,23 @@ def default_ssc_method() -> str:
     )
 
 
+def packed_io_ok(consensus: ConsensusParams) -> bool:
+    """Packed base|qual transfer is lossless iff the input-qual cap
+    fits the 6-bit payload (ops.pipeline.PACKED_QUAL_MAX)."""
+    from duplexumiconsensusreads_tpu.ops.pipeline import PACKED_QUAL_MAX
+
+    return (
+        consensus.max_input_qual <= PACKED_QUAL_MAX
+        and consensus.min_input_qual <= PACKED_QUAL_MAX
+    )
+
+
 def partition_buckets(
     buckets,
     grouping: GroupingParams,
     consensus: ConsensusParams,
     ssc_method: str | None = None,
+    packed_io: bool = False,
 ):
     """Split buckets into dispatch classes of identical geometry+strategy.
 
@@ -284,7 +296,12 @@ def partition_buckets(
         cbuckets = classes[key]
         g = _dc.replace(grouping, strategy="exact") if key[1] else grouping
         out.append(
-            (cbuckets, spec_for_buckets(cbuckets, g, consensus, ssc_method))
+            (
+                cbuckets,
+                spec_for_buckets(
+                    cbuckets, g, consensus, ssc_method, packed_io=packed_io
+                ),
+            )
         )
     return out
 
@@ -356,12 +373,18 @@ def call_batch_tpu(
     # sparse-coverage bucket doesn't pay the dense buckets' u_max/f_max
     # geometry and jumbo/preclustered buckets get their own compiles.
     # All classes are dispatched before any is drained (async overlap).
-    part = partition_buckets(buckets, grouping, consensus)
+    part = partition_buckets(
+        buckets, grouping, consensus, packed_io=packed_io_ok(consensus)
+    )
 
     t0 = time.time()
     pending = []
     for cbuckets, cspec in part:
         stacked = stack_buckets(cbuckets, multiple_of=n_data)
+        if cspec.packed_io:
+            from duplexumiconsensusreads_tpu.ops.pipeline import pack_stacked
+
+            pack_stacked(stacked)
         pending.append(
             (cbuckets, start_fetch(sharded_pipeline(stacked, cspec, mesh)))
         )

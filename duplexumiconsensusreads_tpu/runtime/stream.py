@@ -28,6 +28,7 @@ import hashlib
 import json
 import os
 import struct
+import threading
 import time
 from collections import deque
 
@@ -43,9 +44,11 @@ from duplexumiconsensusreads_tpu.io.convert import (
 
 # chunk-boundary key MUST be the grouping key: one shared implementation
 from duplexumiconsensusreads_tpu.io.convert import records_pos_keys as _rec_pos_keys
+from duplexumiconsensusreads_tpu.ops.pipeline import pack_stacked
 from duplexumiconsensusreads_tpu.runtime.executor import (
     RunReport,
     fetch_outputs,
+    packed_io_ok,
     partition_buckets,
     scatter_bucket_outputs,
     sort_consensus_outputs,
@@ -762,18 +765,47 @@ def stream_call_consensus(
     rep.n_devices = n_dev
     header_out: BamHeader | None = None
 
+    from concurrent.futures import ThreadPoolExecutor
+
     shard_dir = out_path + ".shards"
     os.makedirs(shard_dir, exist_ok=True)
     shards: dict[int, str] = {}
     inflight: deque = deque()
     spec_cache: dict = {}
+    # 4 transfer workers pipeline the tunnel's per-put RPC gaps
+    # (measured r3: 1 worker 17.7k reads/s, 2 -> 19.6k, 4 -> ~21k on
+    # the 2M-read e2e); device_put releases the GIL on the wire wait
+    xfer = ThreadPoolExecutor(max_workers=4, thread_name_prefix="dut-xfer")
+    phase_lock = threading.Lock()
+
+    # per-phase wall breakdown (VERDICT r2 item 2): phases overlap with
+    # async device work, so they sum to the HOST loop's critical path,
+    # which on a 1-core host IS the wall clock. "dispatch" is accrued
+    # inside the transfer worker thread: it is the stack+pack+device_put
+    # wall wherever it runs, overlapped with the main loop's ingest.
+    phase = {
+        "ingest": 0.0, "bucketing": 0.0, "dispatch": 0.0,
+        "device_wait_fetch": 0.0, "scatter": 0.0, "shard_write": 0.0,
+        "finalise": 0.0,
+    }
 
     def dispatch(buckets, spec):
+        t0 = time.time()
         stacked = stack_buckets(buckets, multiple_of=n_data)
+        if spec.packed_io:
+            # one byte per cycle instead of two: base|qual packed on the
+            # host, decoded on device — the host->device transfer is the
+            # dominant streaming phase on a tunneled chip (see the
+            # per-phase breakdown in RunReport.seconds)
+            pack_stacked(stacked)
         # start the device->host copies of the consumed keys right at
         # dispatch: by drain time the results are already on the host,
         # so the tunnel's per-fetch latency overlaps with compute
-        return start_fetch(sharded_pipeline(stacked, spec, mesh))
+        out = start_fetch(sharded_pipeline(stacked, spec, mesh))
+        dt = time.time() - t0
+        with phase_lock:  # dict += from concurrent workers would race
+            phase["dispatch"] += dt
+        return out
 
     def materialize(out, cbuckets, cspec, k):
         """Device results -> host arrays, with failure recovery:
@@ -781,8 +813,14 @@ def stream_call_consensus(
         re-dispatch to isolate a poisoned bucket."""
         import sys
 
+        err: Exception | None = None
+        if out is not None and hasattr(out, "result"):
+            try:
+                out = out.result()  # transfer-thread future
+            except Exception as e:
+                out, err = None, e
         if out is None:
-            err: Exception = RuntimeError("device dispatch failed at submit")
+            err = err or RuntimeError("device dispatch failed at submit")
         else:
             try:
                 return fetch_outputs(out)
@@ -835,28 +873,43 @@ def stream_call_consensus(
         parts = []
         pair_base = 0
         for out, cbuckets, cspec in entries:
+            t0 = time.time()
             out = materialize(out, cbuckets, cspec, k)
+            phase["device_wait_fetch"] += time.time() - t0
             rep.n_families += int(out["n_families"].sum())
             rep.n_molecules += int(out["n_molecules"].sum())
+            t0 = time.time()
             parts.append(
                 scatter_bucket_outputs(
                     out, cbuckets, batch, duplex, pair_base=pair_base
                 )
             )
+            phase["scatter"] += time.time() - t0
             pair_base += len(cbuckets)
+        t0 = time.time()
         shard = _finish_chunk(
             k, parts, duplex, shard_dir, serialize_bam, header_out, name_tag,
             paired_out=grouping.mate_aware,
         )
+        phase["shard_write"] += time.time() - t0
         shards[k] = shard
         if ckpt:
             ckpt.mark(k, shard)
         if progress:
             progress(k, rep)
 
+    def timed_chunks(it):
+        while True:
+            t0 = time.time()
+            item = next(it, None)
+            phase["ingest"] += time.time() - t0
+            if item is None:
+                return
+            yield item
+
     n_skipped = 0
     try:
-        for k, (header, batch, info) in enumerate(chunk_iter):
+        for k, (header, batch, info) in enumerate(timed_chunks(iter(chunk_iter))):
             header_out = header_out or header
             rep.n_chunks += 1
             if ckpt and str(k) in ckpt.done:
@@ -886,9 +939,11 @@ def stream_call_consensus(
 
                 _warnings.warn(MIXED_MATE_WARNING)
             fb: dict = {}
+            t0 = time.time()
             buckets = build_buckets(
                 batch, capacity=capacity, grouping=grouping, counters=fb
             )
+            phase["bucketing"] += time.time() - t0
             for fk, fv in fb.items():
                 setattr(rep, fk, getattr(rep, fk) + fv)
             rep.n_buckets += len(buckets)
@@ -898,19 +953,23 @@ def stream_call_consensus(
                     ckpt.mark(k, shards[k])
                 continue
             entries = []
-            for cbuckets, cspec in partition_buckets(buckets, grouping, consensus):
+            for cbuckets, cspec in partition_buckets(
+                buckets, grouping, consensus, packed_io=packed_io_ok(consensus)
+            ):
                 spec_cache[cspec] = True
-                try:
-                    fut = dispatch(cbuckets, cspec)
-                except Exception:
-                    fut = None  # materialize() re-dispatches with backoff
-                entries.append((fut, cbuckets, cspec))
+                # transfer workers: host->device copies ride the tunnel
+                # while the main loop ingests/buckets the next chunk;
+                # submit never raises — failures surface in materialize
+                entries.append((xfer.submit(dispatch, cbuckets, cspec), cbuckets, cspec))
             inflight.append((k, entries, batch))
             while len(inflight) >= max_inflight:
                 drain_one()
         while inflight:
             drain_one()
     finally:
+        # drop queued-but-unstarted transfers on the error path — their
+        # results would never be drained; the in-flight one completes
+        xfer.shutdown(wait=True, cancel_futures=True)
         if profile_dir:
             jax.profiler.stop_trace()
 
@@ -923,6 +982,7 @@ def stream_call_consensus(
         _r = BamStreamReader(in_path)
         header_out = _r.header
         _r.close()
+    t_fin = time.time()
     shell = serialize_bam(header_out, _empty_records())
     with open(out_path, "wb") as f:
         f.write(bgzf.compress_fast(shell, eof=False))
@@ -953,8 +1013,11 @@ def stream_call_consensus(
             os.remove(checkpoint_path)
         except OSError:
             pass
+    phase["finalise"] = time.time() - t_fin
     rep.n_chunks_skipped = n_skipped
     rep.n_pipeline_compiles = len(spec_cache)
+    for pk, pv in phase.items():
+        rep.seconds[pk] = round(pv, 3)
     rep.seconds["total"] = round(time.time() - t_start, 3)
     if report_path:
         with open(report_path, "w") as f:
