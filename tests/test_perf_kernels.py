@@ -187,6 +187,66 @@ class TestSortedSegmentMethods:
             )
 
 
+class TestPackedIO:
+    def test_pack_base_qual_roundtrip(self):
+        from duplexumiconsensusreads_tpu.ops.pipeline import (
+            PACKED_NONE,
+            PACKED_QUAL_MAX,
+            pack_base_qual,
+        )
+
+        rng = np.random.default_rng(9)
+        bases = rng.integers(0, 6, size=(40, 30)).astype(np.uint8)  # incl N=4, PAD=5
+        quals = rng.integers(0, 64, size=(40, 30)).astype(np.uint8)
+        bq = pack_base_qual(bases, quals)
+        real = bases < 4
+        assert (bq[~real] == PACKED_NONE).all()
+        np.testing.assert_array_equal(bq[real] & 3, bases[real])
+        np.testing.assert_array_equal(
+            bq[real] >> 2, np.minimum(quals, PACKED_QUAL_MAX)[real]
+        )
+        # a real base can never alias the NONE marker
+        assert (bq[real] != PACKED_NONE).all()
+
+    def test_packed_pipeline_bit_equal(self):
+        """packed_io=True must reproduce the unpacked pipeline outputs
+        bit-for-bit (quals < 62 — the executors' packed_io_ok gate)."""
+        import dataclasses as dc
+
+        from duplexumiconsensusreads_tpu.ops.pipeline import pack_stacked
+
+        cfg = SimConfig(n_molecules=120, duplex=True, umi_error=0.02, seed=13)
+        batch, _ = simulate_batch(cfg)
+        gp = GroupingParams(strategy="adjacency", paired=True)
+        cp = ConsensusParams(mode="duplex", error_model="cycle")
+        buckets = build_buckets(batch, capacity=512, grouping=gp)
+        spec_raw = spec_for_buckets(buckets, gp, cp)
+        spec_pk = dc.replace(spec_raw, packed_io=True)
+        for bk in buckets:
+            a = run_bucket(bk, spec_raw)
+            stacked = {
+                "bases": bk.bases[None], "quals": bk.quals[None],
+            }
+            pack_stacked(stacked)
+            from duplexumiconsensusreads_tpu.ops import fused_pipeline
+
+            b = fused_pipeline(
+                bk.pos, bk.umi, bk.strand_ab, bk.frag_end, bk.valid,
+                stacked["bases"][0], stacked["quals"][0], spec_pk,
+            )
+            for key in ("family_id", "cons_base", "cons_qual", "cons_depth",
+                        "cons_valid", "cons_mate", "cons_pair"):
+                np.testing.assert_array_equal(
+                    np.asarray(a[key]), np.asarray(b[key]), err_msg=key
+                )
+
+    def test_packed_io_gate(self):
+        from duplexumiconsensusreads_tpu.runtime.executor import packed_io_ok
+
+        assert packed_io_ok(ConsensusParams(max_input_qual=50))
+        assert not packed_io_ok(ConsensusParams(max_input_qual=80))
+
+
 class TestPallasSegmentGemm:
     def _ref(self, big, fid, f):
         ref = np.zeros((f, big.shape[1]), np.float32)

@@ -1,0 +1,102 @@
+"""Decompose the fused-pipeline step time on the real chip: time the
+bench geometry under spec variants that drop one stage each —
+exact grouping (no Hamming/closure), no cycle error model (one ssc
+pass instead of two) — to see which device stage owns the wall.
+
+Run: python tools/profile_phases.py
+
+Journal (v5e-1, axon tunnel, 2026-07-30, 527k reads, capacity 2048):
+  full config5 (adj+cycle)   0.211s   2.25M reads/s
+  no error model (adj)       0.189s   2.52M   -> 2nd ssc pass ~ 10%
+  exact grouping + cycle     0.199s   2.39M   -> Hamming+closure ~ 6%
+  exact, no error model      0.183s   2.59M
+No single device stage dominates; the bulk is the core ssc GEMM +
+contributions elementwise + fixed per-step costs.
+
+Related measurements feeding benchmark.py decisions:
+- Sync discipline: fetching every class's output paid a tunnel RTT
+  each; ONE fetch of the final program suffices (TPUs execute
+  programs in order) — +7% step throughput; bench.py now does this.
+- Class granularity: merging the (255-bucket, u_max 512) class into
+  the (1-bucket, u_max 1024) geometry = ONE launch but 1.5x SLOWER —
+  the u^3 closure padding dwarfs the saved launch; the pow2
+  unique-count classing stays.
+- Capacity sweep (bench.py, same workload): 1024 -> 2.24M reads/s
+  (mfu .027), 2048 -> 2.45M (mfu .060)  <-- default, 4096 -> 2.32M
+  (mfu .141), 8192 -> 1.82M (mfu .336). MFU rises with capacity only
+  because the u^3 closure burns more padded FLOPs per read — analytic
+  MFU is NOT the objective; reads/s is.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
+    from duplexumiconsensusreads_tpu.parallel import make_mesh
+    from duplexumiconsensusreads_tpu.parallel.sharded import (
+        presharded_pipeline,
+        shard_stacked,
+    )
+    from duplexumiconsensusreads_tpu.runtime.executor import partition_buckets
+    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+    from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+    from duplexumiconsensusreads_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(".bench_cache/xla_cache")
+    cfg = SimConfig(
+        n_molecules=60_000,
+        read_len=150,
+        n_positions=1250,
+        mean_family_size=4,
+        umi_error=0.01,
+        duplex=True,
+        seed=7,
+    )
+    batch, _ = simulate_batch(cfg)
+    n_reads = int(np.asarray(batch.valid).sum())
+    mesh = make_mesh(len(jax.devices()))
+
+    variants = [
+        ("full config5 (adj+cycle)", GroupingParams(strategy="adjacency", paired=True),
+         ConsensusParams(mode="duplex", error_model="cycle", min_duplex_reads=1)),
+        ("no error model (adj)", GroupingParams(strategy="adjacency", paired=True),
+         ConsensusParams(mode="duplex", error_model=None, min_duplex_reads=1)),
+        ("exact grouping + cycle", GroupingParams(strategy="exact", paired=True),
+         ConsensusParams(mode="duplex", error_model="cycle", min_duplex_reads=1)),
+        ("exact, no error model", GroupingParams(strategy="exact", paired=True),
+         ConsensusParams(mode="duplex", error_model=None, min_duplex_reads=1)),
+    ]
+    n_dev = len(jax.devices())
+    for name, gp, cp in variants:
+        buckets = build_buckets(batch, capacity=2048, grouping=gp)
+        part = partition_buckets(buckets, gp, cp)
+        classes = [
+            (cspec, shard_stacked(stack_buckets(cb, multiple_of=n_dev), mesh))
+            for cb, cspec in part
+        ]
+        jax.block_until_ready([c[1] for c in classes])
+
+        def run_all():
+            return [presharded_pipeline(a, s, mesh) for s, a in classes]
+
+        for o in run_all():
+            np.asarray(o["n_families"])
+        reps = 8
+        t0 = time.time()
+        outs = [run_all() for _ in range(reps)]
+        for ro in outs:
+            for o in ro:
+                np.asarray(o["n_families"])
+        dt = (time.time() - t0) / reps
+        print(f"{name:28s} step={dt:.3f}s  {n_reads/dt/1e6:.3f}M reads/s")
+
+
+if __name__ == "__main__":
+    main()
