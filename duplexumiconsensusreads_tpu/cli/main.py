@@ -73,6 +73,17 @@ def build_parser() -> argparse.ArgumentParser:
         "and emit consensus R1+R2 pairs (fgbio-style). auto (default) "
         "turns it on exactly when the input mixes R1 and R2 mates",
     )
+    c.add_argument(
+        "--max-reads",
+        type=int,
+        default=None,
+        help="cap each exact sub-family at this many reads, keeping the "
+        "highest-quality ones (fgbio-style --max-reads; 0 = unlimited). "
+        "Applied as an INPUT policy before the fused grouping, so "
+        "adjacency merge decisions see capped counts — use values >= 20 "
+        "(see io.convert.downsample_families). Dropped reads are "
+        "counted in the report (n_downsampled_reads)",
+    )
     c.add_argument("--capacity", type=int, default=None, help="bucket read capacity")
     c.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     c.add_argument(
@@ -212,6 +223,29 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--reads", type=int, default=None)
     b.add_argument("--capacity", type=int, default=None)
 
+    g = sub.add_parser(
+        "group",
+        help="annotate reads with UMI-family tags without calling "
+        "consensus (the standalone UmiGrouper operator: fgbio "
+        "GroupReadsByUmi-style MI molecule ids)",
+    )
+    g.add_argument("input", help="input BAM")
+    g.add_argument("-o", "--output", required=True, help="annotated BAM")
+    g.add_argument("--grouping", choices=["exact", "adjacency"], default="adjacency")
+    g.add_argument("--max-hamming", type=int, default=1)
+    g.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
+    g.add_argument(
+        "--duplex",
+        action="store_true",
+        help="duplex inputs: canonicalise A/B-strand UMI pairs; MI "
+        "values carry the fgbio-style /A or /B strand suffix",
+    )
+    g.add_argument(
+        "--capacity", type=int, default=2048,
+        help="bucket read capacity for the device grouping path",
+    )
+    g.add_argument("--json", action="store_true", help="print summary as JSON")
+
     return p
 
 
@@ -231,7 +265,7 @@ def _load_config_file(path: str) -> dict:
         "backend", "grouping", "mode", "error_model", "max_hamming",
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
         "min_input_qual", "capacity", "devices", "cycle_shards",
-        "chunk_reads", "max_inflight", "config", "mate_aware",
+        "chunk_reads", "max_inflight", "config", "mate_aware", "max_reads",
     }
     unknown = set(conf) - allowed
     if unknown:
@@ -278,6 +312,9 @@ def _cmd_call(args) -> int:
     devices = opt("devices", None)
     max_inflight = opt("max_inflight", 4)
     mate_aware = opt("mate_aware", "auto")
+    max_reads = opt("max_reads", 0)
+    if max_reads < 0:
+        raise SystemExit(f"--max-reads must be >= 0 (got {max_reads})")
 
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour
@@ -353,6 +390,7 @@ def _cmd_call(args) -> int:
             profile_dir=args.profile,
             cycle_shards=cycle_shards,
             mate_aware=mate_aware,
+            max_reads=max_reads,
         )
         if rep is None:
             print("[duplexumi] host has no records in range; idle", file=sys.stderr)
@@ -378,6 +416,7 @@ def _cmd_call(args) -> int:
             profile_dir=args.profile,
             cycle_shards=cycle_shards,
             mate_aware=mate_aware,
+            max_reads=max_reads,
         )
     else:
         rep = call_consensus_file(
@@ -392,6 +431,7 @@ def _cmd_call(args) -> int:
             profile_dir=args.profile,
             cycle_shards=cycle_shards,
             mate_aware=mate_aware,
+            max_reads=max_reads,
         )
     pairs = f", {rep.n_consensus_pairs} R1+R2 pairs" if rep.mate_aware else ""
     print(
@@ -826,6 +866,107 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _cmd_group(args) -> int:
+    """The standalone UmiGrouper operator boundary at the CLI: annotate
+    every groupable read with its molecule id (MI:Z), leaving the
+    records otherwise untouched — consensus-free UMI grouping, the
+    fgbio GroupReadsByUmi workflow. Duplex mode appends the /A or /B
+    strand suffix to MI (top/bottom strand of the source molecule).
+
+    The TPU backend groups per position-tiled bucket exactly like the
+    `call` path (adjacency is position-local, so bucket-local molecule
+    ids renumber to the identical whole-file grouping PARTITION) — the
+    device matrices stay u_max^2 per BUCKET, never per file. MI values
+    are opaque labels: the read partition is backend-identical, but the
+    numbering may differ between backends when oversized position
+    groups reorder bucket emission. Host memory holds the whole record
+    set (annotation needs every record); for inputs beyond that, run
+    `call --chunk-reads`.
+    """
+    import numpy as np
+
+    from duplexumiconsensusreads_tpu.bucketing import build_buckets
+    from duplexumiconsensusreads_tpu.io.bam import (
+        make_aux_z,
+        read_bam,
+        strip_aux_tag,
+        write_bam,
+    )
+    from duplexumiconsensusreads_tpu.io.convert import records_to_readbatch
+    from duplexumiconsensusreads_tpu.oracle import group_reads
+    from duplexumiconsensusreads_tpu.types import GroupingParams
+    from duplexumiconsensusreads_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    header, recs = read_bam(args.input)
+    batch, info = records_to_readbatch(recs, duplex=args.duplex)
+    gp = GroupingParams(
+        strategy=args.grouping,
+        max_hamming=args.max_hamming,
+        paired=args.duplex,
+    )
+    n = len(recs)
+    mol = np.full(n, -1, np.int64)
+    n_mol_total = n_fam_total = 0
+    if args.backend == "cpu":
+        fams = group_reads(batch, gp)
+        mol[:] = np.asarray(fams.molecule_id)
+        n_mol_total = int(fams.n_molecules)
+        n_fam_total = int(fams.n_families)
+    else:
+        from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel
+
+        def _pow2(x):
+            return 1 << max(x - 1, 0).bit_length()
+
+        for bk in build_buckets(batch, capacity=args.capacity, grouping=gp):
+            strategy = "exact" if bk.preclustered else gp.strategy
+            _, mids, _, n_fam, n_mol, n_over = group_kernel(
+                bk.pos, bk.umi, bk.strand_ab, bk.frag_end, bk.valid,
+                strategy=strategy,
+                max_hamming=gp.max_hamming,
+                paired=gp.paired,
+                u_max=min(_pow2(max(bk.n_unique_umi, 1)), bk.capacity),
+                presorted=True,
+            )
+            mids = np.asarray(mids)
+            assert int(n_over) == 0  # u_max >= bucket unique count
+            sel = (bk.read_index >= 0) & bk.valid & (mids >= 0)
+            mol[bk.read_index[sel]] = mids[sel] + n_mol_total
+            n_mol_total += int(n_mol)
+            n_fam_total += int(n_fam)
+    valid = np.asarray(batch.valid, bool)
+    strand = np.asarray(batch.strand_ab, bool)
+    tagged = valid & (mol >= 0)
+    for i in np.nonzero(tagged)[0]:
+        mi = str(int(mol[i]))
+        if args.duplex:
+            mi += "/A" if strand[i] else "/B"
+        recs.aux_raw[i] = strip_aux_tag(recs.aux_raw[i], "MI") + make_aux_z(
+            "MI", mi
+        )
+    write_bam(args.output, header, recs)
+    summary = {
+        "n_records": len(recs),
+        "n_tagged": int(tagged.sum()),
+        "n_molecules": n_mol_total,
+        "n_families": n_fam_total,
+        "grouping": args.grouping,
+        "backend": args.backend,
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"[duplexumi] {summary['n_tagged']}/{summary['n_records']} reads "
+            f"tagged with MI across {summary['n_molecules']} molecules "
+            f"({summary['n_families']} families, {args.grouping}) → "
+            f"{args.output}",
+            file=sys.stderr,
+        )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "call":
@@ -842,6 +983,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_stats(args)
     if args.cmd == "bench":
         return _cmd_bench(args)
+    if args.cmd == "group":
+        return _cmd_group(args)
     raise AssertionError(args.cmd)
 
 

@@ -505,6 +505,38 @@ def write_bam(path: str, header: BamHeader, recs: BamRecords, level: int = 6) ->
         f.write(bgzf.compress_fast(serialize_bam(header, recs), level=level))
 
 
+def strip_aux_tag(aux: bytes, tag: str) -> bytes:
+    """Return ``aux`` with every field named ``tag`` removed (any value
+    type) — re-annotators must replace, not duplicate, their tags."""
+    t = tag.encode("ascii")
+    out = bytearray()
+    pos, n = 0, len(aux)
+    while pos + 3 <= n:
+        start = pos
+        name = aux[pos : pos + 2]
+        typ = aux[pos + 2 : pos + 3]
+        pos += 3
+        if typ in b"AcC":
+            size = 1
+        elif typ in b"sS":
+            size = 2
+        elif typ in b"iIf":
+            size = 4
+        elif typ in b"ZH":
+            size = aux.index(b"\x00", pos) - pos + 1
+        elif typ == b"B":
+            sub = aux[pos : pos + 1]
+            cnt = struct.unpack_from("<I", aux, pos + 1)[0]
+            sub_size = {b"c": 1, b"C": 1, b"s": 2, b"S": 2, b"i": 4, b"I": 4, b"f": 4}[sub]
+            size = 5 + cnt * sub_size
+        else:
+            raise ValueError(f"unknown aux tag type {typ!r}")
+        pos += size
+        if name != t:
+            out += aux[start:pos]
+    return bytes(out)
+
+
 def make_aux_z(tag: str, value: str) -> bytes:
     return tag.encode("ascii") + b"Z" + value.encode("ascii") + b"\x00"
 

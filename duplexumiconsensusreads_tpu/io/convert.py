@@ -315,6 +315,53 @@ def mixed_ends_present(batch) -> bool:
     return bool((has1 & has2).any())
 
 
+def downsample_families(batch, max_reads: int) -> int:
+    """Cap every exact sub-family (pos_key, UMI, strand, fragment end)
+    at ``max_reads`` reads, keeping the highest-summed-quality reads
+    (ties break to the earliest record — deterministic). Extra reads
+    are marked invalid in place; returns how many were dropped.
+
+    This is the input-policy analogue of the reference domain's
+    --max-reads: beyond ~20 reads the consensus posterior is saturated,
+    so pathological families (primer stacks, optical duplicates of
+    duplicates) only cost compute and pad jumbo buckets. Applied on the
+    host BEFORE grouping — the same stage as every other input policy
+    here (SAM-flag exclusion, min-input-qual, the modal-CIGAR filter),
+    so both backends and both executors see the identical capped input.
+    Two documented consequences of the pre-grouping semantics:
+    - under adjacency grouping, the directional count-ratio rule sees
+      CAPPED counts, so an error-UMI sub-family at >= max_reads reads
+      may stay unmerged where uncapped counts would have absorbed it
+      (tools that downsample after a separate grouping step — fgbio's
+      CallMolecularConsensusReads after GroupReadsByUmi — do not have
+      this edge; here grouping is fused). Choose max_reads comfortably
+      above the error-family size (>= 20) to keep the edge negligible.
+    - a directional cluster may still merge several capped
+      sub-families, so a cluster's total depth can exceed max_reads.
+    """
+    v = np.asarray(batch.valid, bool)
+    idx = np.nonzero(v)[0]
+    if max_reads <= 0 or not len(idx):
+        return 0
+    key = np.column_stack(
+        [
+            _family_cols(batch.pos_key, batch.umi, idx),
+            np.asarray(batch.strand_ab, bool)[idx][:, None].astype(np.int64),
+            np.asarray(batch.frag_end, bool)[idx][:, None].astype(np.int64),
+        ]
+    )
+    _, inv = np.unique(key, axis=0, return_inverse=True)
+    bases = np.asarray(batch.bases)[idx]
+    quals = np.asarray(batch.quals)[idx]
+    score = (quals.astype(np.int64) * (bases < N_REAL_BASES)).sum(axis=1)
+    order = np.lexsort((idx, -score, inv))  # family, then best-first
+    sf = inv[order]
+    rank = np.arange(len(sf)) - np.searchsorted(sf, sf, side="left")
+    drop = rank >= max_reads
+    batch.valid[idx[order[drop]]] = False
+    return int(drop.sum())
+
+
 def records_to_readbatch(
     recs: BamRecords, duplex: bool = True, warn_mixed: bool = True
 ) -> tuple[ReadBatch, dict]:

@@ -52,6 +52,7 @@ class RunReport:
     n_precluster_fallback_reads: int = 0
     n_jumbo_hardcut_families: int = 0
     n_jumbo_hardcut_splits: int = 0
+    n_downsampled_reads: int = 0  # --max-reads: io.convert.downsample_families
     mate_aware: bool = False  # resolved mate-aware mode of this run
     backend: str = ""
     seconds: dict = dataclasses.field(default_factory=dict)
@@ -526,6 +527,7 @@ def call_consensus_file(
     profile_dir: str | None = None,
     cycle_shards: int = 1,
     mate_aware: str = "auto",
+    max_reads: int = 0,
 ) -> RunReport:
     """End-to-end: read BAM/npz → consensus → write consensus BAM."""
     from duplexumiconsensusreads_tpu.io import (
@@ -554,6 +556,10 @@ def call_consensus_file(
     )
     rep.n_mixed_mate_families = info.get("n_mixed_mate_families", 0)
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
+    if max_reads > 0:
+        from duplexumiconsensusreads_tpu.io.convert import downsample_families
+
+        rep.n_downsampled_reads = downsample_families(batch, max_reads)
     rep.seconds["read_input"] = round(time.time() - t0, 4)
 
     prof = None

@@ -39,6 +39,7 @@ from duplexumiconsensusreads_tpu.io.bam import BamHeader, BamRecords, parse_bam
 from duplexumiconsensusreads_tpu.io.convert import (
     UNMAPPED_POS_KEY,
     consensus_to_records,
+    downsample_families,
     records_to_readbatch,
 )
 
@@ -616,7 +617,7 @@ class Checkpoint:
 
 def _fingerprint(
     in_path: str, grouping, consensus, capacity, chunk_reads, input_range=None,
-    mate_aware: str = "auto",
+    mate_aware: str = "auto", max_reads: int = 0,
 ) -> str:
     """The mate_aware SETTING (auto/on/off) joins the key rather than
     the resolved boolean: resolution is a deterministic function of the
@@ -634,6 +635,7 @@ def _fingerprint(
             capacity,
             chunk_reads,
             mate_aware,
+            max_reads,
             [list(x) if isinstance(x, tuple) else x for x in (input_range or [])],
             # range-mode chunk boundaries differ between the native and
             # Python iterators (the fallback ignores the seek and
@@ -677,6 +679,8 @@ def stream_call_consensus(
     input_range=None,  # (start_voffset, key_lo, key_hi) — multi-host partition
     name_tag: str = "",  # disambiguates consensus names across hosts
     mate_aware: str = "auto",
+    max_reads: int = 0,  # cap per exact sub-family (0 = off); see
+    # io.convert.downsample_families
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -727,7 +731,7 @@ def stream_call_consensus(
     if checkpoint_path:
         fp = _fingerprint(
             in_path, grouping, consensus, capacity, chunk_reads, input_range,
-            mate_aware=mate_aware,
+            mate_aware=mate_aware, max_reads=max_reads,
         )
         ckpt = Checkpoint.load_or_create(checkpoint_path, fp)
         if not resume:
@@ -938,6 +942,8 @@ def stream_call_consensus(
                 )
 
                 _warnings.warn(MIXED_MATE_WARNING)
+            if max_reads > 0:
+                rep.n_downsampled_reads += downsample_families(batch, max_reads)
             fb: dict = {}
             t0 = time.time()
             buckets = build_buckets(

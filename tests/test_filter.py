@@ -242,3 +242,103 @@ def test_multi_chromosome_grouping_and_call(tmp_path):
     assert h2.ref_names == ["chr1", "chr2"]
     batch2, _ = records_to_readbatch(recs2, duplex=False)
     assert len(np.unique(np.asarray(batch2.pos_key))) == 2
+
+
+class TestMaxReadsDownsampling:
+    def _batch(self):
+        from duplexumiconsensusreads_tpu.types import ReadBatch
+
+        rng = np.random.default_rng(3)
+        n, l, u = 40, 20, 6
+        umi = np.tile(rng.integers(0, 4, size=u, dtype=np.uint8), (n, 1))
+        umi[20:, 0] = (umi[20:, 0] + 1) % 4  # two families of 20
+        return ReadBatch(
+            bases=rng.integers(0, 4, size=(n, l), dtype=np.uint8),
+            quals=rng.integers(10, 41, size=(n, l), dtype=np.uint8),
+            umi=umi,
+            pos_key=np.full(n, 777, np.int64),
+            strand_ab=np.ones(n, bool),
+            frag_end=np.zeros(n, bool),
+            valid=np.ones(n, bool),
+        )
+
+    def test_keeps_top_quality_per_subfamily(self):
+        from duplexumiconsensusreads_tpu.io.convert import downsample_families
+
+        batch = self._batch()
+        score = (batch.quals.astype(int) * (batch.bases < 4)).sum(axis=1)
+        dropped = downsample_families(batch, 5)
+        assert dropped == 30
+        for fam in (np.arange(20), np.arange(20, 40)):
+            kept = fam[batch.valid[fam]]
+            assert len(kept) == 5
+            # kept reads are exactly the 5 best scores of the family
+            assert set(score[kept]) == set(np.sort(score[fam])[-5:])
+
+    def test_strands_and_ends_capped_independently(self):
+        from duplexumiconsensusreads_tpu.io.convert import downsample_families
+
+        batch = self._batch()
+        batch.umi[:] = batch.umi[0]  # one (pos, UMI) pair
+        batch.strand_ab[:20] = False
+        batch.frag_end[10:20] = True
+        dropped = downsample_families(batch, 4)
+        # sub-families: (BA,end1) 10, (BA,end2) 10, (AB,end1) 20
+        assert dropped == (10 - 4) + (10 - 4) + (20 - 4)
+        assert batch.valid.sum() == 12
+
+    def test_zero_means_off_and_determinism(self):
+        from duplexumiconsensusreads_tpu.io.convert import downsample_families
+
+        b1, b2 = self._batch(), self._batch()
+        assert downsample_families(b1, 0) == 0
+        assert b1.valid.all()
+        downsample_families(b1, 3)
+        downsample_families(b2, 3)
+        np.testing.assert_array_equal(b1.valid, b2.valid)
+
+    def test_cli_max_reads_end_to_end(self, tmp_path):
+        import json as _json
+
+        from duplexumiconsensusreads_tpu.cli.main import main
+        from duplexumiconsensusreads_tpu.io.bam import read_bam
+
+        bam = str(tmp_path / "in.bam")
+        truth = str(tmp_path / "t.npz")
+        assert main([
+            "simulate", "-o", bam, "--truth", truth, "--molecules", "60",
+            "--family-size", "8", "--max-family-size", "16", "--sorted",
+            "--seed", "2",
+        ]) == 0
+        out1 = str(tmp_path / "c1.bam")
+        out2 = str(tmp_path / "c2.bam")
+        rep1 = str(tmp_path / "r1.json")
+        rep2 = str(tmp_path / "r2.json")
+        # whole-file and streamed runs with the same cap must agree
+        assert main([
+            "call", bam, "-o", out1, "--config", "config3",
+            "--capacity", "256", "--max-reads", "3", "--report", rep1,
+        ]) == 0
+        assert main([
+            "call", bam, "-o", out2, "--config", "config3",
+            "--capacity", "256", "--max-reads", "3", "--report", rep2,
+            "--chunk-reads", "150",
+        ]) == 0
+        r1 = _json.load(open(rep1))
+        r2 = _json.load(open(rep2))
+        assert r1["n_downsampled_reads"] > 0
+        assert r1["n_downsampled_reads"] == r2["n_downsampled_reads"]
+        _, a = read_bam(out1)
+        _, b = read_bam(out2)
+        assert len(a) == len(b) > 0
+        np.testing.assert_array_equal(a.seq, b.seq)
+        np.testing.assert_array_equal(a.qual, b.qual)
+        # depth tags reflect the cap: no consensus saw more than
+        # 2 strands * 3 reads
+        from duplexumiconsensusreads_tpu.io.convert import depth_stats  # noqa: F401
+        import struct as _struct
+        for aux in a.aux_raw:
+            i = aux.find(b"cDi")
+            assert i >= 0
+            (cd,) = _struct.unpack_from("<i", aux, i + 3)
+            assert cd <= 6
