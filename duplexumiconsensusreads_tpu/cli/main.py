@@ -74,6 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
         "turns it on exactly when the input mixes R1 and R2 mates",
     )
     c.add_argument(
+        "--per-base-tags",
+        action="store_true",
+        default=None,
+        help="emit fgbio-style per-base depth arrays (cd:B,I) on every "
+        "consensus record (costs extra device->host transfer and "
+        "output size)",
+    )
+    c.add_argument(
         "--max-reads",
         type=int,
         default=None,
@@ -266,6 +274,7 @@ def _load_config_file(path: str) -> dict:
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
         "min_input_qual", "capacity", "devices", "cycle_shards",
         "chunk_reads", "max_inflight", "config", "mate_aware", "max_reads",
+        "per_base_tags",
     }
     unknown = set(conf) - allowed
     if unknown:
@@ -315,6 +324,7 @@ def _cmd_call(args) -> int:
     max_reads = opt("max_reads", 0)
     if max_reads < 0:
         raise SystemExit(f"--max-reads must be >= 0 (got {max_reads})")
+    per_base_tags = bool(opt("per_base_tags", False))
 
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour
@@ -391,6 +401,7 @@ def _cmd_call(args) -> int:
             cycle_shards=cycle_shards,
             mate_aware=mate_aware,
             max_reads=max_reads,
+            per_base_tags=per_base_tags,
         )
         if rep is None:
             print("[duplexumi] host has no records in range; idle", file=sys.stderr)
@@ -417,6 +428,7 @@ def _cmd_call(args) -> int:
             cycle_shards=cycle_shards,
             mate_aware=mate_aware,
             max_reads=max_reads,
+            per_base_tags=per_base_tags,
         )
     else:
         rep = call_consensus_file(
@@ -432,6 +444,7 @@ def _cmd_call(args) -> int:
             cycle_shards=cycle_shards,
             mate_aware=mate_aware,
             max_reads=max_reads,
+            per_base_tags=per_base_tags,
         )
     pairs = f", {rep.n_consensus_pairs} R1+R2 pairs" if rep.mate_aware else ""
     print(
@@ -628,50 +641,30 @@ def _cmd_filter(args) -> int:
                 b"i": "<i", b"I": "<I"}
 
     def aux_i(aux: bytes, tag: bytes) -> int | None:
-        """Integer aux value for ``tag``, walking the aux records
-        properly (a raw substring scan could match the tag pattern
-        inside another field's VALUE bytes). Accepts every BAM integer
-        type (c/C/s/S/i/I) — consensus BAMs from other writers store
-        small depths as c/s (ADVICE r2). Returns None when the tag is
-        absent; raises on a malformed aux stream or a non-integer
-        value under the tag, so missing-tag and broken-record inputs
-        are distinguishable instead of both silently filtering."""
-        off, end = 0, len(aux)
-        while off + 3 <= end:
-            t, typ = aux[off : off + 2], aux[off + 2 : off + 3]
-            off += 3
-            fmt = _INT_FMT.get(typ)
-            if fmt is not None:
+        """Integer aux value for ``tag`` via the shared field walker
+        (io.bam.iter_aux_fields — ONE aux-type switch for the whole
+        codebase). Accepts every BAM integer type (c/C/s/S/i/I) —
+        consensus BAMs from other writers store small depths as c/s
+        (ADVICE r2). Returns None when the tag is absent; raises on a
+        malformed aux stream or a non-integer value under the tag, so
+        missing-tag and broken-record inputs are distinguishable
+        instead of both silently filtering."""
+        from duplexumiconsensusreads_tpu.io.bam import iter_aux_fields
+
+        try:
+            for _s, t, typ, vstart, end in iter_aux_fields(aux):
+                if end > len(aux):
+                    raise ValueError("malformed aux stream: value past end")
                 if t == tag:
-                    return struct.unpack_from(fmt, aux, off)[0]
-                vlen = struct.calcsize(fmt)
-            elif typ in (b"A",):
-                vlen = 1
-            elif typ in (b"f",):
-                if t == tag:
-                    raise ValueError(
-                        f"aux tag {tag.decode()} has non-integer type 'f'"
-                    )
-                vlen = 4
-            elif typ in (b"Z", b"H"):
-                z = aux.find(b"\x00", off)
-                if z < 0:
-                    raise ValueError("malformed aux stream: unterminated Z/H")
-                vlen = z - off + 1
-            elif typ == b"B":
-                if off + 5 > end:
-                    raise ValueError("malformed aux stream: truncated B array")
-                sub = aux[off : off + 1]
-                cnt = struct.unpack_from("<I", aux, off + 1)[0]
-                esz = 1 if sub in b"cC" else 2 if sub in b"sS" else 4
-                vlen = 5 + cnt * esz
-            else:
-                raise ValueError(
-                    f"malformed aux stream: unknown type {typ!r}"
-                )
-            off += vlen
-            if off > end:
-                raise ValueError("malformed aux stream: value past end")
+                    fmt = _INT_FMT.get(typ)
+                    if fmt is None:
+                        raise ValueError(
+                            f"aux tag {tag.decode()} has non-integer "
+                            f"type {typ.decode()!r}"
+                        )
+                    return struct.unpack_from(fmt, aux, vstart)[0]
+        except (IndexError, struct.error) as e:
+            raise ValueError(f"malformed aux stream: {e}") from e
         return None
 
     reader = BamStreamReader(args.input)
@@ -897,6 +890,8 @@ def _cmd_group(args) -> int:
     from duplexumiconsensusreads_tpu.types import GroupingParams
     from duplexumiconsensusreads_tpu.utils.compile_cache import enable_compile_cache
 
+    if args.capacity < 1:
+        raise SystemExit(f"--capacity must be >= 1 (got {args.capacity})")
     enable_compile_cache()
     header, recs = read_bam(args.input)
     batch, info = records_to_readbatch(recs, duplex=args.duplex)
@@ -914,10 +909,8 @@ def _cmd_group(args) -> int:
         n_mol_total = int(fams.n_molecules)
         n_fam_total = int(fams.n_families)
     else:
+        from duplexumiconsensusreads_tpu.bucketing.buckets import _pow2
         from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel
-
-        def _pow2(x):
-            return 1 << max(x - 1, 0).bit_length()
 
         for bk in build_buckets(batch, capacity=args.capacity, grouping=gp):
             strategy = "exact" if bk.preclustered else gp.strategy
@@ -938,13 +931,18 @@ def _cmd_group(args) -> int:
     valid = np.asarray(batch.valid, bool)
     strand = np.asarray(batch.strand_ab, bool)
     tagged = valid & (mol >= 0)
+    # strip stale MI from EVERY record (not just re-tagged ones): an
+    # input annotated under a different run's numbering would otherwise
+    # leave old ids on untagged reads, colliding with this run's
+    # molecule-id space
+    for i in range(n):
+        if b"MI" in recs.aux_raw[i]:
+            recs.aux_raw[i] = strip_aux_tag(recs.aux_raw[i], "MI")
     for i in np.nonzero(tagged)[0]:
         mi = str(int(mol[i]))
         if args.duplex:
             mi += "/A" if strand[i] else "/B"
-        recs.aux_raw[i] = strip_aux_tag(recs.aux_raw[i], "MI") + make_aux_z(
-            "MI", mi
-        )
+        recs.aux_raw[i] = recs.aux_raw[i] + make_aux_z("MI", mi)
     write_bam(args.output, header, recs)
     summary = {
         "n_records": len(recs),

@@ -119,13 +119,17 @@ class BamRecords:
 _CIGAR_OPS = "MIDNSHP=X"
 
 
-def _parse_aux_rx(aux: bytes) -> str:
-    """Extract the RX:Z tag from an aux blob (empty string if absent)."""
+def iter_aux_fields(aux: bytes):
+    """Yield (field_start, tag, typ, value_start, field_end) for each
+    aux field — the ONE walker parse/strip/filter code shares, so a
+    type-handling fix can never apply to one consumer and miss another."""
     pos, n = 0, len(aux)
     while pos + 3 <= n:
+        start = pos
         tag = aux[pos : pos + 2]
         typ = aux[pos + 2 : pos + 3]
         pos += 3
+        vstart = pos
         if typ in b"AcC":
             size = 1
         elif typ in b"sS":
@@ -133,11 +137,7 @@ def _parse_aux_rx(aux: bytes) -> str:
         elif typ in b"iIf":
             size = 4
         elif typ in b"ZH":
-            end = aux.index(b"\x00", pos)
-            if tag == b"RX" and typ == b"Z":
-                return aux[pos:end].decode("ascii")
-            pos = end + 1
-            continue
+            size = aux.index(b"\x00", pos) - pos + 1
         elif typ == b"B":
             sub = aux[pos : pos + 1]
             cnt = struct.unpack_from("<I", aux, pos + 1)[0]
@@ -146,6 +146,14 @@ def _parse_aux_rx(aux: bytes) -> str:
         else:
             raise ValueError(f"unknown aux tag type {typ!r}")
         pos += size
+        yield start, tag, typ, vstart, pos
+
+
+def _parse_aux_rx(aux: bytes) -> str:
+    """Extract the RX:Z tag from an aux blob (empty string if absent)."""
+    for _, tag, typ, vstart, end in iter_aux_fields(aux):
+        if tag == b"RX" and typ == b"Z":
+            return aux[vstart : end - 1].decode("ascii")
     return ""
 
 
@@ -510,30 +518,9 @@ def strip_aux_tag(aux: bytes, tag: str) -> bytes:
     type) — re-annotators must replace, not duplicate, their tags."""
     t = tag.encode("ascii")
     out = bytearray()
-    pos, n = 0, len(aux)
-    while pos + 3 <= n:
-        start = pos
-        name = aux[pos : pos + 2]
-        typ = aux[pos + 2 : pos + 3]
-        pos += 3
-        if typ in b"AcC":
-            size = 1
-        elif typ in b"sS":
-            size = 2
-        elif typ in b"iIf":
-            size = 4
-        elif typ in b"ZH":
-            size = aux.index(b"\x00", pos) - pos + 1
-        elif typ == b"B":
-            sub = aux[pos : pos + 1]
-            cnt = struct.unpack_from("<I", aux, pos + 1)[0]
-            sub_size = {b"c": 1, b"C": 1, b"s": 2, b"S": 2, b"i": 4, b"I": 4, b"f": 4}[sub]
-            size = 5 + cnt * sub_size
-        else:
-            raise ValueError(f"unknown aux tag type {typ!r}")
-        pos += size
+    for start, name, _typ, _vstart, end in iter_aux_fields(aux):
         if name != t:
-            out += aux[start:pos]
+            out += aux[start:end]
     return bytes(out)
 
 

@@ -118,6 +118,8 @@ def scatter_bucket_outputs(
     batch: ReadBatch,
     duplex: bool,
     pair_base: int = 0,  # global bucket index of buckets[0] — see below
+    want_depth: bool = False,  # also return per-base depth rows
+    # (requires cons_depth in out — per_base_tags runs only)
 ):
     """Map per-bucket device outputs back to source-batch coordinates.
 
@@ -176,7 +178,7 @@ def scatter_bucket_outputs(
         pair_local + ((pair_base + np.arange(nb, dtype=np.int64))[:, None] << 33),
         -1,
     )
-    return (
+    res = (
         out["cons_base"][:nb][keep],
         out["cons_qual"][:nb][keep],
         np.stack(
@@ -188,6 +190,9 @@ def scatter_bucket_outputs(
         out["cons_mate"][:nb][keep],
         pair_glob[keep],
     )
+    if want_depth:
+        res = res + (out["cons_depth"][:nb][keep],)
+    return res
 
 
 # Device outputs the executors actually consume. cons_depth (the padded
@@ -212,11 +217,12 @@ FETCH_KEYS = (
 )
 
 
-def start_fetch(out: dict) -> dict:
-    """Select FETCH_KEYS and start their device->host copies NOW, so
-    every transfer is in flight before any is awaited (per-fetch tunnel
-    latency would otherwise serialise)."""
-    sel = {k: out[k] for k in FETCH_KEYS}
+def start_fetch(out: dict, extra: tuple = ()) -> dict:
+    """Select FETCH_KEYS (+ extra, e.g. cons_depth for per-base tags)
+    and start their device->host copies NOW, so every transfer is in
+    flight before any is awaited (per-fetch tunnel latency would
+    otherwise serialise)."""
+    sel = {k: out[k] for k in (*FETCH_KEYS, *extra)}
     for v in sel.values():
         try:
             v.copy_to_host_async()
@@ -226,8 +232,9 @@ def start_fetch(out: dict) -> dict:
 
 
 def fetch_outputs(out: dict) -> dict:
-    """start_fetch + blocking conversion to host NumPy arrays."""
-    return {k: np.asarray(v) for k, v in start_fetch(out).items()}
+    """Blocking conversion of an ALREADY-SELECTED start_fetch dict to
+    host NumPy arrays (re-selecting here would drop extra keys)."""
+    return {k: np.asarray(v) for k, v in out.items()}
 
 
 # In-pipeline measurements on v5e (BENCH_r02/r03 stderr journals, full
@@ -307,15 +314,17 @@ def partition_buckets(
     return out
 
 
-def sort_consensus_outputs(cb, cq, cd, fp, fu, mate, pair):
+def sort_consensus_outputs(cb, cq, cd, fp, fu, mate, pair, *extra):
     """Order consensus rows by (pos_key, UMI) so the output BAM stays
     coordinate-sorted (class-wise dispatch visits buckets out of
     genomic order; downstream tools and our own streaming executor
-    expect non-decreasing positions)."""
+    expect non-decreasing positions). Extra row-aligned arrays (e.g.
+    per-base depth) ride along under the same order."""
     order = np.lexsort((*reversed(umi_sort_keys(fu)), fp))
     return (
         cb[order], cq[order], cd[order], fp[order], fu[order],
         mate[order], pair[order],
+        *(x[order] for x in extra),
     )
 
 
@@ -327,12 +336,15 @@ def call_batch_tpu(
     n_devices: int | None = None,
     report: RunReport | None = None,
     cycle_shards: int = 1,
+    per_base_tags: bool = False,
 ):
     """Run one host ReadBatch through the bucketed mesh pipeline.
 
     Returns (cons_base, cons_qual, cons_dstats, cons_valid, fam_pos,
     fam_umi, cons_mate, cons_pair) concatenated over buckets in global
-    dense-output order.
+    dense-output order; per_base_tags=True appends a 9th element, the
+    (n, L) per-base depth matrix (fetched off-device only on request —
+    it is the transfer the FETCH_KEYS discipline exists to avoid).
     """
     import jax
 
@@ -353,7 +365,7 @@ def call_batch_tpu(
     if not buckets:
         u = batch.umi_len
         z = np.zeros
-        return (
+        empty = (
             z((0, batch.read_len), np.uint8),
             z((0, batch.read_len), np.uint8),
             z((0, batch.read_len), np.int32),
@@ -363,6 +375,7 @@ def call_batch_tpu(
             z((0,), np.uint8),
             z((0,), np.int64),
         )
+        return empty + ((z((0, batch.read_len), np.int32),) if per_base_tags else ())
 
     n_dev = n_devices or len(jax.devices())
     mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
@@ -387,7 +400,13 @@ def call_batch_tpu(
 
             pack_stacked(stacked)
         pending.append(
-            (cbuckets, start_fetch(sharded_pipeline(stacked, cspec, mesh)))
+            (
+                cbuckets,
+                start_fetch(
+                    sharded_pipeline(stacked, cspec, mesh),
+                    extra=("cons_depth",) if per_base_tags else (),
+                ),
+            )
         )
     rep.seconds["device_dispatch"] = round(time.time() - t0, 4)
 
@@ -401,18 +420,19 @@ def call_batch_tpu(
         rep.n_molecules += int(out["n_molecules"][:n_real].sum())
         parts.append(
             scatter_bucket_outputs(
-                out, cbuckets, batch, duplex, pair_base=pair_base
+                out, cbuckets, batch, duplex, pair_base=pair_base,
+                want_depth=per_base_tags,
             )
         )
         pair_base += n_real
     rep.seconds["device_pipeline_and_scatter"] = round(time.time() - t0, 4)
     rep.n_size_classes = len(part)
 
-    cb, cq, cd, fp, fu, mate, pair = (np.concatenate(x) for x in zip(*parts))
-    cb, cq, cd, fp, fu, mate, pair = sort_consensus_outputs(
-        cb, cq, cd, fp, fu, mate, pair
+    cols = sort_consensus_outputs(
+        *(np.concatenate(x) for x in zip(*parts))
     )
-    return (cb, cq, cd, np.ones(len(cb), bool), fp, fu, mate, pair)
+    cb = cols[0]
+    return (*cols[:3], np.ones(len(cb), bool), *cols[3:])
 
 
 def call_batch_cpu(
@@ -420,6 +440,7 @@ def call_batch_cpu(
     grouping: GroupingParams,
     consensus: ConsensusParams,
     report: RunReport | None = None,
+    per_base_tags: bool = False,
 ):
     """Oracle (reference-math) path over the whole batch."""
     from duplexumiconsensusreads_tpu.ops import ConsensusCaller, UmiGrouper
@@ -470,7 +491,7 @@ def call_batch_cpu(
     mate = np.where(cv, np.minimum(mate, 1), 0).astype(np.uint8)
     pair = np.where(cv & (pair < big), pair, -1)
 
-    return (
+    res = (
         np.asarray(cons.bases)[cv],
         np.asarray(cons.quals)[cv],
         depth_stats(np.asarray(cons.depth))[cv],
@@ -480,6 +501,9 @@ def call_batch_cpu(
         mate[cv],
         pair[cv],
     )
+    if per_base_tags:
+        res = res + (np.asarray(cons.depth)[cv],)
+    return res
 
 
 def resolve_mate_aware(
@@ -528,6 +552,7 @@ def call_consensus_file(
     cycle_shards: int = 1,
     mate_aware: str = "auto",
     max_reads: int = 0,
+    per_base_tags: bool = False,
 ) -> RunReport:
     """End-to-end: read BAM/npz → consensus → write consensus BAM."""
     from duplexumiconsensusreads_tpu.io import (
@@ -570,13 +595,13 @@ def call_consensus_file(
         prof = profile_dir
     try:
         if backend == "tpu":
-            cb, cq, cd, cv, fp, fu, mate, pair = call_batch_tpu(
+            cb, cq, cd, cv, fp, fu, mate, pair, *rest = call_batch_tpu(
                 batch, grouping, consensus, capacity, n_devices, rep,
-                cycle_shards=cycle_shards,
+                cycle_shards=cycle_shards, per_base_tags=per_base_tags,
             )
         elif backend == "cpu":
-            cb, cq, cd, cv, fp, fu, mate, pair = call_batch_cpu(
-                batch, grouping, consensus, rep
+            cb, cq, cd, cv, fp, fu, mate, pair, *rest = call_batch_cpu(
+                batch, grouping, consensus, rep, per_base_tags=per_base_tags
             )
         else:
             raise ValueError(f"unknown backend {backend!r}")
@@ -590,6 +615,7 @@ def call_consensus_file(
     out_recs = consensus_to_records(
         cb, cq, cd, cv, fp, fu, duplex=duplex,
         cons_mate=mate, cons_pair=pair, paired_out=grouping.mate_aware,
+        cons_pdepth=rest[0] if rest else None,
     )
     write_bam(out_path, header, out_recs)
     rep.n_consensus = len(out_recs)

@@ -617,7 +617,7 @@ class Checkpoint:
 
 def _fingerprint(
     in_path: str, grouping, consensus, capacity, chunk_reads, input_range=None,
-    mate_aware: str = "auto", max_reads: int = 0,
+    mate_aware: str = "auto", max_reads: int = 0, per_base_tags: bool = False,
 ) -> str:
     """The mate_aware SETTING (auto/on/off) joins the key rather than
     the resolved boolean: resolution is a deterministic function of the
@@ -636,6 +636,7 @@ def _fingerprint(
             chunk_reads,
             mate_aware,
             max_reads,
+            per_base_tags,
             [list(x) if isinstance(x, tuple) else x for x in (input_range or [])],
             # range-mode chunk boundaries differ between the native and
             # Python iterators (the fallback ignores the seek and
@@ -681,6 +682,8 @@ def stream_call_consensus(
     mate_aware: str = "auto",
     max_reads: int = 0,  # cap per exact sub-family (0 = off); see
     # io.convert.downsample_families
+    per_base_tags: bool = False,  # emit cd:B,I per-base depth arrays
+    # (fetches the (F, L) depth matrix off-device — costs transfer)
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -732,6 +735,7 @@ def stream_call_consensus(
         fp = _fingerprint(
             in_path, grouping, consensus, capacity, chunk_reads, input_range,
             mate_aware=mate_aware, max_reads=max_reads,
+            per_base_tags=per_base_tags,
         )
         ckpt = Checkpoint.load_or_create(checkpoint_path, fp)
         if not resume:
@@ -805,7 +809,10 @@ def stream_call_consensus(
         # start the device->host copies of the consumed keys right at
         # dispatch: by drain time the results are already on the host,
         # so the tunnel's per-fetch latency overlaps with compute
-        out = start_fetch(sharded_pipeline(stacked, spec, mesh))
+        out = start_fetch(
+            sharded_pipeline(stacked, spec, mesh),
+            extra=("cons_depth",) if per_base_tags else (),
+        )
         dt = time.time() - t0
         with phase_lock:  # dict += from concurrent workers would race
             phase["dispatch"] += dt
@@ -885,7 +892,8 @@ def stream_call_consensus(
             t0 = time.time()
             parts.append(
                 scatter_bucket_outputs(
-                    out, cbuckets, batch, duplex, pair_base=pair_base
+                    out, cbuckets, batch, duplex, pair_base=pair_base,
+                    want_depth=per_base_tags,
                 )
             )
             phase["scatter"] += time.time() - t0
@@ -1087,11 +1095,10 @@ def _finish_chunk(
     k, parts, duplex, shard_dir, serialize_bam, header, name_tag="",
     paired_out=False,
 ) -> str:
-    """Merge one chunk's per-class scattered outputs and write its shard."""
-    cb, cq, cd, fp, fu, mate, pair = (np.concatenate(x) for x in zip(*parts))
-    cb, cq, cd, fp, fu, mate, pair = sort_consensus_outputs(
-        cb, cq, cd, fp, fu, mate, pair
-    )
+    """Merge one chunk's per-class scattered outputs and write its
+    shard. parts rows are 7-tuples (8 with per-base depth)."""
+    cols = sort_consensus_outputs(*(np.concatenate(x) for x in zip(*parts)))
+    cb, cq, cd, fp, fu, mate, pair = cols[:7]
     recs = consensus_to_records(
         cb,
         cq,
@@ -1104,6 +1111,7 @@ def _finish_chunk(
         cons_mate=mate,
         cons_pair=pair,
         paired_out=paired_out,
+        cons_pdepth=cols[7] if len(cols) > 7 else None,
     )
     # record stream only (header stripped) so shards concatenate
     full = serialize_bam(header, recs)

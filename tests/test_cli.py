@@ -320,3 +320,67 @@ def test_group_regroup_replaces_mi(tmp_path):
         assert aux_b.count(b"MIZ") == aux_a.count(b"MIZ")
     # grouping an annotated file reproduces the same partition
     assert a.aux_raw == b.aux_raw
+
+
+def _cd_array(aux):
+    import struct
+
+    i = aux.find(b"cdBI")
+    assert i >= 0, "missing cd per-base tag"
+    (cnt,) = struct.unpack_from("<I", aux, i + 4)
+    return np.frombuffer(aux, "<u4", cnt, i + 8)
+
+
+def test_per_base_tags(tmp_path):
+    """--per-base-tags emits a cd:B,I per-base depth array consistent
+    with the record-level cD/cM stats, identically in whole-file,
+    streamed, and cpu-backend runs."""
+    import struct
+
+    bam = str(tmp_path / "pb.bam")
+    assert main([
+        "simulate", "-o", bam, "--molecules", "50", "--read-len", "40",
+        "--positions", "6", "--umi-error", "0.02", "--seed", "41", "--sorted",
+    ]) == 0
+    outs = {}
+    for tag, extra in (
+        ("whole", []),
+        ("stream", ["--chunk-reads", "120"]),
+        ("cpu", ["--backend", "cpu"]),
+    ):
+        out = str(tmp_path / f"{tag}.bam")
+        assert main([
+            "call", bam, "-o", out, "--config", "config3",
+            "--capacity", "256", "--per-base-tags", *extra,
+        ]) == 0
+        outs[tag] = read_bam(out)[1]
+    w = outs["whole"]
+    assert len(w) > 0
+    for r in (w, outs["stream"], outs["cpu"]):
+        for k in range(len(r)):
+            cd_arr = _cd_array(r.aux_raw[k])
+            assert len(cd_arr) == int(r.lengths[k])
+            i = r.aux_raw[k].find(b"cDi")
+            (cD,) = struct.unpack_from("<i", r.aux_raw[k], i + 3)
+            i = r.aux_raw[k].find(b"cMi")
+            (cM,) = struct.unpack_from("<i", r.aux_raw[k], i + 3)
+            assert cd_arr.max() == cD
+            pos_d = cd_arr[cd_arr > 0]
+            assert (pos_d.min() if len(pos_d) else 0) == cM
+    # the three run modes agree elementwise on the arrays
+    for other in ("stream", "cpu"):
+        o = outs[other]
+        # streamed names differ (chunk prefix); match on (pos, umi, flags)
+        key_w = {
+            (int(w.pos[k]), w.umi[k], int(w.flags[k])): k for k in range(len(w))
+        }
+        assert len(key_w) == len(w)
+        for k in range(len(o)):
+            i = key_w[(int(o.pos[k]), o.umi[k], int(o.flags[k]))]
+            np.testing.assert_array_equal(_cd_array(o.aux_raw[k]), _cd_array(w.aux_raw[i]))
+    # without the flag, no cd array is emitted
+    out0 = str(tmp_path / "plain.bam")
+    assert main(["call", bam, "-o", out0, "--config", "config3",
+                 "--capacity", "256"]) == 0
+    _, r0 = read_bam(out0)
+    assert all(a.find(b"cdBI") < 0 for a in r0.aux_raw)
