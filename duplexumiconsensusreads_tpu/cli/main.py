@@ -195,6 +195,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="mask bases below this quality to N (qual 2)",
     )
     f.add_argument(
+        "--min-base-depth", type=int, default=0,
+        help="mask bases whose per-base depth (cd:B array, written by "
+        "call --per-base-tags) is below this; records lacking the cd "
+        "tag are counted + warned about",
+    )
+    f.add_argument(
         "--max-n-frac", type=float, default=1.0,
         help="drop consensus with more than this fraction of N bases "
         "(evaluated after masking)",
@@ -670,7 +676,7 @@ def _cmd_filter(args) -> int:
     reader = BamStreamReader(args.input)
     header = reader.header
     shell = serialize_bam(header, _empty_records())
-    n_in = n_kept = n_masked = n_no_tag = 0
+    n_in = n_kept = n_masked = n_no_tag = n_no_cd = 0
     try:
         with open(args.output, "wb") as out_f:
             out_f.write(bgzf.compress_fast(shell, eof=False))
@@ -696,6 +702,48 @@ def _cmd_filter(args) -> int:
                     n_masked += int(low.sum())
                     recs.seq[low] = BASE_N
                     recs.qual[low] = NO_CALL_QUAL
+                if args.min_base_depth > 0:
+                    # per-base depth mask from the cd:B array (written
+                    # by call --per-base-tags; any integer subtype —
+                    # other writers store depths as B,S/c/s). Shallow
+                    # cycles go N so the subsequent max-n-frac/
+                    # mean-qual thresholds see the post-mask record.
+                    from duplexumiconsensusreads_tpu.io.bam import (
+                        iter_aux_fields,
+                    )
+
+                    _B_DT = {b"c": "<i1", b"C": "<u1", b"s": "<i2",
+                             b"S": "<u2", b"i": "<i4", b"I": "<u4"}
+                    for i, a in enumerate(recs.aux_raw):
+                        arr = None
+                        try:
+                            for _s, t, typ, vs, _e in iter_aux_fields(a):
+                                sub = a[vs : vs + 1]
+                                if t == b"cd" and typ == b"B" and sub in _B_DT:
+                                    (cnt,) = struct.unpack_from("<I", a, vs + 1)
+                                    arr = np.frombuffer(
+                                        a, _B_DT[sub], cnt, vs + 5
+                                    )
+                                    break
+                        except (struct.error, KeyError, IndexError) as e:
+                            # keep the loud-cleanup contract: the outer
+                            # handler only catches ValueError
+                            raise ValueError(
+                                f"malformed aux stream: {e}"
+                            ) from e
+                        li = int(recs.lengths[i])
+                        if arr is None or len(arr) < li:
+                            # missing tag, or a cd array shorter than
+                            # the read (foreign trimming) — skip the
+                            # record's mask rather than kill the run
+                            n_no_cd += 1
+                            continue
+                        shallow = np.zeros(recs.seq.shape[1], bool)
+                        shallow[:li] = arr[:li] < args.min_base_depth
+                        shallow &= recs.seq[i] != BASE_N  # count NEW masks
+                        n_masked += int(shallow.sum())
+                        recs.seq[i][shallow] = BASE_N
+                        recs.qual[i][shallow] = NO_CALL_QUAL
                 keep = np.ones(n, bool)
                 if args.min_depth > 0 or args.min_min_depth > 0:
                     # a tag is only REQUIRED when its threshold is
@@ -748,14 +796,26 @@ def _cmd_filter(args) -> int:
         reader.close()
     if n_no_tag:
         print(
-            f"[duplexumi] filter: WARNING: {n_no_tag} records lack the "
-            "cD/cM depth tags and were dropped by the depth filter "
+            f"[duplexumi] filter: WARNING: {n_no_tag} records lack a "
+            "required depth tag and were dropped by the depth filter "
             "(input not produced by `duplexumi call`?)",
+            file=sys.stderr,
+        )
+    if n_no_cd:
+        print(
+            f"[duplexumi] filter: WARNING: {n_no_cd} records lack a "
+            "usable per-base cd array (absent or shorter than the "
+            "read) and were left unmasked by --min-base-depth (run "
+            "`call --per-base-tags` to emit cd)",
             file=sys.stderr,
         )
     print(
         f"[duplexumi] filter: kept {n_kept}/{n_in} consensus reads"
-        + (f", masked {n_masked} bases" if args.mask_qual > 0 else ""),
+        + (
+            f", masked {n_masked} bases"
+            if (args.mask_qual > 0 or args.min_base_depth > 0)
+            else ""
+        ),
         file=sys.stderr,
     )
     return 0

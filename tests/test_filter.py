@@ -128,7 +128,7 @@ def test_filter_foreign_int_types_and_missing_tags(tmp_path, capsys):
     out = str(tmp_path / "ff.bam")
     assert main(["filter", foreign, "-o", out, "--min-depth", "1"]) == 0
     err = capsys.readouterr().err
-    assert "1 records lack the cD/cM depth tags" in err
+    assert "1 records lack a required depth tag" in err
     _, after = read_bam(out)
     # every tagged record had cD >= 1 (they produced consensus), so only
     # the tagless record is dropped
@@ -342,3 +342,63 @@ class TestMaxReadsDownsampling:
             assert i >= 0
             (cd,) = _struct.unpack_from("<i", aux, i + 3)
             assert cd <= 6
+
+
+def test_filter_min_base_depth_masks_shallow_cycles(tmp_path, capsys):
+    """--min-base-depth consumes the cd:B per-base arrays: cycles below
+    the threshold go N/qual-2; records lacking cd are warned about and
+    left unmasked."""
+    import struct
+
+    from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+    from duplexumiconsensusreads_tpu.io.bam import read_bam
+
+    bam = str(tmp_path / "in.bam")
+    assert cli_main([
+        "simulate", "-o", bam, "--molecules", "40", "--read-len", "30",
+        "--positions", "4", "--seed", "8", "--sorted",
+    ]) == 0
+    cons = str(tmp_path / "c.bam")
+    assert cli_main([
+        "call", bam, "-o", cons, "--config", "config3", "--capacity", "256",
+        "--per-base-tags",
+    ]) == 0
+    _, before = read_bam(cons)
+    # choose a threshold between min and max observed per-base depth so
+    # the mask demonstrably fires without wiping every base
+    def cd_arr(a):
+        i = a.find(b"cdBI")
+        (cnt,) = struct.unpack_from("<I", a, i + 4)
+        return np.frombuffer(a, "<u4", cnt, i + 8)
+
+    depths = np.concatenate([cd_arr(a) for a in before.aux_raw])
+    thr = int(depths.max())  # masks every cycle shallower than the max
+    out = str(tmp_path / "f.bam")
+    assert cli_main([
+        "filter", cons, "-o", out, "--min-base-depth", str(thr),
+    ]) == 0
+    _, after = read_bam(out)
+    n_shallow = int((depths < thr).sum())
+    assert n_shallow > 0
+    n_masked = sum(
+        int(((after.seq[k][: after.lengths[k]] == 4)
+             & (cd_arr(after.aux_raw[k])[: after.lengths[k]] < thr)).sum())
+        for k in range(len(after))
+    )
+    assert n_masked >= n_shallow * 0.9  # all shallow cycles went N
+    err = capsys.readouterr().err
+    assert f"masked" in err
+
+    # input without cd tags: warned, not dropped
+    plain = str(tmp_path / "plain.bam")
+    assert cli_main([
+        "call", bam, "-o", plain, "--config", "config3", "--capacity", "256",
+    ]) == 0
+    out2 = str(tmp_path / "f2.bam")
+    assert cli_main([
+        "filter", plain, "-o", out2, "--min-base-depth", "2",
+    ]) == 0
+    err = capsys.readouterr().err
+    assert "lack a usable per-base cd array" in err
+    _, kept = read_bam(out2)
+    assert len(kept) == len(before)  # nothing dropped
