@@ -77,9 +77,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--per-base-tags",
         action="store_true",
         default=None,
-        help="emit fgbio-style per-base depth arrays (cd:B,I) on every "
-        "consensus record (costs extra device->host transfer and "
-        "output size)",
+        help="emit fgbio-style per-base depth (cd:B,I) and disagreeing-"
+        "read-count (ce:B,I) arrays on every consensus record (costs "
+        "extra device compute, device->host transfer, and output size)",
     )
     c.add_argument(
         "--max-reads",
@@ -643,8 +643,12 @@ def _cmd_filter(args) -> int:
         _records_from_raw,
     )
 
+    from duplexumiconsensusreads_tpu.io.bam import iter_aux_fields
+
     _INT_FMT = {b"c": "<b", b"C": "<B", b"s": "<h", b"S": "<H",
                 b"i": "<i", b"I": "<I"}
+    _B_DT = {b"c": "<i1", b"C": "<u1", b"s": "<i2",
+             b"S": "<u2", b"i": "<i4", b"I": "<u4"}
 
     def aux_i(aux: bytes, tag: bytes) -> int | None:
         """Integer aux value for ``tag`` via the shared field walker
@@ -708,12 +712,6 @@ def _cmd_filter(args) -> int:
                     # other writers store depths as B,S/c/s). Shallow
                     # cycles go N so the subsequent max-n-frac/
                     # mean-qual thresholds see the post-mask record.
-                    from duplexumiconsensusreads_tpu.io.bam import (
-                        iter_aux_fields,
-                    )
-
-                    _B_DT = {b"c": "<i1", b"C": "<u1", b"s": "<i2",
-                             b"S": "<u2", b"i": "<i4", b"I": "<u4"}
                     for i, a in enumerate(recs.aux_raw):
                         arr = None
                         try:

@@ -565,6 +565,7 @@ def consensus_to_records(
     cons_pair: np.ndarray | None = None,  # (F,) i64 template link
     paired_out: bool = False,
     cons_pdepth: np.ndarray | None = None,  # (F, L) per-base depth -> cd:B,I
+    cons_perr: np.ndarray | None = None,  # (F, L) per-base errors -> ce:B,I
 ) -> BamRecords:
     """Build consensus BAM records from (scattered-back) pipeline output.
 
@@ -647,17 +648,17 @@ def consensus_to_records(
     ds = np.asarray(cons_dstats, np.int64)[idx]
     cd_bytes = ds[:, 0].astype("<i4").tobytes()
     cm_bytes = ds[:, 1].astype("<i4").tobytes()
-    pd_rows = None
-    if cons_pdepth is not None:
-        # fgbio-style per-base depth array (lowercase cd), u32 subtype:
-        # jumbo families can exceed u16 (hard cap is 64x bucket capacity)
+    def _pb_rows(tag: bytes, arr):
+        # fgbio-style per-base B,I array (u32 subtype: jumbo families
+        # can exceed u16 — the hard cap is 64x bucket capacity)
         import struct as _struct
 
-        pd_hdr = b"cdBI" + _struct.pack("<I", l)
-        pd_flat = np.asarray(cons_pdepth)[idx].astype("<u4").tobytes()
-        pd_rows = [
-            pd_hdr + pd_flat[4 * l * k : 4 * l * (k + 1)] for k in range(n)
-        ]
+        hdr = tag + b"BI" + _struct.pack("<I", l)
+        flat = np.asarray(arr)[idx].astype("<u4").tobytes()
+        return [hdr + flat[4 * l * k : 4 * l * (k + 1)] for k in range(n)]
+
+    pd_rows = None if cons_pdepth is None else _pb_rows(b"cd", cons_pdepth)
+    pe_rows = None if cons_perr is None else _pb_rows(b"ce", cons_perr)
     names, aux = [], []
     rid_l, pos_l, idx_l = ref_id.tolist(), pos.tolist(), idx.tolist()
     gid_l = pair_gid.tolist()
@@ -682,6 +683,7 @@ def consensus_to_records(
             + b"cMi"
             + cm_bytes[4 * k : 4 * k + 4]
             + (pd_rows[k] if pd_rows is not None else b"")
+            + (pe_rows[k] if pe_rows is not None else b"")
         )
     return BamRecords(
         names=names,

@@ -55,6 +55,10 @@ class PipelineSpec:
     # Exact whenever max_input_qual <= PACKED_QUAL_MAX (the executors
     # check before enabling).
     packed_io: bool = False
+    # True: also compute per-base disagreement counts (the ce tag) —
+    # widens the ssc reduction by 4L count columns, so opt-in
+    # (--per-base-tags runs only).
+    per_base_counts: bool = False
 
     def __post_init__(self):
         if self.consensus.mode == "duplex" and not self.grouping.paired:
@@ -108,6 +112,7 @@ def spec_for_buckets(
     consensus: ConsensusParams,
     ssc_method: str = "matmul",
     packed_io: bool = False,
+    per_base_counts: bool = False,
 ) -> PipelineSpec:
     """Size the static axes from bucket statistics.
 
@@ -122,7 +127,8 @@ def spec_for_buckets(
     """
     if not buckets:
         return PipelineSpec(
-            grouping, consensus, ssc_method=ssc_method, packed_io=packed_io
+            grouping, consensus, ssc_method=ssc_method, packed_io=packed_io,
+            per_base_counts=per_base_counts,
         )
     r = buckets[0].capacity
     max_u = max(b.n_unique_umi for b in buckets)
@@ -140,6 +146,7 @@ def spec_for_buckets(
         ssc_method=ssc_method,
         presorted=True,  # bucketing's output contract
         packed_io=packed_io,
+        per_base_counts=per_base_counts,
     )
 
 
@@ -225,7 +232,7 @@ def fused_pipeline(
     f_max = spec.f_max or r
     m_max = spec.m_max or r
 
-    def ssc(q):
+    def ssc(q, want_err=False):
         return ssc_kernel(
             bases,
             q,
@@ -237,6 +244,7 @@ def fused_pipeline(
             max_input_qual=c.max_input_qual,
             min_input_qual=c.min_input_qual,
             method=spec.ssc_method,
+            want_err=want_err,
         )
 
     quals_eff = quals
@@ -245,12 +253,17 @@ def fused_pipeline(
         cap = fit_cycle_cap_kernel(bases, fam, valid, cb0, fv0)
         quals_eff = apply_cycle_cap(quals, cap)
 
-    cb, cq, dep, size, fv = ssc(quals_eff)
+    # per-base disagreement counts only on the FINAL pass (the error
+    # model's fit pass needs bases, not counts)
+    cb, cq, dep, size, fv, *err_rest = ssc(quals_eff, spec.per_base_counts)
+    ss_err = err_rest[0] if err_rest else None
 
+    out_e = None
     if c.mode == "single_strand":
         out_b, out_q, out_d, out_v = cb, cq, dep, fv
+        out_e = ss_err
     elif c.mode == "duplex":
-        out_b, out_q, out_d, out_v = duplex_kernel(
+        out_b, out_q, out_d, out_v, *dx_rest = duplex_kernel(
             cb,
             cq,
             dep,
@@ -259,10 +272,13 @@ def fused_pipeline(
             mol,
             strand_ab,
             valid,
+            ss_err,
             m_max=m_max,
             min_duplex_reads=c.min_duplex_reads,
             max_qual=c.max_qual,
+            want_err=spec.per_base_counts,
         )
+        out_e = dx_rest[0] if dx_rest else None
     else:
         raise ValueError(f"unknown consensus mode {c.mode!r}")
 
@@ -329,6 +345,7 @@ def fused_pipeline(
         "cons_valid": out_v,
         "cons_mate": cons_mate.astype(jnp.uint8),
         "cons_pair": cons_pair,
+        **({"cons_err": out_e} if out_e is not None else {}),
     }
 
 

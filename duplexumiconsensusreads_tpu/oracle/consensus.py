@@ -40,14 +40,18 @@ def single_strand_consensus(
     bases: np.ndarray,
     quals: np.ndarray,
     params: ConsensusParams,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Consensus of one family: bases/quals (K, L) -> (base, qual, depth) per cycle."""
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Consensus of one family: bases/quals (K, L) ->
+    (base, qual, depth, err) per cycle; err counts contributing reads
+    that disagree with the called base (0 where no call)."""
     k, l = bases.shape
     out_base = np.full(l, BASE_N, np.uint8)
     out_qual = np.full(l, NO_CALL_QUAL, np.uint8)
     depth = np.zeros(l, np.int32)
+    err = np.zeros(l, np.int32)
     for c in range(l):
         ll = np.zeros(N_REAL_BASES)
+        cnt = np.zeros(N_REAL_BASES, np.int32)
         d = 0
         for i in range(k):
             b = bases[i, c]
@@ -58,6 +62,7 @@ def single_strand_consensus(
             e = phred_to_error(min(int(quals[i, c]), params.max_input_qual))
             ll += np.log(e / 3.0)
             ll[b] += np.log1p(-e) - np.log(e / 3.0)
+            cnt[b] += 1
             d += 1
         depth[c] = d
         if d == 0:
@@ -68,23 +73,30 @@ def single_strand_consensus(
         b = int(np.argmax(post))
         out_base[c] = b
         out_qual[c] = error_to_phred(1.0 - post[b], params.max_qual)
-    return out_base, out_qual, depth
+        err[c] = d - cnt[b]
+    return out_base, out_qual, depth, err
 
 
 def duplex_merge(
     base_ab: np.ndarray,
     qual_ab: np.ndarray,
     depth_ab: np.ndarray,
+    err_ab: np.ndarray,
     base_ba: np.ndarray,
     qual_ba: np.ndarray,
     depth_ba: np.ndarray,
+    err_ba: np.ndarray,
     params: ConsensusParams,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Merge the two strand consensi of one molecule, per cycle."""
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Merge the two strand consensi of one molecule, per cycle. The
+    error count is the sum of each strand's own-consensus
+    disagreements (strand-level discordance shows up as the duplex
+    base/qual, not in ce)."""
     l = len(base_ab)
     out_base = np.full(l, BASE_N, np.uint8)
     out_qual = np.full(l, NO_CALL_QUAL, np.uint8)
     depth = (depth_ab + depth_ba).astype(np.int32)
+    err = (err_ab + err_ba).astype(np.int32)
     for c in range(l):
         ba, bb = int(base_ab[c]), int(base_ba[c])
         qa, qb = int(qual_ab[c]), int(qual_ba[c])
@@ -97,7 +109,7 @@ def duplex_merge(
             out_base[c] = ba if qa > qb else bb
             out_qual[c] = max(abs(qa - qb), NO_CALL_QUAL)
         # qa == qb with disagreeing bases: stays N
-    return out_base, out_qual, depth
+    return out_base, out_qual, depth, err
 
 
 def call_consensus(
@@ -135,9 +147,10 @@ def call_consensus(
             quals=np.full((n_fam, l), NO_CALL_QUAL, np.uint8),
             depth=np.zeros((n_fam, l), np.int32),
             valid=np.zeros(n_fam, bool),
+            err=np.zeros((n_fam, l), np.int32),
         )
-        for f, (b, q, d) in ss.items():
-            out.bases[f], out.quals[f], out.depth[f] = b, q, d
+        for f, (b, q, d, e) in ss.items():
+            out.bases[f], out.quals[f], out.depth[f], out.err[f] = b, q, d, e
             out.valid[f] = True
         return out
 
@@ -150,6 +163,7 @@ def call_consensus(
         quals=np.full((n_mol, l), NO_CALL_QUAL, np.uint8),
         depth=np.zeros((n_mol, l), np.int32),
         valid=np.zeros(n_mol, bool),
+        err=np.zeros((n_mol, l), np.int32),
     )
     for mid in range(n_mol):
         sel_ab = np.nonzero((mol == mid) & valid & strand)[0]
@@ -168,7 +182,8 @@ def call_consensus(
                 "duplex consensus requires paired grouping "
                 "(GroupingParams(paired=True)); got a shared AB/BA family id"
             )
-        b, q, d = duplex_merge(*ss[fa], *ss[fb], params)
+        b, q, d, e = duplex_merge(*ss[fa], *ss[fb], params)
         out.bases[mid], out.quals[mid], out.depth[mid] = b, q, d
+        out.err[mid] = e
         out.valid[mid] = True
     return out

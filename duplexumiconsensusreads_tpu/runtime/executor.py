@@ -191,7 +191,7 @@ def scatter_bucket_outputs(
         pair_glob[keep],
     )
     if want_depth:
-        res = res + (out["cons_depth"][:nb][keep],)
+        res = res + (out["cons_depth"][:nb][keep], out["cons_err"][:nb][keep])
     return res
 
 
@@ -276,6 +276,7 @@ def partition_buckets(
     consensus: ConsensusParams,
     ssc_method: str | None = None,
     packed_io: bool = False,
+    per_base_counts: bool = False,
 ):
     """Split buckets into dispatch classes of identical geometry+strategy.
 
@@ -307,7 +308,8 @@ def partition_buckets(
             (
                 cbuckets,
                 spec_for_buckets(
-                    cbuckets, g, consensus, ssc_method, packed_io=packed_io
+                    cbuckets, g, consensus, ssc_method, packed_io=packed_io,
+                    per_base_counts=per_base_counts,
                 ),
             )
         )
@@ -375,7 +377,9 @@ def call_batch_tpu(
             z((0,), np.uint8),
             z((0,), np.int64),
         )
-        return empty + ((z((0, batch.read_len), np.int32),) if per_base_tags else ())
+        return empty + (
+            (z((0, batch.read_len), np.int32),) * 2 if per_base_tags else ()
+        )
 
     n_dev = n_devices or len(jax.devices())
     mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
@@ -388,7 +392,8 @@ def call_batch_tpu(
     # geometry and jumbo/preclustered buckets get their own compiles.
     # All classes are dispatched before any is drained (async overlap).
     part = partition_buckets(
-        buckets, grouping, consensus, packed_io=packed_io_ok(consensus)
+        buckets, grouping, consensus, packed_io=packed_io_ok(consensus),
+        per_base_counts=per_base_tags,
     )
 
     t0 = time.time()
@@ -404,7 +409,7 @@ def call_batch_tpu(
                 cbuckets,
                 start_fetch(
                     sharded_pipeline(stacked, cspec, mesh),
-                    extra=("cons_depth",) if per_base_tags else (),
+                    extra=("cons_depth", "cons_err") if per_base_tags else (),
                 ),
             )
         )
@@ -502,7 +507,7 @@ def call_batch_cpu(
         pair[cv],
     )
     if per_base_tags:
-        res = res + (np.asarray(cons.depth)[cv],)
+        res = res + (np.asarray(cons.depth)[cv], np.asarray(cons.err)[cv])
     return res
 
 
@@ -616,6 +621,7 @@ def call_consensus_file(
         cb, cq, cd, cv, fp, fu, duplex=duplex,
         cons_mate=mate, cons_pair=pair, paired_out=grouping.mate_aware,
         cons_pdepth=rest[0] if rest else None,
+        cons_perr=rest[1] if rest else None,
     )
     write_bam(out_path, header, out_recs)
     rep.n_consensus = len(out_recs)

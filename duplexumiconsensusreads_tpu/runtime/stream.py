@@ -811,7 +811,7 @@ def stream_call_consensus(
         # so the tunnel's per-fetch latency overlaps with compute
         out = start_fetch(
             sharded_pipeline(stacked, spec, mesh),
-            extra=("cons_depth",) if per_base_tags else (),
+            extra=("cons_depth", "cons_err") if per_base_tags else (),
         )
         dt = time.time() - t0
         with phase_lock:  # dict += from concurrent workers would race
@@ -968,7 +968,8 @@ def stream_call_consensus(
                 continue
             entries = []
             for cbuckets, cspec in partition_buckets(
-                buckets, grouping, consensus, packed_io=packed_io_ok(consensus)
+                buckets, grouping, consensus, packed_io=packed_io_ok(consensus),
+                per_base_counts=per_base_tags,
             ):
                 spec_cache[cspec] = True
                 # transfer workers: host->device copies ride the tunnel
@@ -1112,6 +1113,7 @@ def _finish_chunk(
         cons_pair=pair,
         paired_out=paired_out,
         cons_pdepth=cols[7] if len(cols) > 7 else None,
+        cons_perr=cols[8] if len(cols) > 8 else None,
     )
     # record stream only (header stripped) so shards concatenate
     full = serialize_bam(header, recs)

@@ -150,12 +150,16 @@ class ConsensusBatch:
     quals: u8 (F, L)   consensus Phred qualities
     depth: i32 (F, L)  per-cycle read depth that contributed
     valid: bool (F,)   False marks padding families
+    err:   i32 (F, L)  per-cycle count of contributing reads that
+                       disagree with the consensus base (duplex: sum of
+                       the two strands' own-consensus disagreements)
     """
 
     bases: Any
     quals: Any
     depth: Any
     valid: Any
+    err: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

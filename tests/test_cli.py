@@ -322,11 +322,11 @@ def test_group_regroup_replaces_mi(tmp_path):
     assert a.aux_raw == b.aux_raw
 
 
-def _cd_array(aux):
+def _cd_array(aux, tag=b"cdBI"):
     import struct
 
-    i = aux.find(b"cdBI")
-    assert i >= 0, "missing cd per-base tag"
+    i = aux.find(tag)
+    assert i >= 0, f"missing {tag} per-base tag"
     (cnt,) = struct.unpack_from("<I", aux, i + 4)
     return np.frombuffer(aux, "<u4", cnt, i + 8)
 
@@ -367,6 +367,10 @@ def test_per_base_tags(tmp_path):
             assert cd_arr.max() == cD
             pos_d = cd_arr[cd_arr > 0]
             assert (pos_d.min() if len(pos_d) else 0) == cM
+            # ce (per-base disagreeing reads) rides along, bounded by cd
+            ce_arr = _cd_array(r.aux_raw[k], b"ceBI")
+            assert len(ce_arr) == len(cd_arr)
+            assert (ce_arr <= cd_arr).all()
     # the three run modes agree elementwise on the arrays
     for other in ("stream", "cpu"):
         o = outs[other]
@@ -378,9 +382,12 @@ def test_per_base_tags(tmp_path):
         for k in range(len(o)):
             i = key_w[(int(o.pos[k]), o.umi[k], int(o.flags[k]))]
             np.testing.assert_array_equal(_cd_array(o.aux_raw[k]), _cd_array(w.aux_raw[i]))
-    # without the flag, no cd array is emitted
+            np.testing.assert_array_equal(
+                _cd_array(o.aux_raw[k], b"ceBI"), _cd_array(w.aux_raw[i], b"ceBI")
+            )
+    # without the flag, no cd/ce arrays are emitted
     out0 = str(tmp_path / "plain.bam")
     assert main(["call", bam, "-o", out0, "--config", "config3",
                  "--capacity", "256"]) == 0
     _, r0 = read_bam(out0)
-    assert all(a.find(b"cdBI") < 0 for a in r0.aux_raw)
+    assert all(a.find(b"cdBI") < 0 and a.find(b"ceBI") < 0 for a in r0.aux_raw)

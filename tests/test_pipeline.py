@@ -270,3 +270,57 @@ def test_cycle_error_model_earns_its_flops():
     assert nhi3 > 0 and nhi5 > 0
     assert hi5 < hi3, (hi5, hi3)
     assert hi5 <= 10 ** (-40 / 10) * 20, hi5  # within 20x of claimed Q40
+
+
+@pytest.mark.parametrize(
+    "gp_kw, cp_kw",
+    [
+        (dict(strategy="exact", paired=False), dict(mode="single_strand")),
+        (dict(strategy="adjacency", paired=True), dict(mode="duplex")),
+        (
+            dict(strategy="adjacency", paired=True),
+            dict(mode="duplex", error_model="cycle"),
+        ),
+    ],
+)
+def test_per_base_err_counts_match_oracle(gp_kw, cp_kw):
+    """spec.per_base_counts: the device err matrix (reads disagreeing
+    with the called base, the ce tag) must equal the oracle's exactly —
+    counts are order-independent integer sums, so no f32 tolerance."""
+    import dataclasses as dc
+
+    from duplexumiconsensusreads_tpu.ops import spec_for_buckets
+    from duplexumiconsensusreads_tpu.types import ReadBatch
+
+    cfg = SimConfig(
+        n_molecules=120, duplex=True, umi_error=0.02, base_error=0.05, seed=19
+    )
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(**gp_kw)
+    cp = ConsensusParams(**cp_kw)
+    buckets = build_buckets(batch, capacity=512, grouping=gp)
+    spec = dc.replace(
+        spec_for_buckets(buckets, gp, cp), per_base_counts=True
+    )
+    checked = 0
+    for bk in buckets:
+        out = run_bucket(bk, spec)
+        assert "cons_err" in out
+        sub = ReadBatch(
+            bases=bk.bases, quals=bk.quals, umi=bk.umi,
+            pos_key=bk.pos.astype(np.int64), strand_ab=bk.strand_ab,
+            frag_end=bk.frag_end, valid=bk.valid,
+        )
+        fams = group_reads(sub, gp)
+        cons = ConsensusCaller(cp, backend="cpu")(sub, fams)
+        n = len(cons.valid)
+        np.testing.assert_array_equal(
+            np.asarray(out["cons_err"])[:n], cons.err
+        )
+        # padding rows carry zero errors
+        assert not np.asarray(out["cons_err"])[n:].any()
+        checked += int(cons.valid.sum())
+    assert checked > 50
+    # err is bounded by depth, and nonzero somewhere at 5% base error
+    assert (cons.err <= cons.depth).all()
+    assert cons.err.sum() > 0
