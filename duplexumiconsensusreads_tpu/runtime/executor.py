@@ -118,8 +118,9 @@ def scatter_bucket_outputs(
     batch: ReadBatch,
     duplex: bool,
     pair_base: int = 0,  # global bucket index of buckets[0] — see below
-    want_depth: bool = False,  # also return per-base depth rows
-    # (requires cons_depth in out — per_base_tags runs only)
+    want_depth: bool = False,  # also return per-base depth AND err rows
+    # (requires cons_depth + cons_err in out, i.e. a pipeline spec with
+    # per_base_counts=True — per_base_tags runs only)
 ):
     """Map per-bucket device outputs back to source-batch coordinates.
 
@@ -344,9 +345,10 @@ def call_batch_tpu(
 
     Returns (cons_base, cons_qual, cons_dstats, cons_valid, fam_pos,
     fam_umi, cons_mate, cons_pair) concatenated over buckets in global
-    dense-output order; per_base_tags=True appends a 9th element, the
-    (n, L) per-base depth matrix (fetched off-device only on request —
-    it is the transfer the FETCH_KEYS discipline exists to avoid).
+    dense-output order; per_base_tags=True appends TWO elements — the
+    (n, L) per-base depth and disagreement-count matrices (fetched
+    off-device only on request — they are the transfer the FETCH_KEYS
+    discipline exists to avoid).
     """
     import jax
 

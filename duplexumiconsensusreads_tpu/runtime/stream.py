@@ -1097,7 +1097,9 @@ def _finish_chunk(
     paired_out=False,
 ) -> str:
     """Merge one chunk's per-class scattered outputs and write its
-    shard. parts rows are 7-tuples (8 with per-base depth)."""
+    shard. parts rows are 7-tuples (9 with per-base tags: cols[7] the
+    depth matrix, cols[8] the disagreement counts — consumed
+    positionally below, so extensions must append AFTER them)."""
     cols = sort_consensus_outputs(*(np.concatenate(x) for x in zip(*parts)))
     cb, cq, cd, fp, fu, mate, pair = cols[:7]
     recs = consensus_to_records(
