@@ -122,7 +122,14 @@ _CIGAR_OPS = "MIDNSHP=X"
 def iter_aux_fields(aux: bytes):
     """Yield (field_start, tag, typ, value_start, field_end) for each
     aux field — the ONE walker parse/strip/filter code shares, so a
-    type-handling fix can never apply to one consumer and miss another."""
+    type-handling fix can never apply to one consumer and miss another.
+
+    Raises ValueError on any malformation it VISITS (unknown type/
+    subtype, any truncation including 1-2 stray trailing bytes).
+    Consumers that early-exit once they find their tag (RX extraction,
+    the filter's tag reads) deliberately do not visit — hence do not
+    validate — fields after it; only full walks (strip_aux_tag, a
+    search for an absent tag) check the whole blob."""
     pos, n = 0, len(aux)
     while pos + 3 <= n:
         start = pos
@@ -139,14 +146,26 @@ def iter_aux_fields(aux: bytes):
         elif typ in b"ZH":
             size = aux.index(b"\x00", pos) - pos + 1
         elif typ == b"B":
+            if pos + 5 > n:
+                raise ValueError(f"truncated B-array header for tag {tag!r}")
             sub = aux[pos : pos + 1]
             cnt = struct.unpack_from("<I", aux, pos + 1)[0]
-            sub_size = {b"c": 1, b"C": 1, b"s": 2, b"S": 2, b"i": 4, b"I": 4, b"f": 4}[sub]
+            sub_size = {b"c": 1, b"C": 1, b"s": 2, b"S": 2, b"i": 4, b"I": 4, b"f": 4}.get(sub)
+            if sub_size is None:
+                raise ValueError(f"unknown B-array subtype {sub!r} for tag {tag!r}")
             size = 5 + cnt * sub_size
         else:
             raise ValueError(f"unknown aux tag type {typ!r}")
         pos += size
+        if pos > n:
+            raise ValueError(
+                f"truncated aux field {tag!r}:{typ!r} (needs {pos - n} more bytes)"
+            )
         yield start, tag, typ, vstart, pos
+    if pos != n:
+        # 1-2 stray trailing bytes: a truncated next-field tag, not a
+        # valid stream tail — reject like every other truncation point
+        raise ValueError(f"trailing {n - pos} stray aux bytes (truncated field)")
 
 
 def _parse_aux_rx(aux: bytes) -> str:

@@ -280,3 +280,63 @@ def test_native_scan_rejects_truncation():
         cut = np.frombuffer(raw[: body_start + off], np.uint8)
         with pytest.raises(ValueError):
             scan_region(lib, cut)
+
+
+def test_aux_walker_fuzz():
+    """Property-fuzz io.bam.iter_aux_fields (the ONE walker behind RX
+    extraction, tag stripping, and filter tag reads): on randomly
+    generated VALID aux blobs it must tile the blob exactly; on any
+    truncation it must raise rather than mis-walk; strip_aux_tag must
+    remove exactly the named fields and preserve the rest bytewise."""
+    import random
+
+    from duplexumiconsensusreads_tpu.io.bam import iter_aux_fields, strip_aux_tag
+
+    rng = random.Random(7)
+    tags = ["AA", "BB", "RX", "MI", "cd", "XZ"]
+
+    def rand_field():
+        tag = rng.choice(tags).encode()
+        kind = rng.randrange(6)
+        if kind == 0:
+            return tag + b"A" + bytes([rng.randrange(33, 120)])
+        if kind == 1:
+            t = rng.choice([b"c", b"C", b"s", b"S", b"i", b"I", b"f"])
+            size = {b"c": 1, b"C": 1, b"s": 2, b"S": 2}.get(t, 4)
+            return tag + t + bytes(rng.randrange(256) for _ in range(size))
+        if kind == 2:
+            return tag + b"Z" + bytes(
+                rng.randrange(33, 126) for _ in range(rng.randrange(0, 9))
+            ) + b"\x00"
+        if kind == 3:
+            return tag + b"H" + b"AB" * rng.randrange(0, 4) + b"\x00"
+        sub = rng.choice([b"c", b"C", b"s", b"S", b"i", b"I", b"f"])
+        esz = {b"c": 1, b"C": 1, b"s": 2, b"S": 2}.get(sub, 4)
+        cnt = rng.randrange(0, 5)
+        return (
+            tag + b"B" + sub + struct.pack("<I", cnt)
+            + bytes(rng.randrange(256) for _ in range(cnt * esz))
+        )
+
+    for _trial in range(200):
+        fields = [rand_field() for _ in range(rng.randrange(0, 7))]
+        aux = b"".join(fields)
+        walked = list(iter_aux_fields(aux))
+        # exact tiling: fields abut and cover the blob
+        assert [aux[s:e] for s, _, _, _, e in walked] == fields
+        # strip removes exactly the matching fields
+        victim = rng.choice(tags)
+        stripped = strip_aux_tag(aux, victim)
+        expect = b"".join(f for f in fields if f[:2] != victim.encode())
+        assert stripped == expect
+        # any strict prefix cut inside a field raises or yields only
+        # the fields wholly before the cut (never a mangled field)
+        if aux:
+            cut = rng.randrange(1, len(aux))
+            try:
+                walked_cut = list(iter_aux_fields(aux[:cut]))
+            except (ValueError, struct.error, IndexError):
+                continue
+            assert all(e <= cut for _s, _t, _y, _v, e in walked_cut)
+            parsed = b"".join(aux[s:e] for s, _, _, _, e in walked_cut)
+            assert aux.startswith(parsed)
