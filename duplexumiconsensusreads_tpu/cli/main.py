@@ -930,7 +930,12 @@ def _cmd_group(args) -> int:
     device matrices stay u_max^2 per BUCKET, never per file. MI values
     are opaque labels: the read partition is backend-identical, but the
     numbering may differ between backends when oversized position
-    groups reorder bucket emission. Host memory holds the whole record
+    groups reorder bucket emission. Two result-changing fallbacks can
+    break exact partition identity (precluster on oversized position
+    groups may miss cross-piece adjacency merges; jumbo hard-cuts split
+    one molecule across MI values) — both are tallied via the same
+    FALLBACK_COUNTERS as `call` and surfaced in the summary when
+    nonzero. Host memory holds the whole record
     set (annotation needs every record); for inputs beyond that, run
     `call --chunk-reads`.
     """
@@ -961,6 +966,7 @@ def _cmd_group(args) -> int:
     n = len(recs)
     mol = np.full(n, -1, np.int64)
     n_mol_total = n_fam_total = 0
+    counters: dict = {}
     if args.backend == "cpu":
         fams = group_reads(batch, gp)
         mol[:] = np.asarray(fams.molecule_id)
@@ -970,7 +976,9 @@ def _cmd_group(args) -> int:
         from duplexumiconsensusreads_tpu.bucketing.buckets import _pow2
         from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel
 
-        for bk in build_buckets(batch, capacity=args.capacity, grouping=gp):
+        for bk in build_buckets(
+            batch, capacity=args.capacity, grouping=gp, counters=counters
+        ):
             strategy = "exact" if bk.preclustered else gp.strategy
             _, mids, _, n_fam, n_mol, n_over = group_kernel(
                 bk.pos, bk.umi, bk.strand_ab, bk.frag_end, bk.valid,
@@ -981,7 +989,15 @@ def _cmd_group(args) -> int:
                 presorted=True,
             )
             mids = np.asarray(mids)
-            assert int(n_over) == 0  # u_max >= bucket unique count
+            if int(n_over) != 0:
+                # production invariant (u_max >= bucket unique count),
+                # not a debug check: under `python -O` an assert would
+                # let overflowed reads silently drop from MI tagging
+                raise RuntimeError(
+                    f"group: {int(n_over)} reads overflowed u_max in a "
+                    f"bucket (capacity {bk.capacity}); this is a bug in "
+                    f"bucket sizing — please report"
+                )
             sel = (bk.read_index >= 0) & bk.valid & (mids >= 0)
             mol[bk.read_index[sel]] = mids[sel] + n_mol_total
             n_mol_total += int(n_mol)
@@ -1010,6 +1026,9 @@ def _cmd_group(args) -> int:
         "grouping": args.grouping,
         "backend": args.backend,
     }
+    nonzero = {k: v for k, v in counters.items() if v}
+    if nonzero:
+        summary["fallbacks"] = nonzero
     if args.json:
         print(json.dumps(summary))
     else:
@@ -1018,6 +1037,14 @@ def _cmd_group(args) -> int:
             f"tagged with MI across {summary['n_molecules']} molecules "
             f"({summary['n_families']} families, {args.grouping}) → "
             f"{args.output}",
+            file=sys.stderr,
+        )
+    if nonzero:
+        print(
+            f"[duplexumi] WARNING: result-changing grouping fallbacks fired: "
+            f"{nonzero} — MI partition may deviate from whole-file oracle "
+            f"grouping (precluster can miss cross-piece merges; jumbo "
+            f"hard-cuts split molecules)",
             file=sys.stderr,
         )
     return 0

@@ -144,7 +144,13 @@ def iter_aux_fields(aux: bytes):
         elif typ in b"iIf":
             size = 4
         elif typ in b"ZH":
-            size = aux.index(b"\x00", pos) - pos + 1
+            try:
+                size = aux.index(b"\x00", pos) - pos + 1
+            except ValueError:
+                raise ValueError(
+                    f"unterminated Z/H aux field {tag!r} (no NUL before "
+                    f"end of aux block)"
+                ) from None
         elif typ == b"B":
             if pos + 5 > n:
                 raise ValueError(f"truncated B-array header for tag {tag!r}")

@@ -649,13 +649,20 @@ def consensus_to_records(
     cd_bytes = ds[:, 0].astype("<i4").tobytes()
     cm_bytes = ds[:, 1].astype("<i4").tobytes()
     def _pb_rows(tag: bytes, arr):
-        # fgbio-style per-base B,I array (u32 subtype: jumbo families
-        # can exceed u16 — the hard cap is 64x bucket capacity)
+        # fgbio-style per-base B array. fgbio emits B,S; we match that
+        # whenever every value fits u16, widening to B,I only for jumbo
+        # depths (the hard cap is 64x bucket capacity, which can exceed
+        # u16) — strict fgbio-downstream parsers accept the common case
         import struct as _struct
 
-        hdr = tag + b"BI" + _struct.pack("<I", l)
-        flat = np.asarray(arr)[idx].astype("<u4").tobytes()
-        return [hdr + flat[4 * l * k : 4 * l * (k + 1)] for k in range(n)]
+        rows = np.asarray(arr)[idx]
+        if rows.size == 0 or int(rows.max()) < 65536:
+            sub, width, dt = b"S", 2, "<u2"
+        else:
+            sub, width, dt = b"I", 4, "<u4"
+        hdr = tag + b"B" + sub + _struct.pack("<I", l)
+        flat = rows.astype(dt).tobytes()
+        return [hdr + flat[width * l * k : width * l * (k + 1)] for k in range(n)]
 
     pd_rows = None if cons_pdepth is None else _pb_rows(b"cd", cons_pdepth)
     pe_rows = None if cons_perr is None else _pb_rows(b"ce", cons_perr)

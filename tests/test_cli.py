@@ -322,13 +322,17 @@ def test_group_regroup_replaces_mi(tmp_path):
     assert a.aux_raw == b.aux_raw
 
 
-def _cd_array(aux, tag=b"cdBI"):
+def _cd_array(aux, tag=b"cdB"):
+    # subtype-tolerant: the writer emits the smallest sufficient
+    # integer subtype (B,S normally, B,I for jumbo depths)
     import struct
 
     i = aux.find(tag)
     assert i >= 0, f"missing {tag} per-base tag"
+    sub = aux[i + 3 : i + 4]
+    dt = {b"S": "<u2", b"I": "<u4", b"s": "<i2", b"i": "<i4", b"C": "u1"}[sub]
     (cnt,) = struct.unpack_from("<I", aux, i + 4)
-    return np.frombuffer(aux, "<u4", cnt, i + 8)
+    return np.frombuffer(aux, dt, cnt, i + 8).astype(np.uint32)
 
 
 def test_per_base_tags(tmp_path):
@@ -368,7 +372,7 @@ def test_per_base_tags(tmp_path):
             pos_d = cd_arr[cd_arr > 0]
             assert (pos_d.min() if len(pos_d) else 0) == cM
             # ce (per-base disagreeing reads) rides along, bounded by cd
-            ce_arr = _cd_array(r.aux_raw[k], b"ceBI")
+            ce_arr = _cd_array(r.aux_raw[k], b"ceB")
             assert len(ce_arr) == len(cd_arr)
             assert (ce_arr <= cd_arr).all()
     # the three run modes agree elementwise on the arrays
@@ -383,11 +387,11 @@ def test_per_base_tags(tmp_path):
             i = key_w[(int(o.pos[k]), o.umi[k], int(o.flags[k]))]
             np.testing.assert_array_equal(_cd_array(o.aux_raw[k]), _cd_array(w.aux_raw[i]))
             np.testing.assert_array_equal(
-                _cd_array(o.aux_raw[k], b"ceBI"), _cd_array(w.aux_raw[i], b"ceBI")
+                _cd_array(o.aux_raw[k], b"ceB"), _cd_array(w.aux_raw[i], b"ceB")
             )
     # without the flag, no cd/ce arrays are emitted
     out0 = str(tmp_path / "plain.bam")
     assert main(["call", bam, "-o", out0, "--config", "config3",
                  "--capacity", "256"]) == 0
     _, r0 = read_bam(out0)
-    assert all(a.find(b"cdBI") < 0 and a.find(b"ceBI") < 0 for a in r0.aux_raw)
+    assert all(a.find(b"cdB") < 0 and a.find(b"ceB") < 0 for a in r0.aux_raw)

@@ -367,9 +367,11 @@ def test_filter_min_base_depth_masks_shallow_cycles(tmp_path, capsys):
     # choose a threshold between min and max observed per-base depth so
     # the mask demonstrably fires without wiping every base
     def cd_arr(a):
-        i = a.find(b"cdBI")
+        i = a.find(b"cdB")
+        sub = a[i + 3 : i + 4]
+        dt = {b"S": "<u2", b"I": "<u4"}[sub]
         (cnt,) = struct.unpack_from("<I", a, i + 4)
-        return np.frombuffer(a, "<u4", cnt, i + 8)
+        return np.frombuffer(a, dt, cnt, i + 8).astype(np.uint32)
 
     depths = np.concatenate([cd_arr(a) for a in before.aux_raw])
     thr = int(depths.max())  # masks every cycle shallower than the max

@@ -266,6 +266,17 @@ def test_truncation_at_every_boundary_is_loud():
             parse_bam(raw[:cut])
 
 
+def test_unterminated_z_field_is_descriptive():
+    """A Z/H aux field whose NUL terminator is missing (block ends
+    first) must name the tag and the failure, not surface a bare
+    'subsequence not found' from bytes.index."""
+    from duplexumiconsensusreads_tpu.io.bam import iter_aux_fields
+
+    aux = b"XTZ" + b"no-terminator-here"
+    with pytest.raises(ValueError, match="unterminated Z/H.*XT"):
+        list(iter_aux_fields(aux))
+
+
 @pytest.mark.skipif(_native_lib() is None, reason="native lib unavailable")
 def test_native_scan_rejects_truncation():
     from duplexumiconsensusreads_tpu.io.native_reader import scan_region
