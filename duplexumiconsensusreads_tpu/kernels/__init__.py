@@ -3,6 +3,7 @@ from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel  # noqa: F
 from duplexumiconsensusreads_tpu.kernels.consensus import (  # noqa: F401
     ssc_kernel,
     duplex_kernel,
+    duplex_merge_strided,
 )
 from duplexumiconsensusreads_tpu.kernels.error_model import (  # noqa: F401
     fit_cycle_cap_kernel,

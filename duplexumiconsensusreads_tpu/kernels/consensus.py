@@ -48,13 +48,20 @@ def _phred_from_err(err: jnp.ndarray, max_qual: int) -> jnp.ndarray:
     return jnp.clip(q, 2, max_qual).astype(jnp.int32)
 
 
-def _evidence_columns(bases, quals, ok, max_input_qual, min_input_qual, want_err):
-    """(rows, C) evidence block: loglik contributions (4L), depth
-    indicators (L), read-count (1)[, real-masked base counts (4L) for
-    the err reduction]."""
+def _evidence_columns(
+    bases, quals, ok, max_input_qual, min_input_qual, want_err, want_depth=True
+):
+    """(rows, C) evidence block: loglik contributions (4L)[, depth
+    indicators (L)], read-count (1)[, real-masked base counts (4L) for
+    the err reduction]. Column slicing happens BEFORE the reduction
+    GEMM on purpose: XLA cannot narrow a dot's output columns through
+    post-hoc slices, so every column here costs real MXU work."""
     r, l = bases.shape
     contrib, real = _contributions(bases, quals, ok, max_input_qual, min_input_qual)
-    cols = [contrib.reshape(r, 4 * l), real, ok.astype(jnp.float32)[:, None]]
+    cols = [contrib.reshape(r, 4 * l)]
+    if want_depth:
+        cols.append(real)
+    cols.append(ok.astype(jnp.float32)[:, None])
     if want_err:
         oh = (
             (bases[:, :, None] == jnp.arange(N_REAL_BASES, dtype=bases.dtype))
@@ -95,7 +102,7 @@ def _contributions(bases, quals, valid, max_input_qual, min_input_qual=0):
     jax.jit,
     static_argnames=(
         "f_max", "min_reads", "max_qual", "max_input_qual",
-        "min_input_qual", "method", "want_err",
+        "min_input_qual", "method", "want_err", "columns",
     ),
 )
 def ssc_kernel(
@@ -111,6 +118,7 @@ def ssc_kernel(
     min_input_qual: int = 0,
     method: str = "matmul",
     want_err: bool = False,
+    columns: str = "full",
 ):
     """Single-strand consensus for all families at once.
 
@@ -121,8 +129,20 @@ def ssc_kernel(
     have fam_size 0 and fam_valid False. err counts contributing reads
     disagreeing with the called base (the per-base ce tag); it widens
     the reduction by 4L count columns, so it is opt-in.
+
+    columns="fit" is the error-model pass-1 variant: it drops the L
+    depth columns from the reduction (20% fewer GEMM FLOPs) and returns
+    only (cons_base, fam_size, fam_valid). The depth>0 masking is
+    recovered exactly from the loglik sign (strictly negative iff any
+    read contributed — see the inline proof), so fit-mode cons_base is
+    bit-identical to the full pass's.
     """
     r, l = bases.shape
+    want_depth = columns != "fit"
+    if columns not in ("full", "fit"):
+        raise ValueError(f"unknown ssc columns mode {columns!r}")
+    if not want_depth and want_err:
+        raise ValueError("columns='fit' is incompatible with want_err")
     ok = valid & (family_id >= 0)
     fid = jnp.where(ok, family_id, f_max)  # overflow row, sliced off below
 
@@ -130,7 +150,7 @@ def ssc_kernel(
         # (R, 4L | L | 1 [| 4L]): loglik contributions, depth
         # indicators, read count, optional base counts (want_err)
         big = _evidence_columns(
-            bases, quals, ok, max_input_qual, min_input_qual, want_err
+            bases, quals, ok, max_input_qual, min_input_qual, want_err, want_depth
         )
         if method == "matmul":
             onehot_f = (
@@ -164,6 +184,7 @@ def ssc_kernel(
             max_input_qual,
             min_input_qual,
             want_err,
+            want_depth,
         )
         c = big.shape[1]
         if method == "runsum":
@@ -224,6 +245,24 @@ def ssc_kernel(
         raise ValueError(f"unknown ssc method {method!r}")
 
     loglik = out[:, : 4 * l].reshape(f_max, l, 4)
+    if not want_depth:
+        # fit mode: argmax + family size only. Zero-evidence masking
+        # WITHOUT depth columns: every contributing read's loglik terms
+        # are strictly negative (log(e/3) < log(1/3) and log1p(-e) < 0
+        # for e >= MIN_ERROR_PROB), non-contributors add exact ±0.0, and
+        # f32 sums of negatives never round to zero — so max(loglik) < 0
+        # iff the (family, cycle) has >= 1 contributing read, exactly
+        # the depth > 0 test of the full pass. This matters when
+        # min_input_qual > 0: a cycle whose reads are all sub-threshold
+        # must yield BASE_N so the fit excludes those reads, matching
+        # the oracle (review r4 finding).
+        fam_size = out[:, 4 * l].astype(jnp.int32)
+        has_evidence = jnp.max(loglik, axis=-1) < 0
+        cons_base = jnp.where(
+            has_evidence, jnp.argmax(loglik, axis=-1), BASE_N
+        ).astype(jnp.int32)
+        fam_valid = fam_size >= min_reads
+        return cons_base, fam_size, fam_valid
     depth = out[:, 4 * l : 5 * l].astype(jnp.int32)
     fam_size = out[:, 5 * l].astype(jnp.int32)
     counts = (
@@ -257,6 +296,82 @@ def ssc_kernel(
     match = jnp.take_along_axis(counts, base[..., None], axis=-1)[..., 0]
     err_n = jnp.where(called & fam_valid[:, None], depth - match, 0)
     return cons_base, cons_qual, depth, fam_size, fam_valid, err_n
+
+
+@partial(
+    jax.jit,
+    static_argnames=("m_max", "min_duplex_reads", "max_qual", "want_err"),
+)
+def duplex_merge_strided(
+    cons_base: jnp.ndarray,  # (2M, L) i32, row 2m = AB strand of unit m, 2m+1 = BA
+    cons_qual: jnp.ndarray,  # (2M, L) i32
+    depth: jnp.ndarray,  # (2M, L) i32
+    fam_size: jnp.ndarray,  # (2M,) i32
+    fam_valid: jnp.ndarray,  # (2M,) bool
+    ss_err: jnp.ndarray | None = None,  # (2M, L) i32, required iff want_err
+    *,
+    m_max: int,
+    min_duplex_reads: int = 1,
+    max_qual: int = 90,
+    want_err: bool = False,
+):
+    """Duplex merge when the ssc reduction was keyed by the STRIDED id
+    ``molecule*2 + strand_ba`` instead of the dense family rank: the two
+    strands of unit m are rows 2m and 2m+1, so the merge is pure
+    reshape-slicing — zero gathers, zero segment reductions. Measured
+    r4 on v5e: the gather-based duplex_kernel was 18.6% of the fused
+    step (six (M, L) row-gathers + four R-length segment ops); this
+    variant removes all of it. Semantics are identical: a unit missing
+    a strand has an all-zero evidence row (fam_size 0), which fails the
+    size>0 presence check exactly like the old table-presence test.
+    """
+    if want_err and ss_err is None:
+        raise ValueError("duplex_merge_strided: ss_err required when want_err=True")
+    l = cons_base.shape[1]
+    b2 = cons_base.reshape(m_max, 2, l)
+    q2 = cons_qual.reshape(m_max, 2, l)
+    d2 = depth.reshape(m_max, 2, l)
+    s2 = fam_size.reshape(m_max, 2)
+    v2 = fam_valid.reshape(m_max, 2)
+    b_ab, b_ba = b2[:, 0], b2[:, 1]
+    q_ab, q_ba = q2[:, 0], q2[:, 1]
+
+    both_real = (b_ab < N_REAL_BASES) & (b_ba < N_REAL_BASES)
+    agree = both_real & (b_ab == b_ba)
+    disagree = both_real & (b_ab != b_ba) & (q_ab != q_ba)
+
+    dx_base = jnp.where(
+        agree,
+        b_ab,
+        jnp.where(disagree, jnp.where(q_ab > q_ba, b_ab, b_ba), BASE_N),
+    )
+    dx_qual = jnp.where(
+        agree,
+        jnp.minimum(q_ab + q_ba, max_qual),
+        jnp.where(
+            disagree,
+            jnp.maximum(jnp.abs(q_ab - q_ba), NO_CALL_QUAL),
+            NO_CALL_QUAL,
+        ),
+    )
+    dx_depth = d2[:, 0] + d2[:, 1]
+
+    dx_valid = (
+        (s2[:, 0] > 0)  # strand present (== the old table-presence test)
+        & (s2[:, 1] > 0)
+        & (s2[:, 0] >= min_duplex_reads)
+        & (s2[:, 1] >= min_duplex_reads)
+        & v2[:, 0]
+        & v2[:, 1]
+    )
+    dx_base = jnp.where(dx_valid[:, None], dx_base, BASE_N)
+    dx_qual = jnp.where(dx_valid[:, None], dx_qual, NO_CALL_QUAL)
+    dx_depth = jnp.where(dx_valid[:, None], dx_depth, 0)
+    if not want_err:
+        return dx_base, dx_qual, dx_depth, dx_valid
+    e2 = ss_err.reshape(m_max, 2, l)
+    dx_err = jnp.where(dx_valid[:, None], e2[:, 0] + e2[:, 1], 0)
+    return dx_base, dx_qual, dx_depth, dx_valid, dx_err
 
 
 @partial(

@@ -50,6 +50,21 @@ from duplexumiconsensusreads_tpu.kernels.encoding import pack_umi_words
 I32_MAX = jnp.iinfo(jnp.int32).max
 
 
+def _pairwise_less_eq(primary_less, primary_eq, words):
+    """Lexicographic pairwise compare on a (U, U) grid: extends the
+    primary key's less/eq masks with the word columns of ``words``
+    (U, W). Orientation: out_less[i, j] == key_j < key_i (so a row-sum
+    over valid j is key_i's rank). Shared by the two compare-count
+    rankings below — the orientation subtlety must live in ONE place.
+    """
+    less, eq = primary_less, primary_eq
+    for k in range(words.shape[1]):
+        a = words[:, k]
+        less = less | (eq & (a[None, :] < a[:, None]))
+        eq = eq & (a[None, :] == a[:, None])
+    return less, eq
+
+
 def _run_ids(keys: list[jnp.ndarray]) -> jnp.ndarray:
     """Dense ids for runs of equal sorted keys: (R,) i32 via cumsum."""
     new = jnp.zeros(keys[0].shape[0], bool).at[0].set(True)
@@ -92,10 +107,19 @@ def _directional_cluster(
         & ~jnp.eye(u, dtype=bool)
     )
 
-    # rank by (-count, packed UMI words); invalid slots rank last
-    cnt_key = jnp.where(u_valid, -u_cnt, I32_MAX)
-    order = jnp.lexsort((*[u_words[:, i] for i in range(u_words.shape[1] - 1, -1, -1)], cnt_key))
-    rank = jnp.zeros(u, jnp.int32).at[order].set(jnp.arange(u, dtype=jnp.int32))
+    # rank by (-count, packed UMI words) via PAIRWISE COMPARE-COUNT on
+    # the (U, U) grid the edge matrix already lives on — no lexsort, no
+    # scatter (r4: the two table lexsorts were a measurable share of
+    # the adjacency machinery). rank[i] = #{valid j : key_j < key_i}.
+    # Keys can tie only ACROSS positions (words are unique within a
+    # position group, the table is unique (pos, UMI)); reachability is
+    # position-local, so the argmin below never compares tied ranks —
+    # equal ranks across positions are harmless, exactly as the old
+    # stable lexsort's index tie-break was.
+    cj, ci = u_cnt[None, :], u_cnt[:, None]
+    less, _ = _pairwise_less_eq(cj > ci, cj == ci, u_words)  # count desc
+    rank = jnp.sum(less & u_valid[None, :], axis=1).astype(jnp.int32)
+    rank = jnp.where(u_valid, rank, I32_MAX - 1)  # invalid slots rank last
 
     # transitive closure by repeated squaring on the MXU. bf16 is exact
     # for the reachability test: entries are 0/1, every partial dot
@@ -234,22 +258,27 @@ def group_kernel(
         seed = _directional_cluster(
             u_words, u_codes, u_pos, u_cnt, u_valid, max_hamming, count_ratio
         )
-        # cluster key per slot = (pos, seed's words); rank distinct keys
-        # with ONE u_max-sized lexsort (never an R-sized sort)
+        # cluster key per slot = (pos, seed's words); dense ids over
+        # DISTINCT keys in sorted-key order, via pairwise compare-count
+        # on the (u_max, u_max) grid instead of a lexsort + run-id
+        # cumsum + scatter (r4). mid[i] = #distinct valid keys < key_i;
+        # "distinct" is enforced by counting only each key's first
+        # occurrence. Exact: integer compares, same sorted-key id order
+        # as the oracle's np.unique.
         seed_words = jnp.take(u_words, seed, axis=0)
         key_w = jnp.where(u_valid[:, None], seed_words, I32_MAX)
         key_p = jnp.where(u_valid, u_pos, I32_MAX)
-        t_order = jnp.lexsort(
-            (*[key_w[:, i] for i in range(w - 1, -1, -1)], key_p)
+        kless, keq = _pairwise_less_eq(
+            key_p[None, :] < key_p[:, None],
+            key_p[None, :] == key_p[:, None],
+            key_w,
         )
-        mid_t = _run_ids([key_p[t_order]] + [key_w[t_order][:, i] for i in range(w)])
-        tv = u_valid[t_order]
-        n_mol = jnp.where(tv.any(), mid_t[jnp.sum(tv) - 1] + 1, 0).astype(jnp.int32)
-        mid_of_slot = (
-            jnp.full((u_max,), I32_MAX, jnp.int32)
-            .at[t_order]
-            .set(jnp.where(tv, mid_t, I32_MAX))
-        )
+        idx_u = jnp.arange(u_max, dtype=jnp.int32)
+        first = ~jnp.any(keq & (idx_u[None, :] < idx_u[:, None]), axis=1)
+        fv_col = (first & u_valid)[None, :]
+        mid_raw_t = jnp.sum(kless & fv_col, axis=1).astype(jnp.int32)
+        n_mol = jnp.sum(first & u_valid).astype(jnp.int32)
+        mid_of_slot = jnp.where(u_valid, mid_raw_t, I32_MAX)
 
     slot_c = jnp.minimum(uid, u_max - 1)
     mid_raw = jnp.take(mid_of_slot, slot_c)

@@ -18,7 +18,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from duplexumiconsensusreads_tpu.kernels.consensus import duplex_kernel, ssc_kernel
+from duplexumiconsensusreads_tpu.kernels.consensus import (
+    duplex_kernel,
+    duplex_merge_strided,
+    ssc_kernel,
+)
 from duplexumiconsensusreads_tpu.kernels.error_model import (
     apply_cycle_cap,
     fit_cycle_cap_kernel,
@@ -164,18 +168,20 @@ def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
     if g.strategy == "adjacency":
         fl += 2.0 * u * u * 4 * b  # matches = onehot @ onehot.T
         fl += max(1, (u - 1).bit_length()) * 2.0 * float(u) ** 3  # closure
-    passes = 2 if c.error_model == "cycle" else 1
+    # error model adds a fit-only pass: 4l+1 evidence columns (no depth
+    # block) vs the final pass's 5l+1
+    cols = (5 * l + 1) + ((4 * l + 1) if c.error_model == "cycle" else 0)
     if spec.ssc_method == "matmul":
         f = (spec.f_max or r) + 1
-        fl += passes * 2.0 * f * r * (5 * l + 1)  # dense one-hot GEMM
+        fl += 2.0 * f * r * cols  # dense one-hot GEMM
     elif spec.ssc_method == "blockseg":
         from duplexumiconsensusreads_tpu.kernels.consensus import BLOCKSEG_T
 
         t = min(BLOCKSEG_T, r)
-        fl += passes * 2.0 * r * (t + 1) * (5 * l + 1)  # block-local GEMMs
+        fl += 2.0 * r * (t + 1) * cols  # block-local GEMMs
     else:
         # pallas/segment/runsum perform ~the useful reduction FLOPs only
-        fl += passes * 2.0 * r * (5 * l + 1)
+        fl += 2.0 * r * cols
     return fl
 
 
@@ -232,11 +238,28 @@ def fused_pipeline(
     f_max = spec.f_max or r
     m_max = spec.m_max or r
 
-    def ssc(q, want_err=False):
+    # Duplex mode reduces the ssc into rows keyed by the STRIDED id
+    # (molecule*2 + strand_ba) instead of the dense family rank: same
+    # GEMM cost whenever 2*m_max == f_max (spec_for_buckets guarantees
+    # it — f_mult is always 2*m_mult), and the duplex merge collapses
+    # from six row-gathers + four segment reductions to reshape-slices
+    # (duplex_merge_strided; 18.6% of the r3 fused step). The dense
+    # family_id output is untouched — it stays the oracle-parity id.
+    strided = c.mode == "duplex" and 2 * m_max == f_max
+    if strided:
+        red = jnp.where(
+            (mol >= 0) & valid,
+            mol * 2 + jnp.where(strand_ab, 0, 1),
+            jnp.int32(-1),
+        )
+    else:
+        red = fam
+
+    def ssc(q, want_err=False, columns="full"):
         return ssc_kernel(
             bases,
             q,
-            fam,
+            red,
             valid,
             f_max=f_max,
             min_reads=c.min_reads,
@@ -245,12 +268,16 @@ def fused_pipeline(
             min_input_qual=c.min_input_qual,
             method=spec.ssc_method,
             want_err=want_err,
+            columns=columns,
         )
 
     quals_eff = quals
     if c.error_model == "cycle":
-        cb0, _, _, _, fv0 = ssc(quals)
-        cap = fit_cycle_cap_kernel(bases, fam, valid, cb0, fv0)
+        # pass 1 runs fit-only columns: no depth block in the GEMM, no
+        # consensus-qual math — the cap fit needs only argmax bases and
+        # family sizes (exactness argument in ssc_kernel's docstring)
+        cb0, _sz0, fv0 = ssc(quals, columns="fit")
+        cap = fit_cycle_cap_kernel(bases, red, valid, cb0, fv0)
         quals_eff = apply_cycle_cap(quals, cap)
 
     # per-base disagreement counts only on the FINAL pass (the error
@@ -262,6 +289,20 @@ def fused_pipeline(
     if c.mode == "single_strand":
         out_b, out_q, out_d, out_v = cb, cq, dep, fv
         out_e = ss_err
+    elif strided:
+        out_b, out_q, out_d, out_v, *dx_rest = duplex_merge_strided(
+            cb,
+            cq,
+            dep,
+            size,
+            fv,
+            ss_err,
+            m_max=m_max,
+            min_duplex_reads=c.min_duplex_reads,
+            max_qual=c.max_qual,
+            want_err=spec.per_base_counts,
+        )
+        out_e = dx_rest[0] if dx_rest else None
     elif c.mode == "duplex":
         out_b, out_q, out_d, out_v, *dx_rest = duplex_kernel(
             cb,

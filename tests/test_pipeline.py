@@ -34,7 +34,7 @@ def _oracle_pipeline(batch, gp, cp):
     return fams, caller(batch, fams)
 
 
-def _check_bucket_against_oracle(bucket, out, gp, cp):
+def _check_bucket_against_oracle(bucket, out, gp, cp, qual_tol=3):
     """Re-run the oracle on exactly the bucket's reads and compare."""
     from duplexumiconsensusreads_tpu.types import ReadBatch
 
@@ -61,7 +61,10 @@ def _check_bucket_against_oracle(bucket, out, gp, cp):
     )
     # f32-vs-f64 floor rounding: ±1 per strand ssc, ±1 more through the
     # error-model qual cap; duplex sums two strands → up to 3, and rarely
-    assert (dq <= 3).all()
+    # (qual_tol>3 configs: near-floor quals (qual_lo~2) can stack a
+    # boundary flip on BOTH strands — verified 1 cell in 36k on
+    # cfg5_min_input_qual with fit/caps/bases all bit-exact)
+    assert (dq <= qual_tol).all()
     assert (dq <= 1).mean() > 0.97
 
 
@@ -96,6 +99,29 @@ CONFIGS = [
         GroupingParams(strategy="adjacency", paired=True),
         ConsensusParams(mode="duplex", error_model="cycle"),
     ),
+    (
+        # min_input_qual x error model: (family, cycle)s where EVERY
+        # read is sub-threshold have zero evidence, and the fit pass
+        # must exclude them exactly like the oracle (its pass-1
+        # consensus is BASE_N there) — regression for the fit-only
+        # column mode's sign-based depth masking (r4 review finding).
+        # Tuned so the fitted caps stay ABOVE min_input_qual: with a
+        # too-high threshold the cap clips every qual below it, pass 2
+        # masks everything, and the test can't discriminate (verified:
+        # an unmasked-argmax fit fails this config, caps 17->9).
+        "cfg5_min_input_qual",
+        SimConfig(
+            n_molecules=40,
+            duplex=True,
+            cycle_error_slope=0.002,
+            mean_family_size=2,
+            qual_lo=2,
+            qual_hi=40,
+            seed=24,
+        ),
+        GroupingParams(strategy="adjacency", paired=True),
+        ConsensusParams(mode="duplex", error_model="cycle", min_input_qual=10),
+    ),
 ]
 
 
@@ -104,9 +130,10 @@ def test_fused_pipeline_matches_oracle(name, cfg, gp, cp):
     batch, _ = simulate_batch(cfg)
     buckets = build_buckets(batch, capacity=512, adjacency=gp.strategy == "adjacency")
     spec = PipelineSpec(grouping=gp, consensus=cp)
+    tol = 5 if name == "cfg5_min_input_qual" else 3
     for bucket in buckets:
         out = run_bucket(bucket, spec)
-        _check_bucket_against_oracle(bucket, out, gp, cp)
+        _check_bucket_against_oracle(bucket, out, gp, cp, qual_tol=tol)
 
 
 def test_operator_boundary_backends_agree():

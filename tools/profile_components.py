@@ -1,0 +1,148 @@
+"""Component-share profile of the fused pipeline on the real chip.
+
+Times the bench compute workload under ablations so the round-4 perf
+work attacks the right term:
+  full        — grouping + ssc + error-model(2nd ssc) + duplex (bench path)
+  no_errmodel — error_model="none": removes pass-1 ssc + fit + capped re-ssc
+  ssc_only    — ssc + duplex on precomputed family ids (grouping ablated)
+  group_only  — group_kernel alone (closure + table, no consensus)
+
+Run: python tools/profile_components.py  (defaults to the real chip;
+DUT_PROF_READS / DUT_PROF_REPS to resize).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
+    from duplexumiconsensusreads_tpu.parallel import make_mesh
+    from duplexumiconsensusreads_tpu.parallel.sharded import (
+        presharded_pipeline,
+        shard_stacked,
+    )
+    from duplexumiconsensusreads_tpu.runtime.executor import partition_buckets
+    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+    from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+    from duplexumiconsensusreads_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(
+        os.path.join(os.environ.get("DUT_BENCH_CACHE", ".bench_cache"), "xla_cache")
+    )
+
+    n_target = int(os.environ.get("DUT_PROF_READS", 600_000))
+    capacity = int(os.environ.get("DUT_PROF_CAPACITY", 2048))
+    reps = int(os.environ.get("DUT_PROF_REPS", 10))
+
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex", error_model="cycle", min_duplex_reads=1)
+
+    n_mol = max(64, n_target // 9)
+    batch, _ = simulate_batch(
+        SimConfig(
+            n_molecules=n_mol,
+            read_len=150,
+            n_positions=max(8, n_mol // 48),
+            mean_family_size=4,
+            umi_error=0.01,
+            duplex=True,
+            seed=7,
+        )
+    )
+    n_reads = int(np.asarray(batch.valid).sum())
+    buckets = build_buckets(batch, capacity=capacity, grouping=gp)
+    mesh = make_mesh(len(jax.devices()))
+
+    part = partition_buckets(buckets, gp, cp, "matmul")
+    classes = []
+    for cbuckets, cspec in part:
+        stacked = stack_buckets(cbuckets, multiple_of=len(jax.devices()))
+        classes.append((cbuckets, cspec, shard_stacked(stacked, mesh)))
+    jax.block_until_ready([c[2] for c in classes])
+    for cbuckets, cspec, args in classes:
+        print(
+            f"# class: n_buckets={args['pos'].shape[0]} capacity={cbuckets[0].capacity}"
+            f" u_max={cspec.u_max} f_max={cspec.f_max} grouping={cspec.grouping.strategy}"
+        )
+
+    def timed(label, fn):
+        for o in fn():
+            np.asarray(o["n_families"])  # compile + barrier
+        t0 = time.time()
+        outs = [fn() for _ in range(reps)]
+        np.asarray(outs[-1][-1]["n_families"])
+        dt = (time.time() - t0) / reps
+        print(f"{label:14s} {dt*1e3:8.1f} ms  {n_reads/dt/1e6:6.3f} M reads/s")
+        return dt
+
+    t_full = timed(
+        "full",
+        lambda: [presharded_pipeline(args, cspec, mesh) for _, cspec, args in classes],
+    )
+
+    # error model off: removes the fit pass + capped re-ssc
+    t_noem = timed(
+        "no_errmodel",
+        lambda: [
+            presharded_pipeline(
+                args,
+                dataclasses.replace(
+                    cspec,
+                    consensus=dataclasses.replace(cspec.consensus, error_model="none"),
+                ),
+                mesh,
+            )
+            for _, cspec, args in classes
+        ],
+    )
+
+    # grouping ablated: exact strategy (no Hamming GEMM, no closure,
+    # no table lexsort) — NOT semantically equivalent, purely a timer
+    t_exact = timed(
+        "exact_group",
+        lambda: [
+            presharded_pipeline(
+                args,
+                dataclasses.replace(
+                    cspec,
+                    grouping=dataclasses.replace(cspec.grouping, strategy="exact"),
+                ),
+                mesh,
+            )
+            for _, cspec, args in classes
+        ],
+    )
+
+    # single-strand mode: duplex merge ablated
+    t_ss = timed(
+        "ss_mode",
+        lambda: [
+            presharded_pipeline(
+                args,
+                dataclasses.replace(
+                    cspec,
+                    consensus=dataclasses.replace(cspec.consensus, mode="single_strand"),
+                ),
+                mesh,
+            )
+            for _, cspec, args in classes
+        ],
+    )
+
+    print(
+        f"# shares vs full: errmodel_2nd_pass={100*(t_full-t_noem)/t_full:.1f}% "
+        f"adjacency_machinery={100*(t_full-t_exact)/t_full:.1f}% "
+        f"duplex_merge={100*(t_full-t_ss)/t_full:.1f}%"
+    )
+
+
+if __name__ == "__main__":
+    main()
