@@ -374,7 +374,25 @@ def _cmd_call(args) -> int:
             raise SystemExit("multi-host mode streams: pass --chunk-reads")
         import os as _os
 
-        from duplexumiconsensusreads_tpu.parallel.distributed import multihost_call
+        from duplexumiconsensusreads_tpu.parallel.distributed import (
+            init_distributed,
+            multihost_call,
+        )
+
+        # wire this process into the multi-controller runtime: explicit
+        # env vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+        # JAX_PROCESS_ID) or managed-platform auto-detection (cloud TPU
+        # pods, SLURM — auto=True runs the bare initialize() that
+        # performs it) — a no-op for single-process emulation runs
+        dist = init_distributed(auto=True)
+        if dist["num_processes"] > 1:
+            print(
+                f"[duplexumi] distributed runtime: process "
+                f"{dist['process_id']}/{dist['num_processes']}, "
+                f"{dist['local_devices']} local / "
+                f"{dist['global_devices']} global devices",
+                file=sys.stderr,
+            )
 
         # per-host output path: hosts share storage in a pod, so a
         # verbatim --output would have every host clobber the same

@@ -24,10 +24,19 @@ def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    auto: bool = False,
 ) -> dict:
     """Initialise jax.distributed from args or the standard env vars
-    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID —
-    cloud TPU pods auto-detect all three). No-op on single process.
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+    No-op on single process.
+
+    auto=True additionally attempts a bare jax.distributed.initialize()
+    when nothing is configured explicitly: on managed deployments
+    (cloud TPU pods, SLURM) initialize() auto-detects the cluster from
+    the platform environment, and that detection only runs INSIDE
+    initialize() — the guard below would otherwise skip it and leave
+    multi-host runs uncoordinated exactly where coordination matters
+    most. Falls back to single-process when no cluster is detected.
 
     Returns {"process_id", "num_processes", "local_devices",
     "global_devices"} for logging.
@@ -49,12 +58,57 @@ def init_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
+    elif auto and _coordination_state() is None:
+        try:
+            jax.distributed.initialize()
+        except (ValueError, RuntimeError):
+            pass  # no cluster environment detected: single process
+    # Identity comes from the COORDINATION runtime when one is up, not
+    # from the backend client: backends without cross-process device
+    # fabric (e.g. plain XLA-CPU) report process_count()==1 even though
+    # the processes are wired into one coordination service — which is
+    # all the input-partitioned executors need (each host's compute is
+    # local by design; coordination covers rendezvous + shared-file
+    # election + failure detection).
+    st = _coordination_state()
+    if st is not None and st.client is not None:
+        return {
+            "process_id": int(st.process_id),
+            "num_processes": int(st.num_processes),
+            "local_devices": len(jax.local_devices()),
+            "global_devices": len(jax.devices()),
+        }
     return {
         "process_id": jax.process_index(),
         "num_processes": jax.process_count(),
         "local_devices": len(jax.local_devices()),
         "global_devices": len(jax.devices()),
     }
+
+
+def _coordination_state():
+    """The live coordination-service state, or None. Reaches into
+    jax._src (no public accessor exists for the coordination client);
+    every use below degrades to a no-op if the layout ever changes."""
+    try:
+        from jax._src import distributed as _d
+
+        st = _d.global_state
+        if getattr(st, "coordinator_address", None) is None:
+            return None
+        return st
+    except Exception:
+        return None
+
+
+def coordination_barrier(name: str, timeout_ms: int = 600_000) -> bool:
+    """Rendezvous all processes at ``name`` via the coordination
+    service. Returns False (no-op) when not running distributed."""
+    st = _coordination_state()
+    if st is None or st.client is None or (st.num_processes or 1) <= 1:
+        return False
+    st.client.wait_at_barrier(name, timeout_ms)
+    return True
 
 
 def host_tile_range(
@@ -133,11 +187,25 @@ def multihost_call(
     from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
 
     idx_path = index_path or in_path + INDEX_SUFFIX
+    pid_eff = jax.process_index() if process_id is None else process_id
+    st = _coordination_state()
+    if st is not None and st.client is not None and (st.num_processes or 1) > 1:
+        pid_eff = int(st.process_id)
     if os.path.exists(idx_path):
+        index = BamLinearIndex.load(idx_path)
+    elif coordination_barrier("duplexumi:index:elect") and pid_eff != 0:
+        # index-build election: under a live coordination runtime only
+        # host 0 scans the input; everyone else waits at the done
+        # barrier and loads — concurrent hosts must never race
+        # building/writing the same index file on shared storage.
+        # The done-barrier timeout must outlast a sequential scan of a
+        # pod-scale input (hours, not the default 10 minutes).
+        coordination_barrier("duplexumi:index:done", timeout_ms=6 * 3600 * 1000)
         index = BamLinearIndex.load(idx_path)
     else:
         index = build_linear_index(in_path, every=index_every)
         index.save(idx_path)
+        coordination_barrier("duplexumi:index:done", timeout_ms=6 * 3600 * 1000)
     rng = host_input_range(index, process_id, num_processes)
     pid = jax.process_index() if process_id is None else process_id
     if rng is None:

@@ -30,13 +30,20 @@ def make_mesh(
     n_devices: int | None = None,
     axis: str = "data",
     cycle_shards: int = 1,
+    devices=None,
 ) -> Mesh:
     """A ('data',) mesh, or ('data', 'cycle') when cycle_shards > 1.
 
     n_devices counts TOTAL devices used; it must be divisible by
-    cycle_shards.
+    cycle_shards. ``devices`` overrides the device pool (default: all
+    of jax.devices()). Under an initialized multi-controller runtime
+    the INPUT-PARTITIONED executors must pass jax.local_devices():
+    each host streams a different input range, so its compiled programs
+    are host-local, and a global mesh would (a) be illegal
+    multi-controller SPMD (different programs per host) and (b) on a
+    non-zero host select another host's devices.
     """
-    devs = jax.devices()
+    devs = list(jax.devices() if devices is None else devices)
     n = n_devices or len(devs)
     if n > len(devs):
         raise ValueError(f"requested {n} devices, have {len(devs)}")

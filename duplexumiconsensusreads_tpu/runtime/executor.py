@@ -383,8 +383,11 @@ def call_batch_tpu(
             (z((0, batch.read_len), np.int32),) * 2 if per_base_tags else ()
         )
 
-    n_dev = n_devices or len(jax.devices())
-    mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
+    # local devices: the executors are host-local programs (each host
+    # streams its own input partition), so under an initialized
+    # multi-controller runtime the mesh must never span other hosts
+    n_dev = n_devices or len(jax.local_devices())
+    mesh = make_mesh(n_dev, cycle_shards=cycle_shards, devices=jax.local_devices())
     rep.n_devices = n_dev
     n_data = max(n_dev // max(cycle_shards, 1), 1)
 

@@ -767,8 +767,11 @@ def stream_call_consensus(
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
 
-    n_dev = n_devices or len(jax.devices())
-    mesh = make_mesh(n_dev, cycle_shards=cycle_shards)
+    # local devices: the executors are host-local programs (each host
+    # streams its own input partition), so under an initialized
+    # multi-controller runtime the mesh must never span other hosts
+    n_dev = n_devices or len(jax.local_devices())
+    mesh = make_mesh(n_dev, cycle_shards=cycle_shards, devices=jax.local_devices())
     n_data = max(n_dev // max(cycle_shards, 1), 1)
     rep.n_devices = n_dev
     header_out: BamHeader | None = None
