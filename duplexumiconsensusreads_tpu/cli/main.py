@@ -123,6 +123,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="chunks dispatched to the device ahead of scatter-back "
         "(default 4)",
     )
+    c.add_argument(
+        "--read-group-id",
+        default=None,
+        help="output consensus read group id (fgbio-style single @RG on "
+        "all consensus records; default A)",
+    )
+    c.add_argument(
+        "--write-index",
+        action="store_true",
+        default=None,
+        help="also write the standard .bai binning index beside the "
+        "output (output is always coordinate-sorted)",
+    )
 
     s = sub.add_parser("simulate", help="write a truth-aware synthetic BAM")
     s.add_argument("-o", "--output", required=True, help="output BAM path")
@@ -215,6 +228,13 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument(
         "--every", type=int, default=100_000, help="sampling stride in records"
     )
+    x.add_argument(
+        "--bai",
+        action="store_true",
+        help="write the STANDARD .bai binning index (SAM spec §5.2, "
+        "consumable by samtools/IGV/variant callers) instead of the "
+        "tool's own linear partitioning index",
+    )
 
     st = sub.add_parser(
         "stats",
@@ -280,7 +300,7 @@ def _load_config_file(path: str) -> dict:
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
         "min_input_qual", "capacity", "devices", "cycle_shards",
         "chunk_reads", "max_inflight", "config", "mate_aware", "max_reads",
-        "per_base_tags",
+        "per_base_tags", "read_group_id", "write_index",
     }
     unknown = set(conf) - allowed
     if unknown:
@@ -331,6 +351,18 @@ def _cmd_call(args) -> int:
     if max_reads < 0:
         raise SystemExit(f"--max-reads must be >= 0 (got {max_reads})")
     per_base_tags = bool(opt("per_base_tags", False))
+    read_group = str(opt("read_group_id", "A"))
+    # validate BEFORE the (expensive) run: a bad id would otherwise
+    # crash at record serialization or forge header fields (a tab in
+    # the id splices extra @RG columns)
+    if not read_group or not all(33 <= ord(ch) <= 126 for ch in read_group):
+        raise SystemExit(
+            f"--read-group-id must be non-empty printable ASCII without "
+            f"whitespace (got {read_group!r})"
+        )
+    write_index = bool(opt("write_index", False))
+    if write_index and not args.output.endswith(".bam"):
+        raise SystemExit("--write-index requires a .bam output path")
 
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour
@@ -426,6 +458,8 @@ def _cmd_call(args) -> int:
             mate_aware=mate_aware,
             max_reads=max_reads,
             per_base_tags=per_base_tags,
+            read_group=read_group,
+            write_index=write_index,
         )
         if rep is None:
             print("[duplexumi] host has no records in range; idle", file=sys.stderr)
@@ -453,6 +487,8 @@ def _cmd_call(args) -> int:
             mate_aware=mate_aware,
             max_reads=max_reads,
             per_base_tags=per_base_tags,
+            read_group=read_group,
+            write_index=write_index,
         )
     else:
         rep = call_consensus_file(
@@ -469,6 +505,8 @@ def _cmd_call(args) -> int:
             mate_aware=mate_aware,
             max_reads=max_reads,
             per_base_tags=per_base_tags,
+            read_group=read_group,
+            write_index=write_index,
         )
     pairs = f", {rep.n_consensus_pairs} R1+R2 pairs" if rep.mate_aware else ""
     print(
@@ -695,8 +733,12 @@ def _cmd_filter(args) -> int:
             raise ValueError(f"malformed aux stream: {e}") from e
         return None
 
+    from duplexumiconsensusreads_tpu.io.bam import derive_output_header
+
     reader = BamStreamReader(args.input)
-    header = reader.header
+    # record order is preserved, so the input SO stays truthful
+    # (sort_order=None); the run joins the @PG provenance chain with CL
+    header = derive_output_header(reader.header, sort_order=None)
     shell = serialize_bam(header, _empty_records())
     n_in = n_kept = n_masked = n_no_tag = n_no_cd = 0
     try:
@@ -909,6 +951,12 @@ def _cmd_stats(args) -> int:
 
 
 def _cmd_index(args) -> int:
+    if args.bai:
+        from duplexumiconsensusreads_tpu.io.bai import build_bai
+
+        out = build_bai(args.input, args.output)
+        print(f"[duplexumi] wrote standard BAI → {out}", file=sys.stderr)
+        return 0
     from duplexumiconsensusreads_tpu.io.index import INDEX_SUFFIX, build_linear_index
 
     out = args.output or args.input + INDEX_SUFFIX
@@ -1035,6 +1083,9 @@ def _cmd_group(args) -> int:
         if args.duplex:
             mi += "/A" if strand[i] else "/B"
         recs.aux_raw[i] = recs.aux_raw[i] + make_aux_z("MI", mi)
+    from duplexumiconsensusreads_tpu.io.bam import derive_output_header
+
+    header = derive_output_header(header, sort_order=None)
     write_bam(args.output, header, recs)
     summary = {
         "n_records": len(recs),

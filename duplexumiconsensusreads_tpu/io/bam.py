@@ -74,8 +74,13 @@ class BamHeader:
     ref_lengths: list[int]
 
     @staticmethod
-    def synthetic(ref_names=("chr1",), ref_lengths=(10_000_000,), extra: str = ""):
-        lines = ["@HD\tVN:1.6\tSO:unsorted"]
+    def synthetic(
+        ref_names=("chr1",),
+        ref_lengths=(10_000_000,),
+        extra: str = "",
+        sort_order: str = "unsorted",
+    ):
+        lines = [f"@HD\tVN:1.6\tSO:{sort_order}"]
         for n, l in zip(ref_names, ref_lengths):
             lines.append(f"@SQ\tSN:{n}\tLN:{l}")
         lines.append("@PG\tID:duplexumi\tPN:duplexumiconsensusreads_tpu")
@@ -86,6 +91,86 @@ class BamHeader:
             ref_names=list(ref_names),
             ref_lengths=list(ref_lengths),
         )
+
+
+def set_sort_order(text: str, so: str) -> str:
+    """Rewrite (or insert) the @HD line's SO: field."""
+    lines = text.rstrip("\n").split("\n") if text.strip() else []
+    for i, line in enumerate(lines):
+        if line.startswith("@HD"):
+            fields = [f for f in line.split("\t") if not f.startswith("SO:")]
+            lines[i] = "\t".join(fields + [f"SO:{so}"])
+            break
+    else:
+        lines.insert(0, f"@HD\tVN:1.6\tSO:{so}")
+    return "\n".join(lines) + "\n"
+
+
+def chain_pg(text: str, pn: str = "duplexumiconsensusreads_tpu", cl: str | None = None) -> str:
+    """Append a new @PG entry chained (PP:) to the last program in the
+    existing chain, with a collision-free ID — real pipelines key
+    provenance on the @PG chain, so reruns must never clobber it."""
+    lines = text.rstrip("\n").split("\n") if text.strip() else []
+    ids, last_id = set(), None
+    for line in lines:
+        if line.startswith("@PG"):
+            for f in line.split("\t")[1:]:
+                if f.startswith("ID:"):
+                    ids.add(f[3:])
+                    last_id = f[3:]
+    new_id, k = "duplexumi", 0
+    while new_id in ids:
+        k += 1
+        new_id = f"duplexumi.{k}"
+    entry = f"@PG\tID:{new_id}\tPN:{pn}"
+    if last_id is not None:
+        entry += f"\tPP:{last_id}"
+    if cl:
+        entry += "\tCL:" + cl.replace("\t", " ").replace("\n", " ")
+    lines.append(entry)
+    return "\n".join(lines) + "\n"
+
+
+def add_read_group(text: str, rg_id: str, sample: str | None = None) -> str:
+    """Append a consensus @RG line (fgbio-style: one NEW output read
+    group; input @RG lines are preserved above it for provenance). The
+    sample defaults to the union of input SM values, else the rg id."""
+    lines = text.rstrip("\n").split("\n") if text.strip() else []
+    sms = []
+    for line in lines:
+        if line.startswith("@RG"):
+            for f in line.split("\t")[1:]:
+                if f.startswith("ID:") and f[3:] == rg_id:
+                    return "\n".join(lines) + "\n"  # already present
+                if f.startswith("SM:") and f[3:] not in sms:
+                    sms.append(f[3:])
+    sm = sample or (",".join(sms) if sms else rg_id)
+    lines.append(f"@RG\tID:{rg_id}\tSM:{sm}")
+    return "\n".join(lines) + "\n"
+
+
+def derive_output_header(
+    header: "BamHeader",
+    sort_order: str | None = "coordinate",
+    rg_id: str | None = None,
+    cl: str | None = None,
+) -> "BamHeader":
+    """The consensus-output header: input text preserved verbatim
+    (@SQ/@RG/@CO and the existing @PG chain survive), @HD SO: set to
+    the true output order, a new @PG chained, and optionally the
+    consensus @RG appended. cl defaults to this process's command line
+    (what the @PG CL: field records by convention)."""
+    import sys as _sys
+
+    text = header.text
+    if sort_order:
+        text = set_sort_order(text, sort_order)
+    text = chain_pg(text, cl=cl if cl is not None else " ".join(_sys.argv))
+    if rg_id:
+        text = add_read_group(text, rg_id)
+    return BamHeader(
+        text=text, ref_names=header.ref_names, ref_lengths=header.ref_lengths
+    )
 
 
 @dataclasses.dataclass

@@ -566,6 +566,8 @@ def consensus_to_records(
     paired_out: bool = False,
     cons_pdepth: np.ndarray | None = None,  # (F, L) per-base depth -> cd:B,I
     cons_perr: np.ndarray | None = None,  # (F, L) per-base errors -> ce:B,I
+    read_group: str | None = None,  # RG:Z on every record (fgbio-style
+    # single consensus read group; the header gains the matching @RG)
 ) -> BamRecords:
     """Build consensus BAM records from (scattered-back) pipeline output.
 
@@ -667,6 +669,9 @@ def consensus_to_records(
     pd_rows = None if cons_pdepth is None else _pb_rows(b"cd", cons_pdepth)
     pe_rows = None if cons_perr is None else _pb_rows(b"ce", cons_perr)
     names, aux = [], []
+    rg_bytes = (
+        b"RGZ" + read_group.encode("ascii") + b"\x00" if read_group else b""
+    )
     rid_l, pos_l, idx_l = ref_id.tolist(), pos.tolist(), idx.tolist()
     gid_l = pair_gid.tolist()
     for k in range(n):
@@ -689,6 +694,7 @@ def consensus_to_records(
             + cd_bytes[4 * k : 4 * k + 4]
             + b"cMi"
             + cm_bytes[4 * k : 4 * k + 4]
+            + rg_bytes
             + (pd_rows[k] if pd_rows is not None else b"")
             + (pe_rows[k] if pe_rows is not None else b"")
         )
@@ -738,7 +744,9 @@ def simulated_bam(
                 None if truth.read_end2 is None else truth.read_end2[order]
             ),
         )
-    header = BamHeader.synthetic()
+    header = BamHeader.synthetic(
+        sort_order="coordinate" if sort else "unsorted"
+    )
     # true mate pairs only exist in BAM form as paired-end records
     recs = readbatch_to_records(
         batch, duplex=cfg.duplex, paired_end=paired_end or cfg.paired_reads

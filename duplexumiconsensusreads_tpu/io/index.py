@@ -50,8 +50,14 @@ class BamLinearIndex:
 
     def save(self, path: str) -> None:
         # file handle, not path: savez would append ".npz" to the
-        # conventional ".dlix" suffix and break exists()/load() lookups
-        with open(path, "wb") as f:
+        # conventional ".dlix" suffix and break exists()/load() lookups.
+        # tmp + atomic replace: concurrent hosts on shared storage must
+        # never observe (or interleave into) a torn index — a reader
+        # whose exists() check lands mid-write would load a corrupt npz
+        import os as _os
+
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             np.savez_compressed(
                 f,
                 magic=_MAGIC,
@@ -61,6 +67,7 @@ class BamLinearIndex:
                 every=self.every,
                 n_records=self.n_records,
             )
+        _os.replace(tmp, path)
 
     @staticmethod
     def load(path: str) -> "BamLinearIndex":

@@ -189,23 +189,32 @@ def multihost_call(
     idx_path = index_path or in_path + INDEX_SUFFIX
     pid_eff = jax.process_index() if process_id is None else process_id
     st = _coordination_state()
-    if st is not None and st.client is not None and (st.num_processes or 1) > 1:
+    coordinated = (
+        st is not None and st.client is not None and (st.num_processes or 1) > 1
+    )
+    if coordinated:
         pid_eff = int(st.process_id)
-    if os.path.exists(idx_path):
-        index = BamLinearIndex.load(idx_path)
-    elif coordination_barrier("duplexumi:index:elect") and pid_eff != 0:
-        # index-build election: under a live coordination runtime only
-        # host 0 scans the input; everyone else waits at the done
-        # barrier and loads — concurrent hosts must never race
-        # building/writing the same index file on shared storage.
-        # The done-barrier timeout must outlast a sequential scan of a
-        # pod-scale input (hours, not the default 10 minutes).
+    if coordinated:
+        # Index-build election under a live coordination runtime: EVERY
+        # host passes BOTH barriers unconditionally — the exists() check
+        # happens only inside host 0's critical section. Hosts must
+        # never branch on their own exists() view before a barrier (NFS
+        # attribute caches can disagree across hosts, and a host that
+        # skipped a barrier would deadlock the rest), and only host 0
+        # ever writes, so concurrent builds of the same index file
+        # cannot race on shared storage. The done-barrier timeout must
+        # outlast a sequential scan of a pod-scale input (hours, not
+        # the default 10 minutes).
+        coordination_barrier("duplexumi:index:elect")
+        if pid_eff == 0 and not os.path.exists(idx_path):
+            build_linear_index(in_path, every=index_every).save(idx_path)
         coordination_barrier("duplexumi:index:done", timeout_ms=6 * 3600 * 1000)
+        index = BamLinearIndex.load(idx_path)
+    elif os.path.exists(idx_path):
         index = BamLinearIndex.load(idx_path)
     else:
         index = build_linear_index(in_path, every=index_every)
         index.save(idx_path)
-        coordination_barrier("duplexumi:index:done", timeout_ms=6 * 3600 * 1000)
     rng = host_input_range(index, process_id, num_processes)
     pid = jax.process_index() if process_id is None else process_id
     if rng is None:

@@ -563,13 +563,21 @@ def call_consensus_file(
     mate_aware: str = "auto",
     max_reads: int = 0,
     per_base_tags: bool = False,
+    read_group: str = "A",
+    write_index: bool = False,
 ) -> RunReport:
-    """End-to-end: read BAM/npz → consensus → write consensus BAM."""
+    """End-to-end: read BAM/npz → consensus → write consensus BAM.
+
+    Output is coordinate-sorted by construction (records emit in dense
+    family-id order == ascending (pos_key, UMI)) and the header says so;
+    write_index=True additionally writes the standard .bai beside it.
+    """
     from duplexumiconsensusreads_tpu.io import (
         consensus_to_records,
         load_input,
         write_bam,
     )
+    from duplexumiconsensusreads_tpu.io.bam import derive_output_header
 
     rep = RunReport(backend=backend)
     duplex = consensus.mode == "duplex"
@@ -627,8 +635,16 @@ def call_consensus_file(
         cons_mate=mate, cons_pair=pair, paired_out=grouping.mate_aware,
         cons_pdepth=rest[0] if rest else None,
         cons_perr=rest[1] if rest else None,
+        read_group=read_group,
     )
-    write_bam(out_path, header, out_recs)
+    header_out = derive_output_header(
+        header, sort_order="coordinate", rg_id=read_group
+    )
+    write_bam(out_path, header_out, out_recs)
+    if write_index:
+        from duplexumiconsensusreads_tpu.io.bai import build_bai
+
+        build_bai(out_path)
     rep.n_consensus = len(out_recs)
     rep.n_consensus_pairs = count_consensus_pairs(out_recs)
     rep.seconds["write_output"] = round(time.time() - t0, 4)

@@ -618,6 +618,7 @@ class Checkpoint:
 def _fingerprint(
     in_path: str, grouping, consensus, capacity, chunk_reads, input_range=None,
     mate_aware: str = "auto", max_reads: int = 0, per_base_tags: bool = False,
+    read_group: str = "A",
 ) -> str:
     """The mate_aware SETTING (auto/on/off) joins the key rather than
     the resolved boolean: resolution is a deterministic function of the
@@ -637,6 +638,7 @@ def _fingerprint(
             mate_aware,
             max_reads,
             per_base_tags,
+            read_group,
             [list(x) if isinstance(x, tuple) else x for x in (input_range or [])],
             # range-mode chunk boundaries differ between the native and
             # Python iterators (the fallback ignores the seek and
@@ -684,6 +686,10 @@ def stream_call_consensus(
     # io.convert.downsample_families
     per_base_tags: bool = False,  # emit cd:B,I per-base depth arrays
     # (fetches the (F, L) depth matrix off-device — costs transfer)
+    read_group: str = "A",  # consensus @RG id (fgbio-style single
+    # output read group); joins the checkpoint fingerprint — it changes
+    # record bytes
+    write_index: bool = False,  # write the standard .bai after finalise
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -735,7 +741,7 @@ def stream_call_consensus(
         fp = _fingerprint(
             in_path, grouping, consensus, capacity, chunk_reads, input_range,
             mate_aware=mate_aware, max_reads=max_reads,
-            per_base_tags=per_base_tags,
+            per_base_tags=per_base_tags, read_group=read_group,
         )
         ckpt = Checkpoint.load_or_create(checkpoint_path, fp)
         if not resume:
@@ -904,7 +910,7 @@ def stream_call_consensus(
         t0 = time.time()
         shard = _finish_chunk(
             k, parts, duplex, shard_dir, serialize_bam, header_out, name_tag,
-            paired_out=grouping.mate_aware,
+            paired_out=grouping.mate_aware, read_group=read_group,
         )
         phase["shard_write"] += time.time() - t0
         shards[k] = shard
@@ -1001,6 +1007,14 @@ def stream_call_consensus(
         header_out = _r.header
         _r.close()
     t_fin = time.time()
+    from duplexumiconsensusreads_tpu.io.bam import derive_output_header
+
+    # chunks sort by (pos, UMI) and chunk boundaries are genomic-order
+    # (coordinate-sorted input contract), so the concatenation is
+    # coordinate-sorted end to end — say so, chain @PG, add the @RG
+    header_out = derive_output_header(
+        header_out, sort_order="coordinate", rg_id=read_group
+    )
     shell = serialize_bam(header_out, _empty_records())
     with open(out_path, "wb") as f:
         f.write(bgzf.compress_fast(shell, eof=False))
@@ -1031,6 +1045,10 @@ def stream_call_consensus(
             os.remove(checkpoint_path)
         except OSError:
             pass
+    if write_index:
+        from duplexumiconsensusreads_tpu.io.bai import build_bai
+
+        build_bai(out_path)
     phase["finalise"] = time.time() - t_fin
     rep.n_chunks_skipped = n_skipped
     rep.n_pipeline_compiles = len(spec_cache)
@@ -1097,7 +1115,7 @@ def _count_records(data: bytes) -> tuple[int, int]:
 
 def _finish_chunk(
     k, parts, duplex, shard_dir, serialize_bam, header, name_tag="",
-    paired_out=False,
+    paired_out=False, read_group="A",
 ) -> str:
     """Merge one chunk's per-class scattered outputs and write its
     shard. parts rows are 7-tuples (9 with per-base tags: cols[7] the
@@ -1119,6 +1137,7 @@ def _finish_chunk(
         paired_out=paired_out,
         cons_pdepth=cols[7] if len(cols) > 7 else None,
         cons_perr=cols[8] if len(cols) > 8 else None,
+        read_group=read_group,
     )
     # record stream only (header stripped) so shards concatenate
     full = serialize_bam(header, recs)

@@ -48,7 +48,10 @@ def simulate_bam_file(
             "position space overflow: lower n_positions or chunk count "
             f"({n_chunks} chunks x stride {stride} exceeds int32 coordinates)"
         )
-    header = BamHeader.synthetic(ref_lengths=(min(stride * n_chunks + 1000, (1 << 31) - 1),))
+    header = BamHeader.synthetic(
+        ref_lengths=(min(stride * n_chunks + 1000, (1 << 31) - 1),),
+        sort_order="coordinate",  # chunks emit in ascending position
+    )
     shell = serialize_bam(header, _empty())
     n_reads = 0
     done = 0

@@ -1,0 +1,276 @@
+"""Standard BAI index + output-header conformance (VERDICT r3 missing
+#1/#2): coordinate-sorted SO, spec §5.2 bin/chunk/linear structure on a
+multi-reference file, voffsets that truly address records, header
+provenance (@RG/@CO preserved, @PG chained), and the consensus @RG."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu.cli import main
+from duplexumiconsensusreads_tpu.io import bgzf, read_bam
+from duplexumiconsensusreads_tpu.io.bai import METADATA_BIN, build_bai, read_bai
+from duplexumiconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamRecords,
+    _reg2bin,
+    write_bam,
+)
+
+
+def _multi_ref_bam(path, n_per_ref=40, n_ref=3, seed=5):
+    """Coordinate-sorted BAM spanning several references, positions
+    spread so records cross multiple 16 kb linear windows and several
+    bin levels."""
+    rng = np.random.default_rng(seed)
+    names, flags, rid, pos, ln = [], [], [], [], []
+    n = n_per_ref * n_ref
+    L = 24
+    for r in range(n_ref):
+        p = np.sort(rng.integers(0, 300_000, n_per_ref))
+        for k, pp in enumerate(p.tolist()):
+            names.append(f"r{r}_{k}")
+            flags.append(0)
+            rid.append(r)
+            pos.append(pp)
+            ln.append(L)
+    seq = rng.integers(0, 4, (n, L)).astype(np.uint8)
+    qual = np.full((n, L), 30, np.uint8)
+    recs = BamRecords(
+        names=names,
+        flags=np.array(flags, np.uint16),
+        ref_id=np.array(rid, np.int32),
+        pos=np.array(pos, np.int32),
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.full(n, -1, np.int32),
+        next_pos=np.full(n, -1, np.int32),
+        tlen=np.zeros(n, np.int32),
+        lengths=np.full(n, L, np.int32),
+        seq=seq,
+        qual=qual,
+        cigars=[[(L, "M")] for _ in range(n)],
+        umi=[""] * n,
+        aux_raw=[b"RXZACGTAA\x00" for _ in range(n)],
+    )
+    header = BamHeader.synthetic(
+        ref_names=tuple(f"chr{r+1}" for r in range(n_ref)),
+        ref_lengths=(1_000_000,) * n_ref,
+        sort_order="coordinate",
+    )
+    write_bam(path, header, recs)
+    return recs
+
+
+def _record_at_voffset(path, v):
+    """Decompress the BGZF block a virtual offset points into and parse
+    the record there — proves the BAI's voffsets address real records."""
+    coff, uoff = v >> 16, v & 0xFFFF
+    with open(path, "rb") as f:
+        data = f.read()
+    size = bgzf.read_block_size(data, coff)
+    payload = bytearray(bgzf.decompress_block(data, coff, size))
+    # a record may span into following blocks; extend as needed
+    (bsz,) = struct.unpack_from("<i", payload, uoff)
+    nxt = coff + size
+    while uoff + 4 + bsz > len(payload):
+        size = bgzf.read_block_size(data, nxt)
+        payload += bgzf.decompress_block(data, nxt, size)
+        nxt += size
+    ref_id, pos = struct.unpack_from("<ii", payload, uoff + 4)
+    return ref_id, pos
+
+
+def test_bai_structure_multi_ref(tmp_path):
+    path = str(tmp_path / "mr.bam")
+    recs = _multi_ref_bam(path)
+    bai_path = build_bai(path)
+    idx = read_bai(bai_path)
+    assert idx["n_ref"] == 3
+    assert idx["n_no_coor"] == 0
+
+    L = 24
+    for r in range(3):
+        ref = idx["refs"][r]
+        sel = np.asarray(recs.ref_id) == r
+        n_rec = int(sel.sum())
+        # metadata pseudo-bin counts
+        assert ref["meta"] is not None
+        off_beg, off_end, n_mapped, n_unmapped = ref["meta"]
+        assert (n_mapped, n_unmapped) == (n_rec, 0)
+        assert off_beg < off_end
+        # every record's bin exists and some chunk of exactly that bin
+        # covers a voffset range inside the ref's file span
+        total_chunks = 0
+        for pp in np.asarray(recs.pos)[sel].tolist():
+            b = _reg2bin(pp, pp + L)
+            assert b in ref["bins"], f"ref {r} pos {pp}: bin {b} missing"
+        for b, chunks in ref["bins"].items():
+            total_chunks += len(chunks)
+            for beg_v, end_v in chunks:
+                assert off_beg <= beg_v < end_v <= off_end
+                # the chunk's first voffset addresses a real record of
+                # this ref whose reg2bin is exactly this bin
+                rid_at, pos_at = _record_at_voffset(path, beg_v)
+                assert rid_at == r
+                assert _reg2bin(pos_at, pos_at + L) == b
+        assert total_chunks >= 1
+        # linear index: monotone coverage — for every record the window
+        # entry exists, is nonzero, and does not point past the record
+        lin = ref["linear"]
+        pos_r = np.asarray(recs.pos)[sel]
+        for pp in pos_r.tolist():
+            w = pp >> 14
+            assert w < len(lin)
+            assert lin[w] != 0
+            assert lin[w] <= off_end
+        # backfilled: no zero holes after the first nonzero entry
+        nz = [i for i, v in enumerate(lin) if v]
+        if nz:
+            assert all(lin[i] != 0 for i in range(nz[0], len(lin)))
+
+
+def test_bai_clamps_positionless_placed_records(tmp_path):
+    """Spec-legal ref_id>=0, pos=-1 records (placed but positionless)
+    must clamp to window 0, matching the serializers' own bin math —
+    not crash or poison the last linear window (r4 review finding)."""
+    path = str(tmp_path / "pm1.bam")
+    recs = _multi_ref_bam(path, n_per_ref=5, n_ref=1)
+    recs.pos[0] = -1
+    recs.flags[0] = 4  # unmapped-with-coordinate, as aligners emit them
+    header = BamHeader.synthetic(
+        ref_names=("chr1",), ref_lengths=(1_000_000,), sort_order="coordinate"
+    )
+    write_bam(path, header, recs)
+    idx = read_bai(build_bai(path))
+    ref = idx["refs"][0]
+    assert ref["meta"][2] == 4 and ref["meta"][3] == 1  # 4 mapped + 1 unmapped
+    assert ref["linear"][0] != 0  # clamped into window 0
+
+
+def test_bai_rejects_unsorted(tmp_path):
+    path = str(tmp_path / "uns.bam")
+    recs = _multi_ref_bam(path)
+    # swap two records out of order and rewrite
+    order = np.arange(len(recs.names))
+    order[0], order[5] = order[5], order[0]
+    recs2 = BamRecords(
+        names=[recs.names[i] for i in order],
+        flags=recs.flags[order],
+        ref_id=recs.ref_id[order],
+        pos=recs.pos[order],
+        mapq=recs.mapq[order],
+        next_ref_id=recs.next_ref_id[order],
+        next_pos=recs.next_pos[order],
+        tlen=recs.tlen[order],
+        lengths=recs.lengths[order],
+        seq=recs.seq[order],
+        qual=recs.qual[order],
+        cigars=[recs.cigars[i] for i in order],
+        umi=[recs.umi[i] for i in order],
+        aux_raw=[recs.aux_raw[i] for i in order],
+    )
+    header = BamHeader.synthetic(
+        ref_names=("chr1", "chr2", "chr3"), ref_lengths=(1_000_000,) * 3
+    )
+    write_bam(path, header, recs2)
+    with pytest.raises(ValueError, match="not coordinate-sorted"):
+        build_bai(path)
+
+
+def _sim_with_provenance(tmp_path):
+    """Simulated sorted input with @RG/@CO lines and RG tags grafted in
+    — the provenance a real pipeline BAM carries."""
+    bam = str(tmp_path / "in.bam")
+    assert main([
+        "simulate", "-o", bam, "--molecules", "60", "--read-len", "40",
+        "--positions", "6", "--umi-error", "0.02", "--seed", "17", "--sorted",
+    ]) == 0
+    h, recs = read_bam(bam)
+    lines = h.text.rstrip("\n").splitlines()
+    lines.insert(1, "@RG\tID:rg1\tSM:sampleA")
+    lines.insert(2, "@RG\tID:rg2\tSM:sampleB")
+    lines.append("@CO\tprovenance comment")
+    h2 = BamHeader(
+        text="\n".join(lines) + "\n",
+        ref_names=h.ref_names,
+        ref_lengths=h.ref_lengths,
+    )
+    for i in range(len(recs)):
+        rg = b"rg1" if i % 2 else b"rg2"
+        recs.aux_raw[i] = recs.aux_raw[i] + b"RGZ" + rg + b"\x00"
+    write_bam(bam, h2, recs)
+    return bam
+
+
+@pytest.mark.parametrize("mode", ["whole", "stream"])
+def test_output_header_and_read_group(tmp_path, mode):
+    """call output: SO:coordinate, input @RG/@CO/@PG preserved, a new
+    @PG chained with PP:, the consensus @RG appended, RG:Z on every
+    record — in both the whole-file and streamed paths."""
+    bam = _sim_with_provenance(tmp_path)
+    out = str(tmp_path / "cons.bam")
+    extra = ["--chunk-reads", "120"] if mode == "stream" else []
+    assert main([
+        "call", bam, "-o", out, "--config", "config3", "--capacity", "256",
+        "--write-index", *extra,
+    ]) == 0
+    h, recs = read_bam(out)
+    text = h.text
+    assert "SO:coordinate" in text.splitlines()[0]
+    assert "@RG\tID:rg1\tSM:sampleA" in text
+    assert "@RG\tID:rg2\tSM:sampleB" in text
+    assert "@CO\tprovenance comment" in text
+    # the input's own @PG survives and the new one chains to it
+    pg_lines = [l for l in text.splitlines() if l.startswith("@PG")]
+    assert any("ID:duplexumi\t" in l or l.endswith("ID:duplexumi") for l in pg_lines)
+    new_pg = [l for l in pg_lines if "PP:" in l]
+    assert len(new_pg) == 1
+    assert "PP:duplexumi" in new_pg[0]  # chained to the simulate @PG
+    # consensus @RG with SM union of input samples
+    rg_lines = [l for l in text.splitlines() if l.startswith("@RG")]
+    assert any("ID:A" in l and "sampleA" in l and "sampleB" in l for l in rg_lines)
+    assert len(recs) > 0
+    assert all(b"RGZA\x00" in a for a in recs.aux_raw)
+    # records really are coordinate-sorted and the .bai stands up
+    key = np.asarray(recs.ref_id).astype(np.int64) << 32 | np.asarray(recs.pos)
+    assert (np.diff(key) >= 0).all()
+    idx = read_bai(out + ".bai")
+    n_indexed = sum(
+        (r["meta"][2] + r["meta"][3]) for r in idx["refs"] if r["meta"]
+    )
+    assert n_indexed == len(recs)
+
+
+def test_custom_read_group_id(tmp_path):
+    bam = _sim_with_provenance(tmp_path)
+    out = str(tmp_path / "cons.bam")
+    assert main([
+        "call", bam, "-o", out, "--config", "config3", "--capacity", "256",
+        "--read-group-id", "ctdna1",
+    ]) == 0
+    h, recs = read_bam(out)
+    assert any(
+        l.startswith("@RG") and "ID:ctdna1" in l for l in h.text.splitlines()
+    )
+    assert all(b"RGZctdna1\x00" in a for a in recs.aux_raw)
+
+
+def test_filter_and_group_chain_pg(tmp_path):
+    bam = _sim_with_provenance(tmp_path)
+    out = str(tmp_path / "cons.bam")
+    assert main([
+        "call", bam, "-o", out, "--config", "config3", "--capacity", "256",
+    ]) == 0
+    n_pg = len([l for l in read_bam(out)[0].text.splitlines() if l.startswith("@PG")])
+    filt = str(tmp_path / "filt.bam")
+    assert main(["filter", out, "-o", filt, "--min-depth", "1"]) == 0
+    h_f = read_bam(filt)[0]
+    pg_f = [l for l in h_f.text.splitlines() if l.startswith("@PG")]
+    assert len(pg_f) == n_pg + 1
+    # collision-free id + chained to the call run's entry
+    assert any("ID:duplexumi.1" in l or "ID:duplexumi.2" in l for l in pg_f)
+    grp = str(tmp_path / "grp.bam")
+    assert main(["group", bam, "-o", grp, "--duplex"]) == 0
+    pg_g = [l for l in read_bam(grp)[0].text.splitlines() if l.startswith("@PG")]
+    assert any("PP:" in l for l in pg_g)
