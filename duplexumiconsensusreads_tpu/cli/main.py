@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--mode", choices=["ss", "duplex"], default=None)
     c.add_argument("--error-model", choices=["none", "cycle"], default=None)
     c.add_argument("--max-hamming", type=int, default=None)
+    c.add_argument(
+        "--count-ratio", type=int, default=None,
+        help="directional adjacency edge condition "
+        "count(a) >= ratio*count(b)-1 (UMI-tools default 2)",
+    )
     c.add_argument("--min-reads", type=int, default=None)
     c.add_argument("--min-duplex-reads", type=int, default=None)
     c.add_argument("--max-qual", type=int, default=None)
@@ -267,6 +272,20 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("-o", "--output", required=True, help="annotated BAM")
     g.add_argument("--grouping", choices=["exact", "adjacency"], default="adjacency")
     g.add_argument("--max-hamming", type=int, default=1)
+    g.add_argument(
+        "--count-ratio", type=int, default=2,
+        help="directional edge condition count(a) >= ratio*count(b)-1 "
+        "(same knob as call; UMI-tools default 2)",
+    )
+    g.add_argument(
+        "--mate-aware",
+        choices=["auto", "on", "off"],
+        default="auto",
+        help="the SAME mate handling as call: with it on, MI carries "
+        "the source molecule (a template's R1 and R2 share MI), exactly "
+        "the molecule structure call --mate-aware consumes. auto turns "
+        "it on when the input mixes R1/R2 mates",
+    )
     g.add_argument("--backend", choices=["tpu", "cpu"], default="tpu")
     g.add_argument(
         "--duplex",
@@ -300,7 +319,7 @@ def _load_config_file(path: str) -> dict:
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
         "min_input_qual", "capacity", "devices", "cycle_shards",
         "chunk_reads", "max_inflight", "config", "mate_aware", "max_reads",
-        "per_base_tags", "read_group_id", "write_index",
+        "per_base_tags", "read_group_id", "write_index", "count_ratio",
     }
     unknown = set(conf) - allowed
     if unknown:
@@ -388,6 +407,7 @@ def _cmd_call(args) -> int:
     gp = GroupingParams(
         strategy=grouping,
         max_hamming=opt("max_hamming", 1),
+        count_ratio=opt("count_ratio", 2),
         paired=(mode == "duplex"),
     )
     cp = ConsensusParams(
@@ -1024,19 +1044,29 @@ def _cmd_group(args) -> int:
     enable_compile_cache()
     header, recs = read_bam(args.input)
     batch, info = records_to_readbatch(recs, duplex=args.duplex)
+    from duplexumiconsensusreads_tpu.runtime.executor import resolve_mate_aware
+
     gp = GroupingParams(
         strategy=args.grouping,
         max_hamming=args.max_hamming,
+        count_ratio=args.count_ratio,
         paired=args.duplex,
     )
+    # the SAME auto-detection as call: MI annotations must reproduce the
+    # molecule structure call actually consensuses on the same flags
+    gp = resolve_mate_aware(gp, info, args.mate_aware)
+    # MI carries the SOURCE MOLECULE: under mate-aware grouping that is
+    # pair_id (a template's R1 and R2 units share it); otherwise it is
+    # molecule_id (the two are equal without mate awareness)
     n = len(recs)
     mol = np.full(n, -1, np.int64)
     n_mol_total = n_fam_total = 0
     counters: dict = {}
     if args.backend == "cpu":
         fams = group_reads(batch, gp)
-        mol[:] = np.asarray(fams.molecule_id)
-        n_mol_total = int(fams.n_molecules)
+        src = np.asarray(fams.pair_id if gp.mate_aware else fams.molecule_id)
+        mol[:] = src
+        n_mol_total = int(src.max()) + 1 if (src >= 0).any() else 0
         n_fam_total = int(fams.n_families)
     else:
         from duplexumiconsensusreads_tpu.bucketing.buckets import _pow2
@@ -1046,11 +1076,13 @@ def _cmd_group(args) -> int:
             batch, capacity=args.capacity, grouping=gp, counters=counters
         ):
             strategy = "exact" if bk.preclustered else gp.strategy
-            _, mids, _, n_fam, n_mol, n_over = group_kernel(
+            _, mids, pairs, n_fam, n_mol, n_over = group_kernel(
                 bk.pos, bk.umi, bk.strand_ab, bk.frag_end, bk.valid,
                 strategy=strategy,
                 max_hamming=gp.max_hamming,
+                count_ratio=gp.count_ratio,
                 paired=gp.paired,
+                mate_aware=gp.mate_aware,
                 u_max=min(_pow2(max(bk.n_unique_umi, 1)), bk.capacity),
                 presorted=True,
             )
@@ -1064,9 +1096,14 @@ def _cmd_group(args) -> int:
                     f"bucket (capacity {bk.capacity}); this is a bug in "
                     f"bucket sizing — please report"
                 )
-            sel = (bk.read_index >= 0) & bk.valid & (mids >= 0)
-            mol[bk.read_index[sel]] = mids[sel] + n_mol_total
-            n_mol_total += int(n_mol)
+            ids = np.asarray(pairs) if gp.mate_aware else mids
+            sel = (bk.read_index >= 0) & bk.valid & (ids >= 0) & (mids >= 0)
+            # bucket-local dense renumber of the chosen id space (pair
+            # ids are dense molecule ranks, but their count is not a
+            # kernel output — derive it from the bucket's own values)
+            uniq, inv = np.unique(ids[sel], return_inverse=True)
+            mol[bk.read_index[sel]] = inv + n_mol_total
+            n_mol_total += len(uniq)
             n_fam_total += int(n_fam)
     valid = np.asarray(batch.valid, bool)
     strand = np.asarray(batch.strand_ab, bool)
@@ -1094,6 +1131,7 @@ def _cmd_group(args) -> int:
         "n_families": n_fam_total,
         "grouping": args.grouping,
         "backend": args.backend,
+        "mate_aware": gp.mate_aware,
     }
     nonzero = {k: v for k, v in counters.items() if v}
     if nonzero:

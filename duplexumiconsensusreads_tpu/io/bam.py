@@ -131,6 +131,26 @@ def chain_pg(text: str, pn: str = "duplexumiconsensusreads_tpu", cl: str | None 
     return "\n".join(lines) + "\n"
 
 
+def unique_read_group_id(text: str, rg_id: str) -> str:
+    """Collision-free consensus read-group id: if the input header
+    already carries @RG ID:<rg_id> (e.g. an fgbio-produced input whose
+    consensus group is also 'A'), attributing our consensus records to
+    that EXISTING group would silently inherit its SM/LB/PL — so
+    uniquify the same way chain_pg does for @PG IDs. Must be resolved
+    BEFORE records are built (the RG:Z tags must match the final id)."""
+    ids = set()
+    for line in text.split("\n"):
+        if line.startswith("@RG"):
+            for f in line.split("\t")[1:]:
+                if f.startswith("ID:"):
+                    ids.add(f[3:])
+    out, k = rg_id, 0
+    while out in ids:
+        k += 1
+        out = f"{rg_id}.{k}"
+    return out
+
+
 def add_read_group(text: str, rg_id: str, sample: str | None = None) -> str:
     """Append a consensus @RG line (fgbio-style: one NEW output read
     group; input @RG lines are preserved above it for provenance). The

@@ -56,7 +56,9 @@ class BamLinearIndex:
         # whose exists() check lands mid-write would load a corrupt npz
         import os as _os
 
-        tmp = path + ".tmp"
+        # per-writer tmp name: two uncoordinated hosts saving the same
+        # index must never interleave into one tmp file
+        tmp = f"{path}.tmp.{_os.getpid()}"
         with open(tmp, "wb") as f:
             np.savez_compressed(
                 f,

@@ -146,6 +146,25 @@ def read_bam_native(
     return header, batch, info
 
 
+def _cigar_at(data: np.ndarray, off: int):
+    """Parse ONE record's CIGAR ops from the raw uncompressed bytes —
+    used only for the few modal-vote minority reads the soft-clip
+    rescue inspects, so a per-record Python parse is fine (the bulk
+    path never touches cigars, by design)."""
+    import struct as _struct
+
+    from duplexumiconsensusreads_tpu.io.bam import _CIGAR_OPS
+
+    # operate on the ndarray through the buffer protocol — no copy of
+    # the (large) decompressed chunk
+    l_rn = int(data[off + 12])
+    (n_cig,) = _struct.unpack_from("<H", data, off + 16)
+    if not n_cig:
+        return []
+    ops = np.frombuffer(data, "<u4", n_cig, off + 36 + l_rn)
+    return [(int(v) >> 4, _CIGAR_OPS[int(v) & 0xF]) for v in ops]
+
+
 def batch_from_offsets(
     lib,
     data: np.ndarray,
@@ -259,6 +278,12 @@ def batch_from_offsets(
 
     valid_pre = valid  # pre-CIGAR mask: keeps the drop counters disjoint
     keep = modal_cigar_keep(pos_key, umi_codes, valid, cig_hash, top)
+    from duplexumiconsensusreads_tpu.io.convert import softclip_rescue
+
+    rescue_info = softclip_rescue(
+        seq, qual, keep, valid, pos_key, umi_codes, top,
+        lambda i: _cigar_at(data, int(rec_off[i])),
+    )
     valid = valid & keep
     n_cigar = int(valid_pre.sum()) - int(valid.sum())
 
@@ -278,6 +303,7 @@ def batch_from_offsets(
         "n_dropped_umi_len": int((counted & ~valid_pre).sum()),
         "n_dropped_flag": int(excluded.sum()),
         "n_dropped_cigar": n_cigar,
+        **rescue_info,
         "n_mixed_mate_families": n_mixed,
         "mixed_mates": mixed_present,
         "umi_len": umi_len,

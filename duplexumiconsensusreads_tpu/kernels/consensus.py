@@ -36,9 +36,12 @@ from duplexumiconsensusreads_tpu.constants import (
 
 I32_MAX = jnp.iinfo(jnp.int32).max
 
-# blockseg tile height: rows per local one-hot GEMM. Read at trace
-# time; tools/tune_ssc.py sweeps it (with jax.clear_caches()) on the
-# real chip — see the journal in that file for measured values.
+# blockseg tile height default: rows per local one-hot GEMM. A
+# PipelineSpec.blockseg_t / ssc_kernel(blockseg_t=...) static argument
+# (r4: was a trace-time module constant — the CPU-default method's main
+# tuning knob should not require editing source); tools/tune_ssc.py
+# sweeps it on the real chip — see the journal there for measured
+# values.
 BLOCKSEG_T = 128
 
 
@@ -102,7 +105,7 @@ def _contributions(bases, quals, valid, max_input_qual, min_input_qual=0):
     jax.jit,
     static_argnames=(
         "f_max", "min_reads", "max_qual", "max_input_qual",
-        "min_input_qual", "method", "want_err", "columns",
+        "min_input_qual", "method", "want_err", "columns", "blockseg_t",
     ),
 )
 def ssc_kernel(
@@ -119,6 +122,7 @@ def ssc_kernel(
     method: str = "matmul",
     want_err: bool = False,
     columns: str = "full",
+    blockseg_t: int = BLOCKSEG_T,
 ):
     """Single-strand consensus for all families at once.
 
@@ -209,7 +213,7 @@ def ssc_kernel(
             # added into the dense family rows. 2*R*(T+1)*C FLOPs vs the
             # dense method's 2*R*(F+1)*C — an F/T reduction with no
             # prefix cancellation.
-            t = min(BLOCKSEG_T, r)
+            t = min(blockseg_t, r)
             nb = -(-r // t)
             pad = nb * t - r
             if pad:

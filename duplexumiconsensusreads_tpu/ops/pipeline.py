@@ -47,6 +47,10 @@ class PipelineSpec:
     f_max: int | None = None  # family-axis rows for the ssc reduction
     m_max: int | None = None  # molecule-axis rows for the duplex merge
     ssc_method: str = "matmul"
+    # blockseg tile height (rows per block-local GEMM) — only used when
+    # ssc_method == "blockseg"; spec-level so tools/tune_ssc.py can
+    # sweep it without monkey-patching a module constant
+    blockseg_t: int = 128
     # True asserts reads are sorted by (pos, UMI) with padding at the
     # tail — the bucketing layer's output contract — letting the device
     # kernel skip its (expensive) sorts. spec_for_buckets() sets it;
@@ -175,9 +179,7 @@ def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
         f = (spec.f_max or r) + 1
         fl += 2.0 * f * r * cols  # dense one-hot GEMM
     elif spec.ssc_method == "blockseg":
-        from duplexumiconsensusreads_tpu.kernels.consensus import BLOCKSEG_T
-
-        t = min(BLOCKSEG_T, r)
+        t = min(spec.blockseg_t, r)
         fl += 2.0 * r * (t + 1) * cols  # block-local GEMMs
     else:
         # pallas/segment/runsum perform ~the useful reduction FLOPs only
@@ -269,6 +271,7 @@ def fused_pipeline(
             method=spec.ssc_method,
             want_err=want_err,
             columns=columns,
+            blockseg_t=spec.blockseg_t,
         )
 
     quals_eff = quals

@@ -53,6 +53,14 @@ class RunReport:
     n_jumbo_hardcut_families: int = 0
     n_jumbo_hardcut_splits: int = 0
     n_downsampled_reads: int = 0  # --max-reads: io.convert.downsample_families
+    # CIGAR input policy (io.convert): minority-CIGAR reads rescued by
+    # the soft-clip trim-and-shift vs dropped outright, the latter
+    # split per strand — losing one strand silently downgrades a
+    # molecule from duplex to single-strand, so the split must be
+    # visible, not just the aggregate
+    n_rescued_cigar: int = 0
+    n_dropped_cigar_ab: int = 0
+    n_dropped_cigar_ba: int = 0
     mate_aware: bool = False  # resolved mate-aware mode of this run
     backend: str = ""
     seconds: dict = dataclasses.field(default_factory=dict)
@@ -577,7 +585,10 @@ def call_consensus_file(
         load_input,
         write_bam,
     )
-    from duplexumiconsensusreads_tpu.io.bam import derive_output_header
+    from duplexumiconsensusreads_tpu.io.bam import (
+        derive_output_header,
+        unique_read_group_id,
+    )
 
     rep = RunReport(backend=backend)
     duplex = consensus.mode == "duplex"
@@ -598,6 +609,9 @@ def call_consensus_file(
         + info.get("n_dropped_cigar", 0)
     )
     rep.n_mixed_mate_families = info.get("n_mixed_mate_families", 0)
+    rep.n_rescued_cigar = info.get("n_rescued_cigar", 0)
+    rep.n_dropped_cigar_ab = info.get("n_dropped_cigar_ab", 0)
+    rep.n_dropped_cigar_ba = info.get("n_dropped_cigar_ba", 0)
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
     if max_reads > 0:
         from duplexumiconsensusreads_tpu.io.convert import downsample_families
@@ -630,6 +644,8 @@ def call_consensus_file(
             jax.profiler.stop_trace()
 
     t0 = time.time()
+    # collision-free id FIRST: the RG:Z tags must match the header @RG
+    read_group = unique_read_group_id(header.text, read_group)
     out_recs = consensus_to_records(
         cb, cq, cd, cv, fp, fu, duplex=duplex,
         cons_mate=mate, cons_pair=pair, paired_out=grouping.mate_aware,

@@ -931,7 +931,13 @@ def stream_call_consensus(
     n_skipped = 0
     try:
         for k, (header, batch, info) in enumerate(timed_chunks(iter(chunk_iter))):
-            header_out = header_out or header
+            if header_out is None:
+                header_out = header
+                # collision-free consensus @RG, resolved once from the
+                # input header (deterministic, so resumed runs agree)
+                from duplexumiconsensusreads_tpu.io.bam import unique_read_group_id
+
+                read_group = unique_read_group_id(header.text, read_group)
             rep.n_chunks += 1
             if ckpt and str(k) in ckpt.done:
                 shards[k] = ckpt.done[str(k)]
@@ -949,6 +955,9 @@ def stream_call_consensus(
                 + info.get("n_dropped_flag", 0)
                 + info.get("n_dropped_cigar", 0)
             )
+            rep.n_rescued_cigar += info.get("n_rescued_cigar", 0)
+            rep.n_dropped_cigar_ab += info.get("n_dropped_cigar_ab", 0)
+            rep.n_dropped_cigar_ba += info.get("n_dropped_cigar_ba", 0)
             rep.n_mixed_mate_families += info.get("n_mixed_mate_families", 0)
             if info.get("n_mixed_mate_families") and not grouping.mate_aware:
                 # the iterator was created with warn_mixed=False (auto

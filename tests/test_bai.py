@@ -242,6 +242,29 @@ def test_output_header_and_read_group(tmp_path, mode):
     assert n_indexed == len(recs)
 
 
+def test_read_group_id_collision_uniquified(tmp_path):
+    """Input already carrying @RG ID:A (e.g. an fgbio-made input) must
+    NOT have consensus records attributed to that existing group — the
+    id uniquifies like @PG ids do (r4 review finding)."""
+    bam = str(tmp_path / "in.bam")
+    assert main([
+        "simulate", "-o", bam, "--molecules", "40", "--read-len", "40",
+        "--positions", "4", "--seed", "3", "--sorted",
+    ]) == 0
+    h, recs = read_bam(bam)
+    lines = h.text.rstrip("\n").splitlines()
+    lines.insert(1, "@RG\tID:A\tSM:prior_consensus")
+    write_bam(bam, BamHeader("\n".join(lines) + "\n", h.ref_names, h.ref_lengths), recs)
+    out = str(tmp_path / "c.bam")
+    assert main(["call", bam, "-o", out, "--config", "config3",
+                 "--capacity", "256"]) == 0
+    h2, r2 = read_bam(out)
+    rg_lines = [l for l in h2.text.splitlines() if l.startswith("@RG")]
+    assert any("ID:A\t" in l and "prior_consensus" in l for l in rg_lines)
+    assert any("ID:A.1" in l for l in rg_lines)
+    assert all(b"RGZA.1\x00" in a for a in r2.aux_raw)
+
+
 def test_custom_read_group_id(tmp_path):
     bam = _sim_with_provenance(tmp_path)
     out = str(tmp_path / "cons.bam")

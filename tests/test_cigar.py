@@ -158,3 +158,127 @@ def test_inject_indels_shapes():
         ops = recs.cigars[i]
         consumed = sum(n for n, o in ops if o in "MIS=X")
         assert consumed == int(recs.lengths[i])  # read-consuming ops add up
+
+
+def _clip_family_bam(tmp_path, name="sc.bam"):
+    """One exact family of 4 same-length reads: three modal 5S30M5S,
+    one 3S30M7S (identical 30M aligned core, clips shifted by 2) — the
+    soft-clip rescue case; plus a family whose minority read carries an
+    indel core (non-rescuable)."""
+    from duplexumiconsensusreads_tpu.io.bam import BamHeader, BamRecords, write_bam
+
+    rng = np.random.default_rng(3)
+    L = 40
+    cigs = [
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(3, "S"), (30, "M"), (7, "S")],  # rescuable
+        # second family (pos 500): 2 modal + 1 indel-core minority
+        [(40, "M")],
+        [(40, "M")],
+        [(20, "M"), (1, "I"), (19, "M")],  # NOT rescuable
+    ]
+    n = len(cigs)
+    pos = np.array([100, 100, 100, 100, 500, 500, 500], np.int32)
+    seq = rng.integers(0, 4, (n, L)).astype(np.uint8)
+    qual = rng.integers(20, 40, (n, L)).astype(np.uint8)
+    recs = BamRecords(
+        names=[f"r{i}" for i in range(n)],
+        flags=np.zeros(n, np.uint16),
+        ref_id=np.zeros(n, np.int32),
+        pos=pos,
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.full(n, -1, np.int32),
+        next_pos=np.full(n, -1, np.int32),
+        tlen=np.zeros(n, np.int32),
+        lengths=np.full(n, L, np.int32),
+        seq=seq,
+        qual=qual,
+        cigars=cigs,
+        umi=["ACGTAA"] * n,
+        aux_raw=[b"RXZACGTAA\x00"] * n,
+    )
+    path = str(tmp_path / name)
+    write_bam(path, BamHeader.synthetic(sort_order="coordinate"), recs)
+    return path, recs
+
+
+def test_softclip_rescue_trims_and_shifts(tmp_path):
+    """A minority read differing from the modal CIGAR by soft-clipping
+    only is RESCUED: trimmed to its aligned span and shifted into the
+    modal cycle space, instead of losing its evidence (VERDICT r3 item
+    7). An indel-core minority still drops, with per-strand counters."""
+    from duplexumiconsensusreads_tpu.constants import BASE_PAD
+
+    path, recs = _clip_family_bam(tmp_path)
+    _, r2 = read_bam(path)
+    batch, info = records_to_readbatch(r2, duplex=False)
+    assert info["n_rescued_cigar"] == 1
+    assert info["n_dropped_cigar"] == 1  # the indel-core read only
+    assert info["n_dropped_cigar_ab"] == 1  # unpaired forward = top
+    assert info["n_dropped_cigar_ba"] == 0
+    v = np.asarray(batch.valid)
+    assert v[3] and not v[6]
+    # rescued row: query 3..32 (its 30M core) placed at cycles 5..34
+    # (the modal lead), everything else masked PAD with qual 0
+    b = np.asarray(batch.bases)
+    q = np.asarray(batch.quals)
+    np.testing.assert_array_equal(b[3, 5:35], np.asarray(r2.seq)[3, 3:33])
+    np.testing.assert_array_equal(q[3, 5:35], np.asarray(r2.qual)[3, 3:33])
+    assert (b[3, :5] == BASE_PAD).all() and (b[3, 35:] == BASE_PAD).all()
+    assert (q[3, :5] == 0).all() and (q[3, 35:] == 0).all()
+
+
+def test_softclip_rescue_native_parity(tmp_path):
+    """Both codecs must apply the identical rescue transform — the
+    batches (bases, quals, valid) stay bit-equal."""
+    from duplexumiconsensusreads_tpu.io.native_reader import read_bam_native
+    from duplexumiconsensusreads_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native loader unavailable")
+    path, _ = _clip_family_bam(tmp_path)
+    _, r2 = read_bam(path)
+    b_py, i_py = records_to_readbatch(r2, duplex=False)
+    _, b_nat, i_nat = read_bam_native(path, duplex=False)
+    for k in ("n_rescued_cigar", "n_dropped_cigar", "n_dropped_cigar_ab",
+              "n_dropped_cigar_ba"):
+        assert i_py[k] == i_nat[k], k
+    np.testing.assert_array_equal(b_py.valid, b_nat.valid)
+    np.testing.assert_array_equal(
+        np.asarray(b_py.bases)[b_py.valid], np.asarray(b_nat.bases)[b_nat.valid]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b_py.quals)[b_py.valid], np.asarray(b_nat.quals)[b_nat.valid]
+    )
+
+
+def test_cigar_drop_fraction_bounded_on_indel_sim(tmp_path):
+    """Validate-side evidence-loss ceiling (VERDICT r3 item 7): on the
+    indel sim the CIGAR policy must discard only a bounded fraction of
+    reads, and the report states the loss per strand."""
+    import json as _json
+
+    from duplexumiconsensusreads_tpu.cli import main
+
+    path = str(tmp_path / "indel.bam")
+    cfg = SimConfig(
+        n_molecules=120, mean_family_size=5, indel_error=0.06, duplex=True,
+        seed=8,
+    )
+    simulated_bam(cfg, path=path, sort=True)
+    out = str(tmp_path / "c.bam")
+    rep_path = str(tmp_path / "r.json")
+    assert main([
+        "call", path, "-o", out, "--config", "config3", "--capacity", "256",
+        "--report", rep_path,
+    ]) == 0
+    rep = _json.load(open(rep_path))
+    dropped = rep["n_dropped_cigar_ab"] + rep["n_dropped_cigar_ba"]
+    assert dropped > 0  # the sim does produce minority indel reads
+    # ceiling: with 6% per-read indel prob and ~5-read families, the
+    # modal vote should never discard more than ~12% of records
+    assert dropped / rep["n_records"] < 0.12
+    # both strands appear in the split (duplex sim, symmetric error)
+    assert rep["n_dropped_cigar_ab"] > 0 and rep["n_dropped_cigar_ba"] > 0

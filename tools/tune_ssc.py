@@ -47,7 +47,6 @@ import numpy as np
 def main() -> None:
     import jax
 
-    import duplexumiconsensusreads_tpu.kernels.consensus as kc
     from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
     from duplexumiconsensusreads_tpu.parallel import make_mesh
     from duplexumiconsensusreads_tpu.parallel.sharded import (
@@ -77,13 +76,16 @@ def main() -> None:
     plans = [("matmul", None)] + [
         ("blockseg", t) for t in (64, 128, 256, 512)
     ] + [("runsum", None), ("segment", None)]
+    import dataclasses as _dc
+
     for method, t in plans:
         jax.clear_caches()
-        if t is not None:
-            kc.BLOCKSEG_T = t
         part = partition_buckets(buckets, gp, cp, method)
         classes = [
-            (cspec, shard_stacked(stack_buckets(cb, multiple_of=1), mesh))
+            (
+                cspec if t is None else _dc.replace(cspec, blockseg_t=t),
+                shard_stacked(stack_buckets(cb, multiple_of=1), mesh),
+            )
             for cb, cspec in part
         ]
         jax.block_until_ready([c[1] for c in classes])
