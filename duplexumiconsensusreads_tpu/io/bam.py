@@ -106,22 +106,37 @@ def set_sort_order(text: str, so: str) -> str:
     return "\n".join(lines) + "\n"
 
 
+
+def _header_ids(text: str, tag: str) -> tuple[set, str | None]:
+    """(all ID: values of @<tag> lines, the LAST one seen) — shared by
+    the @PG and @RG uniquification so the parse/suffix logic cannot
+    diverge between them."""
+    ids: set = set()
+    last = None
+    for line in (text.rstrip("\n").split("\n") if text.strip() else []):
+        if line.startswith(tag):
+            for f in line.split("\t")[1:]:
+                if f.startswith("ID:"):
+                    ids.add(f[3:])
+                    last = f[3:]
+    return ids, last
+
+
+def _uniquify(base: str, ids: set) -> str:
+    out, k = base, 0
+    while out in ids:
+        k += 1
+        out = f"{base}.{k}"
+    return out
+
+
 def chain_pg(text: str, pn: str = "duplexumiconsensusreads_tpu", cl: str | None = None) -> str:
     """Append a new @PG entry chained (PP:) to the last program in the
     existing chain, with a collision-free ID — real pipelines key
     provenance on the @PG chain, so reruns must never clobber it."""
     lines = text.rstrip("\n").split("\n") if text.strip() else []
-    ids, last_id = set(), None
-    for line in lines:
-        if line.startswith("@PG"):
-            for f in line.split("\t")[1:]:
-                if f.startswith("ID:"):
-                    ids.add(f[3:])
-                    last_id = f[3:]
-    new_id, k = "duplexumi", 0
-    while new_id in ids:
-        k += 1
-        new_id = f"duplexumi.{k}"
+    ids, last_id = _header_ids(text, "@PG")
+    new_id = _uniquify("duplexumi", ids)
     entry = f"@PG\tID:{new_id}\tPN:{pn}"
     if last_id is not None:
         entry += f"\tPP:{last_id}"
@@ -136,19 +151,11 @@ def unique_read_group_id(text: str, rg_id: str) -> str:
     already carries @RG ID:<rg_id> (e.g. an fgbio-produced input whose
     consensus group is also 'A'), attributing our consensus records to
     that EXISTING group would silently inherit its SM/LB/PL — so
-    uniquify the same way chain_pg does for @PG IDs. Must be resolved
-    BEFORE records are built (the RG:Z tags must match the final id)."""
-    ids = set()
-    for line in text.split("\n"):
-        if line.startswith("@RG"):
-            for f in line.split("\t")[1:]:
-                if f.startswith("ID:"):
-                    ids.add(f[3:])
-    out, k = rg_id, 0
-    while out in ids:
-        k += 1
-        out = f"{rg_id}.{k}"
-    return out
+    uniquify with the same helper chain_pg uses for @PG IDs. Must be
+    resolved BEFORE records are built (the RG:Z tags must match the
+    final id)."""
+    ids, _last = _header_ids(text, "@RG")
+    return _uniquify(rg_id, ids)
 
 
 def add_read_group(text: str, rg_id: str, sample: str | None = None) -> str:

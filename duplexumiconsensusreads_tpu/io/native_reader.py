@@ -281,7 +281,7 @@ def batch_from_offsets(
     from duplexumiconsensusreads_tpu.io.convert import softclip_rescue
 
     rescue_info = softclip_rescue(
-        seq, qual, keep, valid, pos_key, umi_codes, top,
+        seq, qual, keep, valid, pos_key, umi_codes, top, pos,
         lambda i: _cigar_at(data, int(rec_off[i])),
     )
     valid = valid & keep

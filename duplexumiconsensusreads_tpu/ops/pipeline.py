@@ -57,12 +57,20 @@ class PipelineSpec:
     # the conservative default matches fused_pipeline's original
     # any-order contract.
     presorted: bool = False
-    # True: the ``bases`` input carries base|qual packed one byte per
-    # cycle (pack_base_qual below) and ``quals`` is a zero-width dummy —
-    # halves the dominant host->device transfer on tunneled chips.
-    # Exact whenever max_input_qual <= PACKED_QUAL_MAX (the executors
-    # check before enabling).
+    # True: the wire-optimized input convention (pack_stacked below) —
+    # ``bases`` carries base|qual packed one byte per cycle, ``umi``
+    # 2-bit codes four-per-byte, ``pos`` u16, and ``strand_ab`` a
+    # strand|frag_end|valid flag byte (frag_end/quals/valid become
+    # zero-width dummies). Decoding is fused into the first consumers
+    # on device. Exact whenever max_input_qual <= PACKED_QUAL_MAX (the
+    # executors check before enabling); host->device transfer is the
+    # dominant streaming phase on tunneled chips, and the non-base
+    # fields were the remaining ~17% of wire bytes after base|qual
+    # packing (r4: the SURVEY packing ladder, completed).
     packed_io: bool = False
+    # true UMI code count, required to un-pack the 2-bit umi bytes
+    # (static — the packed width ceil(U/4)*4 over-covers)
+    umi_len: int | None = None
     # True: also compute per-base disagreement counts (the ce tag) —
     # widens the ssc reduction by 4L count columns, so opt-in
     # (--per-base-tags runs only).
@@ -102,15 +110,43 @@ def pack_base_qual(bases: "np.ndarray", quals: "np.ndarray"):
 
 
 def pack_stacked(stacked: dict) -> dict:
-    """Apply the packed-io convention to a stacked bucket dict IN PLACE:
-    ``bases`` becomes the packed base|qual bytes and ``quals`` a
-    zero-width dummy (fused_pipeline ignores it when spec.packed_io).
+    """Apply the packed-io convention to a stacked bucket dict IN PLACE
+    (the host side of spec.packed_io — fused_pipeline decodes):
+
+      bases      base|qual, one byte per cycle (pack_base_qual)
+      umi        2-bit codes, four per byte
+      pos        u16 (bucket-local dense ids < capacity, asserted)
+      strand_ab  strand | frag_end<<1 | valid<<2 flag byte
+      quals/frag_end/valid  zero-width dummies
+
     Shared by the whole-file and streaming executors so the convention
-    can never desync."""
+    can never desync. Everything is lossless (quals clip at
+    PACKED_QUAL_MAX, gated by the executors' packed_io_ok check)."""
     import numpy as np
 
     stacked["bases"] = pack_base_qual(stacked["bases"], stacked["quals"])
     stacked["quals"] = np.zeros(stacked["quals"].shape[:2] + (0,), np.uint8)
+    u = np.asarray(stacked["umi"])
+    b_, r_, w_ = u.shape
+    pad = (-w_) % 4
+    if pad:
+        u = np.concatenate([u, np.zeros((b_, r_, pad), np.uint8)], axis=2)
+    u4 = u.reshape(b_, r_, -1, 4)
+    stacked["umi"] = (
+        u4[..., 0] | (u4[..., 1] << 2) | (u4[..., 2] << 4) | (u4[..., 3] << 6)
+    ).astype(np.uint8)
+    pos = np.asarray(stacked["pos"])
+    if pos.max(initial=0) >= 1 << 16 or pos.min(initial=0) < 0:
+        raise ValueError("packed io: bucket-local pos ids must fit u16")
+    stacked["pos"] = pos.astype(np.uint16)
+    flags = (
+        np.asarray(stacked["strand_ab"], bool).astype(np.uint8)
+        | (np.asarray(stacked["frag_end"], bool).astype(np.uint8) << 1)
+        | (np.asarray(stacked["valid"], bool).astype(np.uint8) << 2)
+    )
+    stacked["strand_ab"] = flags
+    stacked["frag_end"] = np.zeros((b_, 0), np.uint8)
+    stacked["valid"] = np.zeros((b_, 0), np.uint8)
     return stacked
 
 
@@ -138,6 +174,7 @@ def spec_for_buckets(
             grouping, consensus, ssc_method=ssc_method, packed_io=packed_io,
             per_base_counts=per_base_counts,
         )
+    umi_len = int(buckets[0].umi.shape[1]) if packed_io else None
     r = buckets[0].capacity
     max_u = max(b.n_unique_umi for b in buckets)
     u_max = min(_pow2(max_u), r)
@@ -154,6 +191,7 @@ def spec_for_buckets(
         ssc_method=ssc_method,
         presorted=True,  # bucketing's output contract
         packed_io=packed_io,
+        umi_len=umi_len,
         per_base_counts=per_base_counts,
     )
 
@@ -213,14 +251,29 @@ def fused_pipeline(
     r = pos.shape[0]
 
     if spec.packed_io:
-        # decode base|qual bytes on device (VPU, fused into the first
-        # consumer): N and PAD both decode to BASE_N — the kernels only
-        # ever test bases < N_REAL_BASES, so the distinction is dead
+        # decode the wire convention on device (VPU, fused into the
+        # first consumers). base|qual: N and PAD both decode to BASE_N —
+        # the kernels only ever test bases < N_REAL_BASES, so the
+        # distinction is dead
         from duplexumiconsensusreads_tpu.constants import BASE_N as _BN
 
         real_b = bases != PACKED_NONE
         quals = jnp.where(real_b, bases >> 2, 0).astype(jnp.uint8)
         bases = jnp.where(real_b, bases & 3, _BN).astype(jnp.uint8)
+        # flag byte -> the three bool vectors (frag_end/valid arrive as
+        # zero-width dummies)
+        flags8 = strand_ab.astype(jnp.uint8)
+        strand_ab = (flags8 & 1) != 0
+        frag_end = (flags8 & 2) != 0
+        valid = (flags8 & 4) != 0
+        pos = pos.astype(jnp.int32)
+        # 2-bit umi bytes -> codes; the packed width over-covers, slice
+        # to the true (static) code count
+        if spec.umi_len is None:
+            raise ValueError("packed_io requires spec.umi_len")
+        shifts = jnp.arange(4, dtype=jnp.uint8) * 2
+        codes = (umi[:, :, None] >> shifts[None, None, :]) & 3
+        umi = codes.reshape(r, -1)[:, : spec.umi_len].astype(jnp.uint8)
 
     fam, mol, pair, n_fam, n_mol, n_over = group_kernel(
         pos,

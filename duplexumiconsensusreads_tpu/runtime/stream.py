@@ -690,6 +690,8 @@ def stream_call_consensus(
     # output read group); joins the checkpoint fingerprint — it changes
     # record bytes
     write_index: bool = False,  # write the standard .bai after finalise
+    packed: str = "auto",  # wire packing: "auto" (packed_io_ok gate) or
+    # "off" — the bench A/B measures both on the same input
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -986,7 +988,8 @@ def stream_call_consensus(
                 continue
             entries = []
             for cbuckets, cspec in partition_buckets(
-                buckets, grouping, consensus, packed_io=packed_io_ok(consensus),
+                buckets, grouping, consensus,
+                packed_io=(packed != "off" and packed_io_ok(consensus)),
                 per_base_counts=per_base_tags,
             ):
                 spec_cache[cspec] = True

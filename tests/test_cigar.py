@@ -282,3 +282,65 @@ def test_cigar_drop_fraction_bounded_on_indel_sim(tmp_path):
     assert dropped / rep["n_records"] < 0.12
     # both strands appear in the split (duplex sim, symmetric error)
     assert rep["n_dropped_cigar_ab"] > 0 and rep["n_dropped_cigar_ba"] > 0
+
+
+def test_softclip_rescue_requires_same_alignment_start(tmp_path):
+    """Family membership does NOT imply same alignment start: paired
+    mates share (pos_key, UMI, strand) while their own POS differ, and
+    a repeat-region minority can start a few bases off. The rescue must
+    skip both — a clip-lead-only shift would inject misaligned
+    evidence (r4 review finding)."""
+    from duplexumiconsensusreads_tpu.io.bam import (
+        FLAG_PAIRED,
+        FLAG_READ1,
+        FLAG_READ2,
+        FLAG_REVERSE,
+        BamHeader,
+        BamRecords,
+        write_bam,
+    )
+
+    rng = np.random.default_rng(6)
+    L = 40
+    # one template: three R1 copies at pos 100 (modal cigar) and one R2
+    # at pos 250 whose cigar is a soft-clip variant of the SAME core —
+    # same pos_key (min(pos, next_pos) = 100), same strand (F1R2 -> R1
+    # fwd top, R2 rev top)
+    cigs = [
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(3, "S"), (30, "M"), (7, "S")],
+    ]
+    n = len(cigs)
+    flags = np.array(
+        [FLAG_PAIRED | FLAG_READ1] * 3
+        + [FLAG_PAIRED | FLAG_READ2 | FLAG_REVERSE],
+        np.uint16,
+    )
+    pos = np.array([100, 100, 100, 250], np.int32)
+    next_pos = np.array([250, 250, 250, 100], np.int32)
+    recs = BamRecords(
+        names=[f"t{i}" for i in range(n)],
+        flags=flags,
+        ref_id=np.zeros(n, np.int32),
+        pos=pos,
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.zeros(n, np.int32),
+        next_pos=next_pos,
+        tlen=np.zeros(n, np.int32),
+        lengths=np.full(n, L, np.int32),
+        seq=rng.integers(0, 4, (n, L)).astype(np.uint8),
+        qual=np.full((n, L), 30, np.uint8),
+        cigars=cigs,
+        umi=["ACGTAA"] * n,
+        aux_raw=[b"RXZACGTAA\x00"] * n,
+    )
+    path = str(tmp_path / "mates.bam")
+    write_bam(path, BamHeader.synthetic(sort_order="coordinate"), recs)
+    _, r2 = read_bam(path)
+    batch, info = records_to_readbatch(r2, duplex=False)
+    # the R2 read must stay DROPPED (not rescued into R1's cycle space)
+    assert info["n_rescued_cigar"] == 0
+    assert info["n_dropped_cigar"] == 1
+    assert not np.asarray(batch.valid)[3]

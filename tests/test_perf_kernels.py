@@ -221,17 +221,28 @@ class TestPackedIO:
         cp = ConsensusParams(mode="duplex", error_model="cycle")
         buckets = build_buckets(batch, capacity=512, grouping=gp)
         spec_raw = spec_for_buckets(buckets, gp, cp)
-        spec_pk = dc.replace(spec_raw, packed_io=True)
+        spec_pk = dc.replace(
+            spec_raw, packed_io=True, umi_len=int(buckets[0].umi.shape[1])
+        )
         for bk in buckets:
             a = run_bucket(bk, spec_raw)
+            # the FULL wire convention: bases|quals byte, 2-bit umi,
+            # u16 pos, flag byte (r4 packing-ladder completion)
             stacked = {
                 "bases": bk.bases[None], "quals": bk.quals[None],
+                "umi": bk.umi[None], "pos": bk.pos[None],
+                "strand_ab": bk.strand_ab[None],
+                "frag_end": bk.frag_end[None], "valid": bk.valid[None],
             }
             pack_stacked(stacked)
+            assert stacked["umi"].dtype == np.uint8
+            assert stacked["umi"].shape[2] == -(-bk.umi.shape[1] // 4)
+            assert stacked["pos"].dtype == np.uint16
             from duplexumiconsensusreads_tpu.ops import fused_pipeline
 
             b = fused_pipeline(
-                bk.pos, bk.umi, bk.strand_ab, bk.frag_end, bk.valid,
+                stacked["pos"][0], stacked["umi"][0], stacked["strand_ab"][0],
+                stacked["frag_end"][0], stacked["valid"][0],
                 stacked["bases"][0], stacked["quals"][0], spec_pk,
             )
             for key in ("family_id", "cons_base", "cons_qual", "cons_depth",
