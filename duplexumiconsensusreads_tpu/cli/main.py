@@ -241,6 +241,21 @@ def build_parser() -> argparse.ArgumentParser:
         "tool's own linear partitioning index",
     )
 
+    vw = sub.add_parser(
+        "view",
+        help="extract records overlapping a region via the standard "
+        ".bai (samtools-view analogue; builds the index on demand)",
+    )
+    vw.add_argument("input", help="coordinate-sorted BAM")
+    vw.add_argument(
+        "region",
+        help="REF[:BEG-END] (1-based inclusive, samtools convention); "
+        "REF alone takes the whole reference",
+    )
+    vw.add_argument("-o", "--output", help="write matching records as BAM "
+                    "(default: print a count summary)")
+    vw.add_argument("--json", action="store_true", help="print summary as JSON")
+
     st = sub.add_parser(
         "stats",
         help="input metrics: family-size histogram, strand balance, "
@@ -1157,6 +1172,161 @@ def _cmd_group(args) -> int:
     return 0
 
 
+def _cmd_view(args) -> int:
+    """Region query through the tool's OWN standard .bai — the
+    consuming side of `index --bai` / `call --write-index` (a written
+    index nobody reads is unproven; this is the samtools-view
+    analogue). One seek + forward scan: the file is coordinate-sorted,
+    so the spec §5.3 candidate bins + linear-index floor yield a start
+    virtual offset, and the scan stops at the first record starting at
+    or past the region end."""
+    import os as _os
+    import re as _re
+
+    import numpy as np
+
+    from duplexumiconsensusreads_tpu.io.bai import (
+        build_bai,
+        query_start_voffset,
+        read_bai,
+    )
+    from duplexumiconsensusreads_tpu.io.bam import derive_output_header, write_bam
+    from duplexumiconsensusreads_tpu.runtime.stream import (
+        BamStreamReader,
+        _records_from_raw,
+    )
+
+    rdr = BamStreamReader(args.input)
+    header = rdr.header
+    rdr.close()
+    # Reference names may themselves contain ':' (GRCh38 HLA alt
+    # contigs), so resolve samtools-style: the whole string as a name
+    # first, then the longest header name followed by :BEG-END.
+    ref_name, g_beg, g_end = None, None, None
+    if args.region in header.ref_names:
+        ref_name = args.region
+    else:
+        m = _re.fullmatch(r"(.+):(\d+)-(\d+)", args.region)
+        if m and m.group(1) in header.ref_names:
+            ref_name, g_beg, g_end = m.group(1), m.group(2), m.group(3)
+    if ref_name is None:
+        raise SystemExit(
+            f"unknown reference in region {args.region!r} (want REF or "
+            f"REF:BEG-END with REF from the header)"
+        )
+    ref_id = header.ref_names.index(ref_name)
+    ref_len = header.ref_lengths[ref_id]
+    # samtools convention: 1-based inclusive input -> 0-based half-open
+    beg = int(g_beg) - 1 if g_beg else 0
+    end = int(g_end) if g_end else ref_len
+    if beg < 0 or end <= beg:
+        raise SystemExit(f"bad region bounds in {args.region!r}")
+
+    bai_path = args.input + ".bai"
+    if not _os.path.exists(bai_path):
+        print(f"[duplexumi] building {bai_path}", file=sys.stderr)
+        build_bai(args.input)
+    idx = read_bai(bai_path)
+    start_v = query_start_voffset(idx, ref_id, beg, end)
+
+    kept = []
+    if start_v is not None:
+        rdr = BamStreamReader(
+            args.input, start=(start_v >> 16, start_v & 0xFFFF)
+        )
+        try:
+            done = False
+            while not done:
+                raw = rdr.read_raw_records(4096)
+                if raw is None:
+                    break
+                recs = _records_from_raw(header, raw)
+                for i in range(len(recs)):
+                    rid, pos = int(recs.ref_id[i]), int(recs.pos[i])
+                    if rid != ref_id or pos >= end:
+                        # rid < 0 is the unmapped tail, which sorts
+                        # LAST — terminal, or a whole-file decode for
+                        # zero output on last-reference queries
+                        if (
+                            rid < 0
+                            or rid > ref_id
+                            or (rid == ref_id and pos >= end)
+                        ):
+                            done = True  # sorted: nothing further overlaps
+                            break
+                        continue  # earlier ref / before the chunk floor
+                    span = sum(
+                        n for n, op in recs.cigars[i]
+                        if op in "MDN=X"
+                    ) or 1
+                    if pos + span > beg:
+                        # copy the row OUT now: retaining (recs, i)
+                        # would pin every 4096-record parsed batch with
+                        # any hit until output time
+                        li = int(recs.lengths[i])
+                        kept.append((
+                            recs.names[i], int(recs.flags[i]), rid, pos,
+                            int(recs.mapq[i]), int(recs.next_ref_id[i]),
+                            int(recs.next_pos[i]), int(recs.tlen[i]), li,
+                            recs.seq[i, :li].copy(), recs.qual[i, :li].copy(),
+                            recs.cigars[i], recs.umi[i], recs.aux_raw[i],
+                        ))
+        finally:
+            rdr.close()
+
+    if args.output:
+        from duplexumiconsensusreads_tpu.constants import BASE_PAD
+        from duplexumiconsensusreads_tpu.io.bam import BamRecords
+
+        l_max = max((k[8] for k in kept), default=0)
+
+        def _pad(row, fill):
+            out = np.full(l_max, fill, np.uint8)
+            out[: len(row)] = row
+            return out
+
+        out_recs = BamRecords(
+            names=[k[0] for k in kept],
+            flags=np.array([k[1] for k in kept], np.uint16),
+            ref_id=np.array([k[2] for k in kept], np.int32),
+            pos=np.array([k[3] for k in kept], np.int32),
+            mapq=np.array([k[4] for k in kept], np.uint8),
+            next_ref_id=np.array([k[5] for k in kept], np.int32),
+            next_pos=np.array([k[6] for k in kept], np.int32),
+            tlen=np.array([k[7] for k in kept], np.int32),
+            lengths=np.array([k[8] for k in kept], np.int32),
+            seq=(
+                np.stack([_pad(k[9], BASE_PAD) for k in kept])
+                if kept else np.zeros((0, 0), np.uint8)
+            ),
+            qual=(
+                np.stack([_pad(k[10], 0) for k in kept])
+                if kept else np.zeros((0, 0), np.uint8)
+            ),
+            cigars=[k[11] for k in kept],
+            umi=[k[12] for k in kept],
+            aux_raw=[k[13] for k in kept],
+        )
+        write_bam(
+            args.output, derive_output_header(header, sort_order=None), out_recs
+        )
+    summary = {
+        "region": f"{ref_name}:{beg + 1}-{end}",
+        "n_records": len(kept),
+        "index": bai_path,
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"[duplexumi] {summary['n_records']} records overlap "
+            f"{summary['region']}"
+            + (f" → {args.output}" if args.output else ""),
+            file=sys.stderr,
+        )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "call":
@@ -1175,6 +1345,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_bench(args)
     if args.cmd == "group":
         return _cmd_group(args)
+    if args.cmd == "view":
+        return _cmd_view(args)
     raise AssertionError(args.cmd)
 
 

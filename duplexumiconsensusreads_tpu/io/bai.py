@@ -201,6 +201,48 @@ def build_bai(path: str, bai_path: str | None = None) -> str:
     return bai_path
 
 
+def reg2bins(beg: int, end: int) -> list[int]:
+    """All bins that MAY hold alignments overlapping [beg, end) — the
+    SAM spec §5.3 candidate-bin enumeration (the query-side dual of
+    reg2bin)."""
+    end -= 1
+    bins = [0]
+    for shift, off in ((26, 1), (23, 9), (20, 73), (17, 585), (14, 4681)):
+        bins.extend(range(off + (beg >> shift), off + (end >> shift) + 1))
+    return bins
+
+
+def query_start_voffset(idx: dict, ref_id: int, beg: int, end: int) -> int | None:
+    """The virtual offset to start scanning for alignments overlapping
+    [beg, end) on ref_id, from a read_bai() index: the minimum chunk
+    begin across candidate bins, floored by the linear-index window
+    (htslib's query strategy). None when the reference holds nothing
+    relevant. The file is coordinate-sorted, so ONE seek + a forward
+    scan that stops at the first record starting >= end is a complete
+    query."""
+    if ref_id < 0 or ref_id >= idx["n_ref"]:
+        return None
+    ref = idx["refs"][ref_id]
+    if ref["meta"] is None and not ref["bins"]:
+        return None
+    lin = ref["linear"]
+    w = beg >> LINEAR_SHIFT
+    min_lin = lin[min(w, len(lin) - 1)] if lin else 0
+    # every overlapping alignment lives in a candidate bin (reg2bins is
+    # the dual of reg2bin), so no candidate chunks => nothing to find.
+    # The linear floor CLAMPS the start (a candidate chunk may begin
+    # before it, holding earlier irrelevant records) — skipping such
+    # chunks instead of clamping would jump past relevant records.
+    best = None
+    for b in reg2bins(beg, end):
+        for beg_v, _end_v in ref["bins"].get(b, ()):
+            if best is None or beg_v < best:
+                best = beg_v
+    if best is None:
+        return None
+    return max(best, min_lin)
+
+
 def read_bai(path: str) -> dict:
     """Parse a .bai into {n_ref, refs: [{bins: {bin: [(beg, end), ...]},
     linear: [...], meta: (off_beg, off_end, n_mapped, n_unmapped) | None}],

@@ -297,3 +297,85 @@ def test_filter_and_group_chain_pg(tmp_path):
     assert main(["group", bam, "-o", grp, "--duplex"]) == 0
     pg_g = [l for l in read_bam(grp)[0].text.splitlines() if l.startswith("@PG")]
     assert any("PP:" in l for l in pg_g)
+
+
+def test_view_region_query_matches_bruteforce(tmp_path, capsys):
+    """`duplexumi view` consumes the tool's OWN .bai (the written index
+    must also be readable): for random regions the one-seek indexed
+    query must return exactly the records a brute-force full scan
+    selects by overlap."""
+    import json as _json
+
+    path = str(tmp_path / "mr.bam")
+    recs = _multi_ref_bam(path, n_per_ref=60, n_ref=3, seed=9)
+    L = 24
+    rng = np.random.default_rng(2)
+    ref_names = ["chr1", "chr2", "chr3"]
+    for _ in range(12):
+        r = int(rng.integers(0, 3))
+        beg = int(rng.integers(0, 300_000))
+        end = beg + int(rng.integers(1, 60_000))
+        sel = (
+            (np.asarray(recs.ref_id) == r)
+            & (np.asarray(recs.pos) < end)
+            & (np.asarray(recs.pos) + L > beg)
+        )
+        region = f"{ref_names[r]}:{beg + 1}-{end}"
+        out = str(tmp_path / "sel.bam")
+        assert main(["view", path, region, "-o", out, "--json"]) == 0
+        res = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert res["n_records"] == int(sel.sum()), region
+        _, got = read_bam(out)
+        assert sorted(got.names) == sorted(
+            np.array(recs.names)[sel].tolist()
+        ), region
+    # whole-reference form
+    assert main(["view", path, "chr2", "--json"]) == 0
+    res = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["n_records"] == 60
+    # unknown reference is a loud error
+    with pytest.raises(SystemExit, match="unknown reference"):
+        main(["view", path, "chrX:1-100"])
+
+
+def test_view_colon_contig_and_unmapped_tail(tmp_path, capsys):
+    """References whose names contain ':' (GRCh38 HLA alt contigs) must
+    be queryable, and a last-reference query must TERMINATE at the
+    unmapped tail instead of decoding it (r4 review findings)."""
+    import json as _json
+
+    path = str(tmp_path / "hla.bam")
+    n, L = 8, 24
+    rng = np.random.default_rng(4)
+    pos = np.r_[np.sort(rng.integers(0, 50_000, n - 2)), [-1, -1]].astype(np.int32)
+    rid = np.r_[np.zeros(n - 2), [-1, -1]].astype(np.int32)
+    flags = np.r_[np.zeros(n - 2), [4, 4]].astype(np.uint16)
+    recs = BamRecords(
+        names=[f"r{i}" for i in range(n)],
+        flags=flags,
+        ref_id=rid,
+        pos=pos,
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.full(n, -1, np.int32),
+        next_pos=np.full(n, -1, np.int32),
+        tlen=np.zeros(n, np.int32),
+        lengths=np.full(n, L, np.int32),
+        seq=rng.integers(0, 4, (n, L)).astype(np.uint8),
+        qual=np.full((n, L), 30, np.uint8),
+        cigars=[[(L, "M")] for _ in range(n - 2)] + [[], []],
+        umi=[""] * n,
+        aux_raw=[b"RXZACGTAA\x00"] * n,
+    )
+    header = BamHeader.synthetic(
+        ref_names=("HLA-A*01:01:01:01",), ref_lengths=(100_000,),
+        sort_order="coordinate",
+    )
+    write_bam(path, header, recs)
+    # whole-reference form with a colon-bearing name
+    assert main(["view", path, "HLA-A*01:01:01:01", "--json"]) == 0
+    res = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["n_records"] == n - 2  # the unmapped tail is excluded
+    # ranged form on the colon-bearing name
+    assert main(["view", path, "HLA-A*01:01:01:01:1-100000", "--json"]) == 0
+    res = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["n_records"] == n - 2
