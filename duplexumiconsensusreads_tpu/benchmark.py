@@ -510,6 +510,22 @@ def main() -> None:
         # charges it all to whichever side runs cold (measured r4:
         # cold packed 14.4k vs warm 31.0k reads/s on the same input)
         n_ab = int(os.environ.get("DUT_BENCH_E2E_AB", n_e2e))
+        # weather guard: if the packed leg already ran slow (bad tunnel
+        # day), the pair would be weather noise AND doubling a slow e2e
+        # risks an external capture timeout losing the WHOLE json line
+        # (it only prints at the end) — skip and say so
+        ab_budget = float(os.environ.get("DUT_BENCH_AB_BUDGET_S", 480))
+        # the guard compares the UNPACKED leg's expected time (scaled by
+        # its read count — a reduced DUT_BENCH_E2E_AB is proportionally
+        # cheaper); 0 disables the guard like the other 0-knobs here
+        ab_expected_s = e2e["e2e_wall_s"] * (n_ab / max(n_e2e, 1))
+        if n_ab > 0 and ab_budget > 0 and ab_expected_s > ab_budget:
+            result["e2e_ab_skipped"] = (
+                f"expected unpacked leg ~{ab_expected_s:.0f}s > "
+                f"{ab_budget:.0f}s budget (packed leg took "
+                f"{e2e['e2e_wall_s']}s)"
+            )
+            n_ab = 0
         if n_ab > 0:
             unpacked = run_e2e(n_ab, packed="off", prefix="e2e_unpacked")
             result.update(unpacked)
