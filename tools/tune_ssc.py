@@ -35,6 +35,19 @@ On XLA-CPU the ranking INVERTS: blockseg 74.6k reads/s vs matmul
 17.8k (4.2x) — the padding FLOPs are real work on a scalar core.
 blockseg is therefore the CPU-backend default
 (runtime/executor.py DEFAULT_SSC_METHOD_CPU).
+
+r4 precision refutation (standalone GEMM micro at the exact bench
+shapes, (280, 2048, 1025)x(280, 2048, 751), true device->host sync
+barrier): f32 default 30.6ms, bf16-cast inputs 37.1ms (SLOWER — the
+casts materialize ~2.3GB of copies the fused f32 path never writes),
+hi/lo split-bf16 52.1ms, precision=HIGHEST 50.8ms. So "run the
+evidence GEMM in bf16 for speed" is REFUTED at these shapes before
+even reaching the parity question (bf16 loglik sums would also risk
+argmax near-tie flips vs the f64 oracle). The r4 wins came from
+COLUMN structure instead: the fit-only pass drops the depth block
+(20% fewer columns, exact via the loglik-sign mask) — columns must be
+dropped BEFORE the dot, XLA cannot narrow a GEMM through output
+slices.
 """
 
 from __future__ import annotations
