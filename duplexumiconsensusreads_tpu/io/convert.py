@@ -263,12 +263,22 @@ def softclip_rescue(
     sab = np.asarray(strand_ab, bool)
     dropped = np.nonzero(v & ~keep)[0]
     n_rescued = 0
+    rp = np.asarray(read_pos)
     if len(dropped):
         kept_idx = np.nonzero(v & keep)[0]
+        # the donor key includes the read's OWN alignment start, so each
+        # mate side (and each distinct minority start) gets its own
+        # donor — keying by family alone let the first kept mate shadow
+        # rescues whose span matched a later same-POS kept read
+        # (advisor r4 finding)
         famk = _family_cols(pos_key, umi, kept_idx)
-        famk = np.column_stack([famk, sab[kept_idx].astype(np.int64)])
+        famk = np.column_stack(
+            [famk, sab[kept_idx].astype(np.int64), rp[kept_idx].astype(np.int64)]
+        )
         dfam = _family_cols(pos_key, umi, dropped)
-        dfam = np.column_stack([dfam, sab[dropped].astype(np.int64)])
+        dfam = np.column_stack(
+            [dfam, sab[dropped].astype(np.int64), rp[dropped].astype(np.int64)]
+        )
         # vectorised pre-filter BEFORE any per-record Python: the vote
         # drops a handful of reads but the kept set is the whole chunk —
         # restrict it to rows of families that actually lost a read
@@ -282,13 +292,13 @@ def softclip_rescue(
         for row, i in zip(map(tuple, famk.tolist()), kept_idx.tolist()):
             modal_of.setdefault(row, i)
         l_cap = bases.shape[1]
-        rp = np.asarray(read_pos)
         for row, i in zip(map(tuple, dfam.tolist()), dropped.tolist()):
             m = modal_of.get(row)
             if m is None:
-                continue  # whole family dropped elsewhere (not by the vote)
-            if rp[i] != rp[m]:
-                continue  # other mate / shifted alignment: NOT the same span
+                # no kept read shares this (family, strand, own-POS):
+                # other mate / shifted alignment, or the whole family
+                # was dropped elsewhere (not by the vote)
+                continue
             lead_r, core_r, _tr, qlen = _cigar_edges(get_cigar(i))
             lead_m, core_m, _tm, _q = _cigar_edges(get_cigar(m))
             if not core_r or core_r != core_m or lead_m + qlen > l_cap:

@@ -139,13 +139,26 @@ def ssc_kernel(
     only (cons_base, fam_size, fam_valid). The depth>0 masking is
     recovered exactly from the loglik sign (strictly negative iff any
     read contributed — see the inline proof), so fit-mode cons_base is
-    bit-identical to the full pass's.
+    bit-identical to the full pass's UP TO the fam_valid mask: the full
+    pass additionally blanks sub-min_reads families to BASE_N; fit mode
+    returns the unmasked argmax and the caller must apply the returned
+    fam_valid itself (the pipeline does). Exception: method="runsum" keeps
+    its depth columns even in fit mode — its prefix-difference sums can
+    cancel a tiny loglik to exact 0.0, so the sign test is unsound
+    there (advisor r4); the depth>0 mask is used instead.
     """
     r, l = bases.shape
-    want_depth = columns != "fit"
     if columns not in ("full", "fit"):
         raise ValueError(f"unknown ssc columns mode {columns!r}")
-    if not want_depth and want_err:
+    fit_mode = columns == "fit"
+    # runsum family sums are differences of two large prefix sums; a
+    # tiny contribution (lone Phred-90 read, loglik ~ -1e-9) can cancel
+    # to exact 0.0 against ~1e6-magnitude prefixes, so the sign test
+    # that replaces the depth>0 mask below is unsound for it. Keep the
+    # depth columns (integer prefix sums are exact below 2^24, so their
+    # differences never cancel) and mask on depth instead.
+    want_depth = (not fit_mode) or method == "runsum"
+    if fit_mode and want_err:
         raise ValueError("columns='fit' is incompatible with want_err")
     ok = valid & (family_id >= 0)
     fid = jnp.where(ok, family_id, f_max)  # overflow row, sliced off below
@@ -172,12 +185,12 @@ def ssc_kernel(
                 big, fid, f_max=f_max, interpret=(method == "pallas_interpret")
             )
     elif method in ("blockseg", "runsum"):
-        # Family ids are dense ranks (group_kernel contract), so after a
-        # stable sort by id every family is one contiguous run AND any T
-        # consecutive sorted rows span at most T distinct — hence
-        # CONSECUTIVE — id values (every id in [0, n_fam) has >= 1 read).
-        # The u8 inputs are permuted (cheap) so the f32 evidence rows are
-        # built directly in family order.
+        # After a stable sort by id every family is one contiguous run,
+        # and any T consecutive sorted rows hold at most T DISTINCT id
+        # values — true for any id layout, including the sparse strided
+        # duplex ids (molecule*2 + strand) where single-strand molecules
+        # leave gaps. The u8 inputs are permuted (cheap) so the f32
+        # evidence rows are built directly in id order.
         perm = jnp.argsort(fid, stable=True)
         sfid = jnp.take(fid, perm)
         sok = jnp.take(ok, perm)
@@ -207,12 +220,18 @@ def ssc_kernel(
             out = jnp.take(z, starts[1:], axis=0) - jnp.take(z, starts[:-1], axis=0)
         else:
             # blockseg: per-block local one-hot GEMMs. Within block k of
-            # T sorted rows, local = fid - fid[first] is in [0, T], so a
-            # (T, T+1) one-hot reduces the block exactly; block partials
-            # (at most 2 blocks share a family boundary) are scatter-
-            # added into the dense family rows. 2*R*(T+1)*C FLOPs vs the
-            # dense method's 2*R*(F+1)*C — an F/T reduction with no
-            # prefix cancellation.
+            # T sorted rows, `local` is the row's RANK among the block's
+            # distinct ids (cumsum of change flags), which always fits
+            # in [0, T) no matter how sparse the id values are — the
+            # earlier offset form (fid - fid[first]) silently corrupted
+            # rows whenever a block spanned > T id values, which the
+            # strided duplex ids (gaps at single-strand molecules) hit
+            # on singleton-heavy data (advisor r4, high). A (T, T)
+            # one-hot reduces the block exactly; block partials (at most
+            # 2 blocks share a family boundary) are scatter-added into
+            # the family rows via a per-rank destination table. 2*R*T*C
+            # FLOPs vs the dense method's 2*R*(F+1)*C — an F/T reduction
+            # with no prefix cancellation.
             t = min(blockseg_t, r)
             nb = -(-r // t)
             pad = nb * t - r
@@ -222,14 +241,16 @@ def ssc_kernel(
                     [sfid, jnp.full((pad,), f_max, jnp.int32)]
                 )
             sfid2 = sfid.reshape(nb, t)
-            f0 = sfid2[:, 0]
-            # rows whose id falls outside [f0, f0+T) are only the f_max
-            # padding/invalid rows — their evidence is all-zero (the ok
-            # mask zeroes every column), so clipping them anywhere is
-            # harmless
-            local = jnp.clip(sfid2 - f0[:, None], 0, t)
+            chg = jnp.concatenate(
+                [
+                    jnp.zeros((nb, 1), jnp.int32),
+                    (sfid2[:, 1:] != sfid2[:, :-1]).astype(jnp.int32),
+                ],
+                axis=1,
+            )
+            local = jnp.cumsum(chg, axis=1)  # (nb, t) ranks in [0, t)
             onehot = (
-                local[:, :, None] == jnp.arange(t + 1, dtype=jnp.int32)
+                local[:, :, None] == jnp.arange(t, dtype=jnp.int32)
             ).astype(jnp.float32)
             partials = jnp.einsum(
                 "btj,btc->bjc",
@@ -237,8 +258,14 @@ def ssc_kernel(
                 big.reshape(nb, t, c),
                 preferred_element_type=jnp.float32,
             )
-            dest = jnp.minimum(
-                f0[:, None] + jnp.arange(t + 1, dtype=jnp.int32)[None, :], f_max
+            # the id occupying each rank slot; unused slots keep f_max
+            # and are dropped with the padding/invalid rows below.
+            # Duplicate (block, rank) indices all write the same id, so
+            # the scatter is deterministic.
+            dest = (
+                jnp.full((nb, t), f_max, jnp.int32)
+                .at[jnp.arange(nb, dtype=jnp.int32)[:, None], local]
+                .set(sfid2)
             )
             out = (
                 jnp.zeros((f_max + 1, c), jnp.float32)
@@ -249,7 +276,7 @@ def ssc_kernel(
         raise ValueError(f"unknown ssc method {method!r}")
 
     loglik = out[:, : 4 * l].reshape(f_max, l, 4)
-    if not want_depth:
+    if fit_mode:
         # fit mode: argmax + family size only. Zero-evidence masking
         # WITHOUT depth columns: every contributing read's loglik terms
         # are strictly negative (log(e/3) < log(1/3) and log1p(-e) < 0
@@ -259,9 +286,15 @@ def ssc_kernel(
         # the depth > 0 test of the full pass. This matters when
         # min_input_qual > 0: a cycle whose reads are all sub-threshold
         # must yield BASE_N so the fit excludes those reads, matching
-        # the oracle (review r4 finding).
-        fam_size = out[:, 4 * l].astype(jnp.int32)
-        has_evidence = jnp.max(loglik, axis=-1) < 0
+        # the oracle (review r4 finding). The sign argument needs exact
+        # per-family sums; runsum keeps its depth columns (see above)
+        # and masks on those instead.
+        if want_depth:  # runsum: exact integer depth, sound mask
+            fam_size = out[:, 5 * l].astype(jnp.int32)
+            has_evidence = out[:, 4 * l : 5 * l] > 0
+        else:
+            fam_size = out[:, 4 * l].astype(jnp.int32)
+            has_evidence = jnp.max(loglik, axis=-1) < 0
         cons_base = jnp.where(
             has_evidence, jnp.argmax(loglik, axis=-1), BASE_N
         ).astype(jnp.int32)

@@ -218,7 +218,7 @@ def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
         fl += 2.0 * f * r * cols  # dense one-hot GEMM
     elif spec.ssc_method == "blockseg":
         t = min(spec.blockseg_t, r)
-        fl += 2.0 * r * (t + 1) * cols  # block-local GEMMs
+        fl += 2.0 * r * t * cols  # block-local rank one-hot GEMMs
     else:
         # pallas/segment/runsum perform ~the useful reduction FLOPs only
         fl += 2.0 * r * cols

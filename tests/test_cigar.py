@@ -344,3 +344,69 @@ def test_softclip_rescue_requires_same_alignment_start(tmp_path):
     assert info["n_rescued_cigar"] == 0
     assert info["n_dropped_cigar"] == 1
     assert not np.asarray(batch.valid)[3]
+
+
+def test_softclip_rescue_per_mate_donor(tmp_path):
+    """Each (family, strand, own-POS) side gets its OWN rescue donor:
+    when R1 copies sort first, a family-keyed donor table would pick an
+    R1 donor and then skip the R2 minority on the own-POS guard — a
+    missed rescue (advisor r4). With the POS in the donor key, the R2
+    soft-clip variant is rescued against a kept R2."""
+    from duplexumiconsensusreads_tpu.constants import BASE_PAD
+    from duplexumiconsensusreads_tpu.io.bam import (
+        FLAG_PAIRED,
+        FLAG_READ1,
+        FLAG_READ2,
+        FLAG_REVERSE,
+        BamHeader,
+        BamRecords,
+        write_bam,
+    )
+
+    rng = np.random.default_rng(9)
+    L = 40
+    # 3 R1 at pos 100 + 2 R2 at pos 250 share the modal cigar; one R2
+    # at pos 250 is a soft-clip variant of the same 30M core
+    cigs = [
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(5, "S"), (30, "M"), (5, "S")],
+        [(3, "S"), (30, "M"), (7, "S")],
+    ]
+    n = len(cigs)
+    flags = np.array(
+        [FLAG_PAIRED | FLAG_READ1] * 3
+        + [FLAG_PAIRED | FLAG_READ2 | FLAG_REVERSE] * 3,
+        np.uint16,
+    )
+    pos = np.array([100, 100, 100, 250, 250, 250], np.int32)
+    next_pos = np.where(pos == 100, 250, 100).astype(np.int32)
+    recs = BamRecords(
+        names=[f"t{i}" for i in range(n)],
+        flags=flags,
+        ref_id=np.zeros(n, np.int32),
+        pos=pos,
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.zeros(n, np.int32),
+        next_pos=next_pos,
+        tlen=np.zeros(n, np.int32),
+        lengths=np.full(n, L, np.int32),
+        seq=rng.integers(0, 4, (n, L)).astype(np.uint8),
+        qual=np.full((n, L), 30, np.uint8),
+        cigars=cigs,
+        umi=["ACGTAA"] * n,
+        aux_raw=[b"RXZACGTAA\x00"] * n,
+    )
+    path = str(tmp_path / "mate_donor.bam")
+    write_bam(path, BamHeader.synthetic(sort_order="coordinate"), recs)
+    _, r2 = read_bam(path)
+    batch, info = records_to_readbatch(r2, duplex=False)
+    assert info["n_rescued_cigar"] == 1
+    assert np.asarray(batch.valid).all()
+    # rescued row 5: its 30M core (query 3..32) lands at the R2 donor's
+    # modal lead (cycles 5..34)
+    b = np.asarray(batch.bases)
+    np.testing.assert_array_equal(b[5, 5:35], np.asarray(r2.seq)[5, 3:33])
+    assert (b[5, :5] == BASE_PAD).all() and (b[5, 35:] == BASE_PAD).all()

@@ -311,3 +311,102 @@ class TestPallasSegmentGemm:
                 np.asarray(y).astype(np.float64),
                 atol=1,  # qual may differ by 1 at f32 sum-order boundaries
             )
+
+class TestBlocksegSparseIds:
+    """blockseg must be exact for SPARSE reduction ids: the strided
+    duplex path keys the ssc by molecule*2 + strand, so single-strand
+    molecules leave id gaps and a sorted block of T rows can span up to
+    2T id values. The earlier offset-based routing (fid - fid[first],
+    clipped to T) silently scatter-added out-of-window families into a
+    neighbour's consensus row (advisor r4, high); the rank-based
+    routing has no density assumption."""
+
+    def test_direct_sparse_ids_exact(self):
+        from duplexumiconsensusreads_tpu.kernels.consensus import ssc_kernel
+
+        rng = np.random.default_rng(17)
+        # singletons at even ids only: a block of T=8 rows spans 16 ids
+        k = 96
+        ids = (np.arange(k, dtype=np.int32) * 2)
+        l = 24
+        bases = rng.integers(0, 4, (k, l)).astype(np.uint8)
+        quals = rng.integers(20, 41, (k, l)).astype(np.uint8)
+        valid = np.ones(k, bool)
+        a = ssc_kernel(bases, quals, ids, valid, f_max=2 * k, method="matmul")
+        b = ssc_kernel(
+            bases, quals, ids, valid, f_max=2 * k, method="blockseg",
+            blockseg_t=8,
+        )
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_duplex_blockseg_singleton_families(self):
+        """Full strided-duplex pipeline with blockseg on singleton-heavy
+        data: half the molecules lose their BA strand entirely, so the
+        strided ids are gappy exactly where the old blockseg corrupted.
+        Mean family size 1 keeps blocks spanning many molecules."""
+        import dataclasses as dc
+
+        cfg = SimConfig(
+            n_molecules=300, duplex=True, mean_family_size=1,
+            max_family_size=2, seed=23,
+        )
+        batch, truth = simulate_batch(cfg)
+        # drop the BA strand of every even molecule -> strided-id gaps
+        drop = (truth.read_mol % 2 == 0) & ~truth.read_strand
+        sub = batch.take(np.nonzero(~drop)[0])
+        gp = GroupingParams(strategy="exact", paired=True)
+        cp = ConsensusParams(mode="duplex", min_duplex_reads=1)
+        buckets = build_buckets(sub, capacity=512, grouping=gp)
+        ref_spec = spec_for_buckets(buckets, gp, cp, ssc_method="matmul")
+        new_spec = dc.replace(
+            spec_for_buckets(buckets, gp, cp, ssc_method="blockseg"),
+            blockseg_t=16,
+        )
+        # the scenario must actually exercise the strided path
+        assert new_spec.consensus.mode == "duplex"
+        checked = 0
+        for bk in buckets:
+            a = run_bucket(bk, ref_spec)
+            b = run_bucket(bk, new_spec)
+            for key in ("family_id", "cons_base", "cons_qual",
+                        "cons_depth", "cons_valid"):
+                np.testing.assert_array_equal(
+                    np.asarray(a[key]), np.asarray(b[key]), err_msg=key
+                )
+            checked += int(np.asarray(a["cons_valid"]).sum())
+        assert checked > 100
+
+
+def test_runsum_fit_mode_uses_depth_mask():
+    """columns='fit' under runsum: a lone high-qual read's ~1e-9 loglik
+    cancels to exact 0.0 against the large prefix sums, so the sign
+    test that replaces the depth>0 mask misses its evidence (advisor
+    r4). runsum must keep depth columns in fit mode and match the full
+    pass's calls exactly."""
+    from duplexumiconsensusreads_tpu.kernels.consensus import ssc_kernel
+
+    rng = np.random.default_rng(3)
+    l = 16
+    # family 0: 64 qual-30 reads all base A -> prefix magnitude ~0.064
+    # per match column, ulp >> 1e-9; family 1: one qual-90 read whose
+    # match-column contribution log1p(-1e-9) ~ -1e-9 vanishes into it
+    n0 = 64
+    bases = np.zeros((n0 + 1, l), np.uint8)
+    quals = np.concatenate(
+        [np.full((n0, l), 30, np.uint8), np.full((1, l), 90, np.uint8)]
+    )
+    ids = np.concatenate(
+        [np.zeros(n0, np.int32), np.ones(1, np.int32)]
+    )
+    valid = np.ones(n0 + 1, bool)
+    kw = dict(f_max=4, min_reads=1, max_input_qual=90, method="runsum")
+    full_b, _fq, full_d, _sz, _fv = ssc_kernel(
+        bases, quals, ids, valid, **kw
+    )
+    fit_b, _fsz, _ffv = ssc_kernel(
+        bases, quals, ids, valid, columns="fit", **kw
+    )
+    # the lone read's family must be CALLED (base A), not masked to N
+    assert (np.asarray(full_d)[1] > 0).all()
+    np.testing.assert_array_equal(np.asarray(fit_b), np.asarray(full_b))
