@@ -22,7 +22,10 @@ wall-clock reads/s including ingest + write.
 Env knobs: DUT_BENCH_READS (default 600000), DUT_BENCH_CAPACITY (2048),
 DUT_BENCH_CPU_SAMPLE (3000), DUT_BENCH_REPS (10),
 DUT_BENCH_E2E_READS (default 10000000; 0 disables the e2e phase),
-DUT_BENCH_CACHE (default .bench_cache).
+DUT_BENCH_E2E_AB (A/B leg size, default 2000000; 0 disables),
+DUT_BENCH_AB_BUDGET_S (A/B wall budget the legs shrink to fit, 480),
+DUT_BENCH_WIRE_MB (wire probe payload, 32), DUT_BENCH_CPU_E2E_REPS (2),
+DUT_BENCH_VEC_REPS (3), DUT_BENCH_CACHE (default .bench_cache).
 """
 
 from __future__ import annotations
@@ -40,6 +43,52 @@ import numpy as np
 # with identical params, or e2e_vs_cpu_e2e compares different work
 E2E_CHUNK_READS = 500_000
 E2E_MAX_INFLIGHT = 4
+
+
+def wire_probe(mb: int | None = None) -> dict:
+    """Measure the raw host<->device wire, both directions, with a
+    ~mb-MB uint8 payload. On a tunneled chip the wire varies ~3x
+    intra-day (r4: same-day e2e runs spanned 9.4-31.0k reads/s with no
+    code change); emitting the measured bandwidth beside every e2e
+    capture turns "tunnel weather" from an assertion into a per-capture
+    fact, and bytes/bandwidth gives an arithmetic floor for the e2e
+    wall (VERDICT r4 item 1a). The device->host fetch of a 1-element
+    slice is the true h2d barrier — block_until_ready returns early on
+    tunneled platforms (measured r3)."""
+    import jax
+
+    if mb is None:
+        mb = int(os.environ.get("DUT_BENCH_WIRE_MB", 32))
+    dev = jax.devices()[0]
+    payload = np.random.default_rng(0).integers(
+        0, 256, size=(mb << 20,), dtype=np.uint8
+    )
+    # warm the FULL-SHAPE path untimed: the [:1] barrier below is a
+    # jit-compiled slice keyed on the payload shape, and a cold compile
+    # (seconds over the tunnel) would land inside the first probe's
+    # timing only — systematically skewing the before/after bracket
+    # this probe exists to make trustworthy (review r5 finding)
+    warm = jax.device_put(payload, dev)
+    np.asarray(warm[:1])
+    warm.delete()
+    t0 = time.time()
+    x = jax.device_put(payload, dev)
+    np.asarray(x[:1])  # true completion barrier (1-elem fetch)
+    h2d_s = time.time() - t0
+    t0 = time.time()
+    back = np.asarray(x)
+    d2h_s = time.time() - t0
+    assert back[-1] == payload[-1]
+    x.delete()
+    # decimal MB/s: the e2e byte counters report bytes/1e6, and the
+    # floor arithmetic divides one by the other — mixing MiB into the
+    # bandwidth side would bias every floor ~4.6% low (review r5)
+    dec_mb = (mb << 20) / 1e6
+    return {
+        "wire_mb": mb,
+        "wire_h2d_mb_s": round(dec_mb / max(h2d_s, 1e-9), 1),
+        "wire_d2h_mb_s": round(dec_mb / max(d2h_s, 1e-9), 1),
+    }
 
 
 def _e2e_params():
@@ -125,6 +174,11 @@ def run_e2e(n_target: int, packed: str = "auto", prefix: str = "e2e") -> dict:
         # DUT_SSC_METHOD only steers the compute phase, and the JSON
         # must not attribute e2e numbers to the wrong kernel
         f"{prefix}_ssc_method": default_ssc_method(),
+        # measured wire payload of this run (device inputs dispatched /
+        # outputs materialised) — divides against the wire probe's MB/s
+        # for the arithmetic wall floor
+        f"{prefix}_h2d_mb": round(rep.bytes_h2d / 1e6, 1),
+        f"{prefix}_d2h_mb": round(rep.bytes_d2h / 1e6, 1),
         # per-phase host wall breakdown (VERDICT r2 item 2); on a
         # 1-core host the phases sum to ~the wall clock
         f"{prefix}_phases": {k: v for k, v in rep.seconds.items() if k != "total"},
@@ -271,23 +325,40 @@ print(json.dumps({{"reads": rep.n_records, "wall": wall,
                    "phases": rep.seconds}}))
 """
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run(
-        [_sys.executable, "-c", child], capture_output=True, text=True, env=env
-    )
+    # The denominator is as weather-sensitive as the numerator on this
+    # contended 1-core box (r4: vs_vectorized_cpu swung 35.9 -> 48.6
+    # between same-day runs). Run the subprocess >= 2x back to back —
+    # strictly while the TPU is idle — and report the BEST run: the
+    # fastest CPU is the honest denominator for a >= 50x claim
+    # (VERDICT r4 item 4).
+    reps = max(1, int(os.environ.get("DUT_BENCH_CPU_E2E_REPS", 2)))
+    best = None
+    walls = []
     try:
-        os.remove(out_path)
-    except OSError:
-        pass
-    if proc.returncode != 0:
-        sys.stderr.write(proc.stderr[-2000:])
-        return {"cpu_e2e_error": f"exit {proc.returncode}"}
-    r = json.loads(proc.stdout.strip().splitlines()[-1])
+        for _ in range(reps):
+            proc = subprocess.run(
+                [_sys.executable, "-c", child], capture_output=True,
+                text=True, env=env,
+            )
+            if proc.returncode != 0:
+                sys.stderr.write(proc.stderr[-2000:])
+                return {"cpu_e2e_error": f"exit {proc.returncode}"}
+            r = json.loads(proc.stdout.strip().splitlines()[-1])
+            walls.append(round(r["wall"], 2))
+            if best is None or r["wall"] < best["wall"]:
+                best = r
+    finally:
+        try:
+            os.remove(out_path)
+        except OSError:
+            pass
     return {
-        "cpu_e2e_reads": r["reads"],
-        "cpu_e2e_wall_s": round(r["wall"], 2),
-        "cpu_e2e_reads_per_sec": round(r["reads"] / r["wall"], 1),
+        "cpu_e2e_reads": best["reads"],
+        "cpu_e2e_wall_s": round(best["wall"], 2),
+        "cpu_e2e_walls": walls,
+        "cpu_e2e_reads_per_sec": round(best["reads"] / best["wall"], 1),
         "cpu_e2e_phases": {
-            k: v for k, v in r["phases"].items() if k != "total"
+            k: v for k, v in best["phases"].items() if k != "total"
         },
     }
 
@@ -473,10 +544,16 @@ def main() -> None:
     with jax.default_device(cpu_dev):
         outs = [run_bucket(bk, cs) for bk, cs in sample]  # compile
         jax.block_until_ready(outs)
-        t0 = time.time()
-        outs = [run_bucket(bk, cs) for bk, cs in sample]
-        jax.block_until_ready(outs)
-        vec_cpu_s = time.time() - t0
+        # best of N timed passes: the 1-core box's scheduling noise
+        # hits the denominator too, and the fastest CPU pass is the
+        # honest one for the >= 50x claim (VERDICT r4 item 4)
+        vec_reps = max(1, int(os.environ.get("DUT_BENCH_VEC_REPS", 3)))
+        vec_cpu_s = float("inf")
+        for _ in range(vec_reps):
+            t0 = time.time()
+            outs = [run_bucket(bk, cs) for bk, cs in sample]
+            jax.block_until_ready(outs)
+            vec_cpu_s = min(vec_cpu_s, time.time() - t0)
     vec_cpu_rps = got / max(vec_cpu_s, 1e-9)
 
     result = {
@@ -494,48 +571,72 @@ def main() -> None:
     if int(os.environ.get("DUT_BENCH_PER_CONFIG", 1)):
         result["per_config"] = run_per_config(mesh)
 
-    # ---- end-to-end phase: wall-clock through the streaming pipeline
+    # ---- end-to-end phase: wall-clock through the streaming pipeline.
+    # Phase order is pinned (VERDICT r4 item 4): wire probe, TPU e2e,
+    # wire probe again, the packed/unpacked A/B pair, then the CPU
+    # denominator runs strictly after all device work is idle.
     n_e2e = int(os.environ.get("DUT_BENCH_E2E_READS", 10_000_000))
     if n_e2e > 0:
+        probe0 = wire_probe()
+        result["wire_before_e2e"] = probe0
         e2e = run_e2e(n_e2e)
         result.update(e2e)
         result["e2e_vs_compute"] = round(
             e2e["e2e_reads_per_sec"] / tpu_rps, 3
         )
-        # same-run packed-vs-unpacked A/B on the identical input: the
-        # wire-packing win must be driver-captured, not README prose
-        # (VERDICT r3 item 5); DUT_BENCH_E2E_AB=0 skips. The pair is
-        # only fair on WARM compile caches — a layout change recompiles
-        # every streaming geometry (~30-40s each over the tunnel) and
-        # charges it all to whichever side runs cold (measured r4:
-        # cold packed 14.4k vs warm 31.0k reads/s on the same input)
-        n_ab = int(os.environ.get("DUT_BENCH_E2E_AB", n_e2e))
-        # weather guard: if the packed leg already ran slow (bad tunnel
-        # day), the pair would be weather noise AND doubling a slow e2e
-        # risks an external capture timeout losing the WHOLE json line
-        # (it only prints at the end) — skip and say so
+        probe1 = wire_probe()
+        result["wire_after_e2e"] = probe1
+        # arithmetic wall floor: measured bytes over measured wire,
+        # bracketed by the probes on either side of the run. When
+        # frac ~ 1 the JSON itself proves the tunnel, not the code, set
+        # the wall (VERDICT r4 item 1: "tunnel weather" must be a
+        # measured per-capture fact, not an assertion)
+        floors = [
+            e2e["e2e_h2d_mb"] / p["wire_h2d_mb_s"]
+            + e2e["e2e_d2h_mb"] / p["wire_d2h_mb_s"]
+            for p in (probe0, probe1)
+        ]
+        result["e2e_wire_floor_s"] = [round(min(floors), 1), round(max(floors), 1)]
+        result["e2e_wire_floor_frac"] = [
+            round(min(floors) / e2e["e2e_wall_s"], 2),
+            round(max(floors) / e2e["e2e_wall_s"], 2),
+        ]
+        # same-run packed-vs-unpacked A/B: BOTH legs run here, same
+        # size, adjacent in time, warm caches — r4's guard compared a
+        # full-size unpacked leg against a budget the packed leg had
+        # already blown, so it self-disabled on exactly the host it was
+        # built for and erased the round's A/B evidence (VERDICT r4
+        # weak 1). Now the legs SHRINK to fit the budget instead of
+        # skipping; DUT_BENCH_E2E_AB=0 disables.
+        n_ab = int(os.environ.get("DUT_BENCH_E2E_AB", 2_000_000))
         ab_budget = float(os.environ.get("DUT_BENCH_AB_BUDGET_S", 480))
-        # the guard compares the UNPACKED leg's expected time (scaled by
-        # its read count — a reduced DUT_BENCH_E2E_AB is proportionally
-        # cheaper); 0 disables the guard like the other 0-knobs here
-        ab_expected_s = e2e["e2e_wall_s"] * (n_ab / max(n_e2e, 1))
-        if n_ab > 0 and ab_budget > 0 and ab_expected_s > ab_budget:
-            result["e2e_ab_skipped"] = (
-                f"expected unpacked leg ~{ab_expected_s:.0f}s > "
-                f"{ab_budget:.0f}s budget (packed leg took "
-                f"{e2e['e2e_wall_s']}s)"
-            )
-            n_ab = 0
         if n_ab > 0:
+            exp_s = 2.0 * n_ab / max(e2e["e2e_reads_per_sec"], 1.0)
+            if ab_budget > 0 and exp_s > ab_budget:
+                # quantize to whole chunks: the leg size feeds the
+                # input-BAM cache key, and a weather-dependent arbitrary
+                # integer would simulate+cache a fresh multi-hundred-MB
+                # input on every budget-limited run (review r5 finding)
+                n_ab = min(
+                    n_ab,
+                    max(
+                        int(n_ab * ab_budget / exp_s) // E2E_CHUNK_READS,
+                        1,
+                    ) * E2E_CHUNK_READS,
+                )
+                result["e2e_ab_shrunk_to"] = n_ab
+            packed_leg = run_e2e(n_ab, packed="auto", prefix="e2e_ab_packed")
+            result.update(packed_leg)
             unpacked = run_e2e(n_ab, packed="off", prefix="e2e_unpacked")
             result.update(unpacked)
             result["e2e_packed_speedup"] = round(
-                e2e["e2e_reads_per_sec"]
+                packed_leg["e2e_ab_packed_reads_per_sec"]
                 / unpacked["e2e_unpacked_reads_per_sec"],
                 3,
             )
         # same pipeline end-to-end on XLA-CPU: the wall-clock >=50x
-        # denominator (DUT_BENCH_CPU_E2E_READS=0 disables)
+        # denominator (DUT_BENCH_CPU_E2E_READS=0 disables); runs after
+        # every TPU leg so the 1-core box is never shared
         n_cpu_e2e = int(os.environ.get("DUT_BENCH_CPU_E2E_READS", 1_000_000))
         if n_cpu_e2e > 0:
             cpu_e2e = run_cpu_e2e(n_cpu_e2e)

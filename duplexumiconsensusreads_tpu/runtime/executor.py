@@ -63,6 +63,12 @@ class RunReport:
     n_dropped_cigar_ba: int = 0
     mate_aware: bool = False  # resolved mate-aware mode of this run
     backend: str = ""
+    # wire accounting (streaming): bytes of device-input tensors
+    # dispatched and device-output tensors materialised. Together with
+    # a measured wire-bandwidth probe these turn "the tunnel was slow"
+    # from an assertion into arithmetic (bytes / MB/s ~ observed wall).
+    bytes_h2d: int = 0
+    bytes_d2h: int = 0
     seconds: dict = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:

@@ -817,6 +817,9 @@ def stream_call_consensus(
             # dominant streaming phase on a tunneled chip (see the
             # per-phase breakdown in RunReport.seconds)
             pack_stacked(stacked)
+        h2d = sum(
+            v.nbytes for v in stacked.values() if hasattr(v, "nbytes")
+        )
         # start the device->host copies of the consumed keys right at
         # dispatch: by drain time the results are already on the host,
         # so the tunnel's per-fetch latency overlaps with compute
@@ -827,6 +830,7 @@ def stream_call_consensus(
         dt = time.time() - t0
         with phase_lock:  # dict += from concurrent workers would race
             phase["dispatch"] += dt
+            rep.bytes_h2d += h2d
         return out
 
     def materialize(out, cbuckets, cspec, k):
@@ -898,6 +902,9 @@ def stream_call_consensus(
             t0 = time.time()
             out = materialize(out, cbuckets, cspec, k)
             phase["device_wait_fetch"] += time.time() - t0
+            rep.bytes_d2h += sum(
+                v.nbytes for v in out.values() if hasattr(v, "nbytes")
+            )
             rep.n_families += int(out["n_families"].sum())
             rep.n_molecules += int(out["n_molecules"].sum())
             t0 = time.time()

@@ -379,3 +379,54 @@ def test_view_colon_contig_and_unmapped_tail(tmp_path, capsys):
     assert main(["view", path, "HLA-A*01:01:01:01:1-100000", "--json"]) == 0
     res = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert res["n_records"] == n - 2
+
+
+def test_bai_refuses_contig_over_512mbp(tmp_path):
+    """BAI bins address coordinates < 2^29; a longer contig must be
+    refused loudly (pointing at CSI) rather than silently mis-indexed
+    (VERDICT r4 item 8)."""
+    path = str(tmp_path / "long.bam")
+    n, L = 4, 24
+    rng = np.random.default_rng(2)
+    recs = BamRecords(
+        names=[f"r{i}" for i in range(n)],
+        flags=np.zeros(n, np.uint16),
+        ref_id=np.zeros(n, np.int32),
+        pos=np.arange(n, dtype=np.int32) * 100,
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.full(n, -1, np.int32),
+        next_pos=np.full(n, -1, np.int32),
+        tlen=np.zeros(n, np.int32),
+        lengths=np.full(n, L, np.int32),
+        seq=rng.integers(0, 4, (n, L)).astype(np.uint8),
+        qual=np.full((n, L), 30, np.uint8),
+        cigars=[[(L, "M")] for _ in range(n)],
+        umi=[""] * n,
+        aux_raw=[b"" for _ in range(n)],
+    )
+    header = BamHeader.synthetic(
+        ref_names=("big1",), ref_lengths=(600_000_000,),
+        sort_order="coordinate",
+    )
+    write_bam(path, header, recs)
+    with pytest.raises(ValueError, match="2\\^29.*CSI|CSI"):
+        build_bai(path)
+
+
+def test_bai_scale_indexes_fast(tmp_path):
+    """The vectorised builder must index ~100k records in seconds, not
+    minutes (VERDICT r4 item 7: the per-record walk cost minutes of
+    host time per million records on the 200M-read critical path)."""
+    import time
+
+    path = str(tmp_path / "big.bam")
+    _multi_ref_bam(path, n_per_ref=50_000, n_ref=2, seed=3)
+    t0 = time.time()
+    build_bai(path)
+    dt = time.time() - t0
+    idx = read_bai(path + ".bai")
+    total = sum(r["meta"][2] + r["meta"][3] for r in idx["refs"])
+    assert total == 100_000
+    # generous bound for a contended 1-core box; the per-record walk
+    # took ~40s+ here and scales linearly
+    assert dt < 15, f"build_bai took {dt:.1f}s for 100k records"
