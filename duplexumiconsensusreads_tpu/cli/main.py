@@ -141,6 +141,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write the standard .bai binning index beside the "
         "output (output is always coordinate-sorted)",
     )
+    c.add_argument(
+        "--ref-projected",
+        action="store_true",
+        default=None,
+        help="project reads onto per-position reference columns instead "
+        "of raw cycles: indel-bearing minority reads contribute "
+        "realigned evidence instead of being dropped, and consensus "
+        "records carry a structural-majority CIGAR (M/I/D). Whole-file "
+        "executor only; BAM input only",
+    )
 
     s = sub.add_parser("simulate", help="write a truth-aware synthetic BAM")
     s.add_argument("-o", "--output", required=True, help="output BAM path")
@@ -335,6 +345,7 @@ def _load_config_file(path: str) -> dict:
         "min_input_qual", "capacity", "devices", "cycle_shards",
         "chunk_reads", "max_inflight", "config", "mate_aware", "max_reads",
         "per_base_tags", "read_group_id", "write_index", "count_ratio",
+        "ref_projected",
     }
     unknown = set(conf) - allowed
     if unknown:
@@ -397,6 +408,23 @@ def _cmd_call(args) -> int:
     write_index = bool(opt("write_index", False))
     if write_index and not args.output.endswith(".bam"):
         raise SystemExit("--write-index requires a .bam output path")
+    ref_projected = bool(opt("ref_projected", False))
+    if ref_projected:
+        if args.input.endswith(".npz"):
+            raise SystemExit(
+                "--ref-projected requires BAM input (the .npz "
+                "interchange carries no CIGARs)"
+            )
+        if chunk_reads > 0 or args.n_hosts > 0:
+            raise SystemExit(
+                "--ref-projected runs on the whole-file executor "
+                "(omit --chunk-reads / --n-hosts)"
+            )
+        if mate_aware == "on":
+            raise SystemExit(
+                "--ref-projected does not support mate-aware pairing yet"
+            )
+        mate_aware = "off"
 
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour
@@ -542,6 +570,7 @@ def _cmd_call(args) -> int:
             per_base_tags=per_base_tags,
             read_group=read_group,
             write_index=write_index,
+            ref_projected=ref_projected,
         )
     pairs = f", {rep.n_consensus_pairs} R1+R2 pairs" if rep.mate_aware else ""
     print(
@@ -631,9 +660,11 @@ def _cmd_validate(args) -> int:
     _, truth_pos = unpack_pos_key(pack_pos_key(np.zeros(len(mol_pos_key)), mol_pos_key))
     index = {}
     by_pos: dict = {}
+    by_umi: dict = {}
     for m in range(len(mol_seq)):
         index[(int(truth_pos[m]), mol_umi[m].tobytes())] = m
         by_pos.setdefault(int(truth_pos[m]), []).append(m)
+        by_umi.setdefault(mol_umi[m].tobytes(), []).append(m)
 
     # pass 1: exact matches + error rate
     n_match = n_err = n_base = 0
@@ -641,8 +672,22 @@ def _cmd_validate(args) -> int:
     matched_mols: set = set()
     for i in range(len(recs)):
         codes = umi_string_to_codes(recs.umi[i])
-        key = (int(recs.pos[i]), codes.tobytes() if codes is not None else b"")
-        m = index.get(key)
+        ub = codes.tobytes() if codes is not None else b""
+        m = index.get((int(recs.pos[i]), ub))
+        if m is None:
+            # ref-projected records move POS to the first called
+            # reference column, which can differ from the canonical
+            # pos_key coordinate (e.g. uniformly soft-clipped starts) —
+            # fall back to the nearest same-UMI truth molecule within a
+            # read length, so moved-POS records still validate instead
+            # of silently leaving the error-rate denominator
+            w = int(recs.lengths[i])
+            cand = [
+                c for c in by_umi.get(ub, ())
+                if abs(int(recs.pos[i]) - int(truth_pos[c])) <= w
+            ]
+            if cand:
+                m = min(cand, key=lambda c: abs(int(recs.pos[i]) - int(truth_pos[c])))
         if m is None:
             unmatched_idx.append((i, codes))
             continue
@@ -651,10 +696,29 @@ def _cmd_validate(args) -> int:
         l = int(recs.lengths[i])
         called = recs.seq[i, :l]
         is_r2 = bool(recs.flags[i] & FLAG_READ2)
-        true = (mol_seq2 if (is_r2 and mol_seq2 is not None) else mol_seq)[m][:l]
-        real = called != 4
-        n_err += int((called[real] != true[real]).sum())
-        n_base += int(real.sum())
+        true_row = (mol_seq2 if (is_r2 and mol_seq2 is not None) else mol_seq)[m]
+        # CIGAR-aware comparison: ref-projected consensus records carry
+        # real M/I/D CIGARs and can start past (or span beyond) the
+        # truth row — walk M runs and compare at reference offsets;
+        # inserted and beyond-truth bases have no truth to compare.
+        # Legacy full-M records reduce to the old direct comparison.
+        p0 = int(recs.pos[i]) - int(truth_pos[m])
+        q = r = 0
+        for nop, op in recs.cigars[i]:
+            if op in "M=X":
+                roff = p0 + r + np.arange(nop)
+                sel = (roff >= 0) & (roff < len(true_row))
+                qs = called[q : q + nop][sel]
+                tr = true_row[roff[sel]]
+                real = qs != 4
+                n_err += int((qs[real] != tr[real]).sum())
+                n_base += int(real.sum())
+                q += nop
+                r += nop
+            elif op in ("I", "S"):
+                q += nop
+            elif op in ("D", "N"):
+                r += nop
 
     # pass 2: classify every unmatched record (VERDICT r1 item 9 —
     # "unmatched" must not be able to hide error-rate regressions):

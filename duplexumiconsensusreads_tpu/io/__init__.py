@@ -20,16 +20,28 @@ from duplexumiconsensusreads_tpu.io.convert import (
 from duplexumiconsensusreads_tpu.io.npz import load_readbatch, save_readbatch
 
 
-def load_input(path: str, duplex: bool, warn_mixed: bool = True):
+def load_input(
+    path: str, duplex: bool, warn_mixed: bool = True,
+    ref_projected: bool = False,
+):
     """ONE input loader for every consumer (call, stats, ...): .npz
     ReadBatch interchange, else native BAM parse when available
     (DUT_NO_NATIVE=1 forces the portable codec), else pure Python.
     Returns (header, batch, info). warn_mixed=False defers the
     mixed-mate warning to the caller (mate-aware auto-resolution
-    decides whether it applies)."""
+    decides whether it applies). ref_projected=True projects reads onto
+    reference columns (io/refproject.py) — BAM inputs only (the .npz
+    interchange carries no CIGARs), via the portable codec (the native
+    fast path hands back a finished batch; projection needs the parsed
+    records)."""
     import os
 
     if path.endswith(".npz"):
+        if ref_projected:
+            raise ValueError(
+                "ref-projected consensus requires BAM input (CIGARs); "
+                ".npz interchange carries none"
+            )
         from duplexumiconsensusreads_tpu.io.convert import mixed_ends_present
 
         batch = load_readbatch(path)
@@ -39,14 +51,16 @@ def load_input(path: str, duplex: bool, warn_mixed: bool = True):
             # when some family actually mixes fragment ends
             "mixed_mates": mixed_ends_present(batch),
         }
-    if not os.environ.get("DUT_NO_NATIVE"):
+    if not ref_projected and not os.environ.get("DUT_NO_NATIVE"):
         from duplexumiconsensusreads_tpu.io.native_reader import read_bam_native
 
         res = read_bam_native(path, duplex=duplex, warn_mixed=warn_mixed)
         if res is not None:
             return res
     header, recs = read_bam(path)
-    batch, info = records_to_readbatch(recs, duplex=duplex, warn_mixed=warn_mixed)
+    batch, info = records_to_readbatch(
+        recs, duplex=duplex, warn_mixed=warn_mixed, ref_projected=ref_projected
+    )
     return header, batch, info
 
 

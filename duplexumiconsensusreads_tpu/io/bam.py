@@ -228,6 +228,29 @@ class BamRecords:
         return len(self.names)
 
 
+def reorder_records(recs: "BamRecords", order) -> "BamRecords":
+    """Row-permute a BamRecords (e.g. restore coordinate order after
+    ref-projected emission moves POS values)."""
+    o = np.asarray(order)
+    ol = o.tolist()
+    return BamRecords(
+        names=[recs.names[i] for i in ol],
+        flags=np.asarray(recs.flags)[o],
+        ref_id=np.asarray(recs.ref_id)[o],
+        pos=np.asarray(recs.pos)[o],
+        mapq=np.asarray(recs.mapq)[o],
+        next_ref_id=np.asarray(recs.next_ref_id)[o],
+        next_pos=np.asarray(recs.next_pos)[o],
+        tlen=np.asarray(recs.tlen)[o],
+        lengths=np.asarray(recs.lengths)[o],
+        seq=np.asarray(recs.seq)[o],
+        qual=np.asarray(recs.qual)[o],
+        cigars=[recs.cigars[i] for i in ol],
+        umi=[recs.umi[i] for i in ol],
+        aux_raw=[recs.aux_raw[i] for i in ol],
+    )
+
+
 _CIGAR_OPS = "MIDNSHP=X"
 
 

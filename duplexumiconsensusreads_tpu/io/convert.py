@@ -475,7 +475,8 @@ def downsample_families(batch, max_reads: int) -> int:
 
 
 def records_to_readbatch(
-    recs: BamRecords, duplex: bool = True, warn_mixed: bool = True
+    recs: BamRecords, duplex: bool = True, warn_mixed: bool = True,
+    ref_projected: bool = False,
 ) -> tuple[ReadBatch, dict]:
     """Convert parsed BAM records into a padded ReadBatch.
 
@@ -485,6 +486,13 @@ def records_to_readbatch(
     so read indices stay aligned with ``recs``. ``warn_mixed=False``
     suppresses the mixed-mate warning (mate-aware callers handle those
     families; the counter still fills).
+
+    ref_projected=True places reads on per-position-group REFERENCE
+    columns instead of cycles (io/refproject.py): indel-bearing reads
+    contribute realigned evidence instead of being dropped, and
+    info["ref_projection"] carries the column metadata the emission
+    side needs. Groups that cannot project (span too wide) keep the
+    classic cycle layout + modal-CIGAR policy.
     """
     n = len(recs)
     l = recs.seq.shape[1] if n else 0
@@ -541,12 +549,32 @@ def records_to_readbatch(
         warn=warn_mixed,
     )
     n_before = int(batch.valid.sum())
+    proj = None
+    if ref_projected:
+        from duplexumiconsensusreads_tpu.io.refproject import ref_project
+
+        pb, pq, proj, fb = ref_project(
+            batch.bases, batch.quals, batch.valid, batch.pos_key,
+            batch.umi, np.asarray(recs.pos), lambda i: recs.cigars[i],
+        )
+        widened = ReadBatch.empty(n, proj.width, umi_len)
+        widened.bases[:] = pb
+        widened.quals[:] = pq
+        for f in ("umi", "pos_key", "strand_ab", "frag_end", "valid"):
+            getattr(widened, f)[:] = getattr(batch, f)
+        batch = widened
+        # the classic policy applies only to the fallback groups, whose
+        # rows kept the cycle layout in columns [0, L)
+        policy_valid = batch.valid & fb
+    else:
+        policy_valid = batch.valid
     keep = modal_cigar_keep(
-        batch.pos_key, batch.umi, batch.valid, cigar_hashes(recs.cigars),
+        batch.pos_key, batch.umi, policy_valid, cigar_hashes(recs.cigars),
         batch.strand_ab,
     )
+    keep |= batch.valid & ~policy_valid  # projected reads are all kept
     rescue_info = softclip_rescue(
-        batch.bases, batch.quals, keep, batch.valid, batch.pos_key,
+        batch.bases, batch.quals, keep, policy_valid, batch.pos_key,
         batch.umi, batch.strand_ab, np.asarray(recs.pos),
         lambda i: recs.cigars[i],
     )
@@ -567,6 +595,11 @@ def records_to_readbatch(
         "mixed_mates": mixed_present,
         "umi_len": umi_len,
     }
+    if proj is not None:
+        info["ref_projection"] = proj
+        info["n_projected_reads"] = proj.n_projected_reads
+        info["n_projection_fallback_reads"] = proj.n_fallback_reads
+        info["n_projection_fallback_groups"] = proj.n_fallback_groups
     return batch, info
 
 
@@ -686,6 +719,7 @@ def consensus_to_records(
     cons_perr: np.ndarray | None = None,  # (F, L) per-base errors -> ce:B,I
     read_group: str | None = None,  # RG:Z on every record (fgbio-style
     # single consensus read group; the header gains the matching @RG)
+    proj=None,  # RefProjection: reference-column emission (io/refproject)
 ) -> BamRecords:
     """Build consensus BAM records from (scattered-back) pipeline output.
 
@@ -704,6 +738,28 @@ def consensus_to_records(
     n = len(idx)
     l = cons_base.shape[1]
     ref_id, pos = unpack_pos_key(fam_pos_key[idx])
+
+    # -------- reference-column emission (--ref-projected) --------
+    # Per row: keep the family's emitted columns, derive the consensus
+    # CIGAR from the structural majorities decided at projection, and
+    # move POS to the first called reference column. Rows whose group
+    # fell back (or called nothing) keep the legacy full-M emission.
+    plan = [None] * n
+    if proj is not None:
+        if paired_out:
+            raise ValueError(
+                "ref-projected emission does not support mate-aware "
+                "paired output yet"
+            )
+        from duplexumiconsensusreads_tpu.io.refproject import emit_columns
+
+        for k in range(n):
+            i = int(idx[k])
+            plan[k] = emit_columns(
+                proj, int(fam_pos_key[i]), fam_umi[i].tobytes(), cons_base[i]
+            )
+            if plan[k] is not None:
+                pos[k] = plan[k][2]
 
     # -------- mate-pair linking (mate-aware emission) --------
     flags_v = np.zeros(n, np.uint16)
@@ -768,6 +824,23 @@ def consensus_to_records(
     ds = np.asarray(cons_dstats, np.int64)[idx]
     cd_bytes = ds[:, 0].astype("<i4").tobytes()
     cm_bytes = ds[:, 1].astype("<i4").tobytes()
+    # per-record emitted lengths + column selections (projection only).
+    # In a projected run the matrices are proj.width wide, but fallback
+    # rows only ever held cycles [0, read_len) — emitting the full width
+    # would pad their SEQ/CIGAR/cd/ce out to the widest projected group.
+    base_len = l if proj is None else proj.read_len
+    lens = np.full(n, base_len, np.int32)
+    for k, p in enumerate(plan):
+        if p is not None:
+            lens[k] = len(p[0])
+
+    def _row_cols(arr, k):
+        """One record's emitted per-base values from a padded (F, C)
+        matrix: the projection's kept columns, or the full row."""
+        p = plan[k]
+        row = np.asarray(arr)[idx[k]]
+        return row[p[0]] if p is not None else row[:base_len]
+
     def _pb_rows(tag: bytes, arr):
         # fgbio-style per-base B array. fgbio emits B,S; we match that
         # whenever every value fits u16, widening to B,I only for jumbo
@@ -775,14 +848,17 @@ def consensus_to_records(
         # u16) — strict fgbio-downstream parsers accept the common case
         import struct as _struct
 
-        rows = np.asarray(arr)[idx]
-        if rows.size == 0 or int(rows.max()) < 65536:
-            sub, width, dt = b"S", 2, "<u2"
+        rows = [_row_cols(arr, k) for k in range(n)]
+        vmax = max((int(r.max()) for r in rows if r.size), default=0)
+        if vmax < 65536:
+            sub, dt = b"S", "<u2"
         else:
-            sub, width, dt = b"I", 4, "<u4"
-        hdr = tag + b"B" + sub + _struct.pack("<I", l)
-        flat = rows.astype(dt).tobytes()
-        return [hdr + flat[width * l * k : width * l * (k + 1)] for k in range(n)]
+            sub, dt = b"I", "<u4"
+        return [
+            tag + b"B" + sub + _struct.pack("<I", int(lens[k]))
+            + rows[k].astype(dt).tobytes()
+            for k in range(n)
+        ]
 
     pd_rows = None if cons_pdepth is None else _pb_rows(b"cd", cons_pdepth)
     pe_rows = None if cons_perr is None else _pb_rows(b"ce", cons_perr)
@@ -816,6 +892,17 @@ def consensus_to_records(
             + (pd_rows[k] if pd_rows is not None else b"")
             + (pe_rows[k] if pe_rows is not None else b"")
         )
+    w_out = int(lens.max()) if n else l
+    seq_m = np.full((n, w_out), 4, np.uint8)
+    qual_m = np.zeros((n, w_out), np.uint8)
+    cigars: list = []
+    for k in range(n):
+        m = int(lens[k])
+        row = _row_cols(cons_base, k)
+        seq_m[k, :m] = np.where(row == BASE_PAD, 4, row)
+        qual_m[k, :m] = _row_cols(cons_qual, k)
+        p = plan[k]
+        cigars.append([(base_len, "M")] if p is None else p[1])
     return BamRecords(
         names=names,
         flags=flags_v,
@@ -825,10 +912,10 @@ def consensus_to_records(
         next_ref_id=next_ref,
         next_pos=next_pos_v,
         tlen=tlen_v,
-        lengths=np.full(n, l, np.int32),
-        seq=np.where(cons_base[idx] == BASE_PAD, 4, cons_base[idx]).astype(np.uint8),
-        qual=cons_qual[idx].astype(np.uint8),
-        cigars=[[(l, "M")] for _ in range(n)],
+        lengths=lens,
+        seq=seq_m,
+        qual=qual_m,
+        cigars=cigars,
         umi=umis,
         aux_raw=aux,
     )

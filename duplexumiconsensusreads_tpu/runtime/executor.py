@@ -61,6 +61,11 @@ class RunReport:
     n_rescued_cigar: int = 0
     n_dropped_cigar_ab: int = 0
     n_dropped_cigar_ba: int = 0
+    # --ref-projected: reads realigned onto reference columns vs groups
+    # (and their reads) that kept the cycle layout + modal-CIGAR policy
+    n_projected_reads: int = 0
+    n_projection_fallback_reads: int = 0
+    n_projection_fallback_groups: int = 0
     mate_aware: bool = False  # resolved mate-aware mode of this run
     backend: str = ""
     # wire accounting (streaming): bytes of device-input tensors
@@ -579,6 +584,7 @@ def call_consensus_file(
     per_base_tags: bool = False,
     read_group: str = "A",
     write_index: bool = False,
+    ref_projected: bool = False,
 ) -> RunReport:
     """End-to-end: read BAM/npz → consensus → write consensus BAM.
 
@@ -593,6 +599,7 @@ def call_consensus_file(
     )
     from duplexumiconsensusreads_tpu.io.bam import (
         derive_output_header,
+        reorder_records,
         unique_read_group_id,
     )
 
@@ -603,7 +610,8 @@ def call_consensus_file(
     # the mixed-mate warning only applies when mate-aware stays off
     # (auto-on and forced-on runs HANDLE those families)
     header, batch, info = load_input(
-        in_path, duplex=duplex, warn_mixed=(mate_aware == "off")
+        in_path, duplex=duplex, warn_mixed=(mate_aware == "off"),
+        ref_projected=ref_projected,
     )
     grouping = resolve_mate_aware(grouping, info, mate_aware)
     rep.mate_aware = grouping.mate_aware
@@ -618,6 +626,11 @@ def call_consensus_file(
     rep.n_rescued_cigar = info.get("n_rescued_cigar", 0)
     rep.n_dropped_cigar_ab = info.get("n_dropped_cigar_ab", 0)
     rep.n_dropped_cigar_ba = info.get("n_dropped_cigar_ba", 0)
+    rep.n_projected_reads = info.get("n_projected_reads", 0)
+    rep.n_projection_fallback_reads = info.get("n_projection_fallback_reads", 0)
+    rep.n_projection_fallback_groups = info.get(
+        "n_projection_fallback_groups", 0
+    )
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
     if max_reads > 0:
         from duplexumiconsensusreads_tpu.io.convert import downsample_families
@@ -658,7 +671,18 @@ def call_consensus_file(
         cons_pdepth=rest[0] if rest else None,
         cons_perr=rest[1] if rest else None,
         read_group=read_group,
+        proj=info.get("ref_projection"),
     )
+    if info.get("ref_projection") is not None:
+        # projected POS moves to the first called reference column, so
+        # family-id emission order is no longer guaranteed coordinate
+        # order — restore it (stable: equal positions keep UMI order)
+        out_recs = reorder_records(
+            out_recs,
+            np.lexsort(
+                (np.asarray(out_recs.pos), np.asarray(out_recs.ref_id))
+            ),
+        )
     header_out = derive_output_header(
         header, sort_order="coordinate", rg_id=read_group
     )
