@@ -7,5 +7,6 @@ from duplexumiconsensusreads_tpu.kernels.consensus import (  # noqa: F401
 )
 from duplexumiconsensusreads_tpu.kernels.error_model import (  # noqa: F401
     fit_cycle_cap_kernel,
+    fit_cycle_cap_from_counts,
     apply_cycle_cap,
 )

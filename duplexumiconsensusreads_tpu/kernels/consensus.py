@@ -52,13 +52,20 @@ def _phred_from_err(err: jnp.ndarray, max_qual: int) -> jnp.ndarray:
 
 
 def _evidence_columns(
-    bases, quals, ok, max_input_qual, min_input_qual, want_err, want_depth=True
+    bases, quals, ok, max_input_qual, min_input_qual, want_err,
+    want_depth=True, want_fit_counts=False,
 ):
     """(rows, C) evidence block: loglik contributions (4L)[, depth
     indicators (L)], read-count (1)[, real-masked base counts (4L) for
-    the err reduction]. Column slicing happens BEFORE the reduction
-    GEMM on purpose: XLA cannot narrow a dot's output columns through
-    post-hoc slices, so every column here costs real MXU work."""
+    the err reduction][, UNfiltered base counts (4L) for the error-model
+    fit]. Column slicing happens BEFORE the reduction GEMM on purpose:
+    XLA cannot narrow a dot's output columns through post-hoc slices, so
+    every column here costs real MXU work.
+
+    The fit counts deliberately skip the min_input_qual mask: the
+    error-model fit tallies every real base against the consensus
+    (oracle/error_model.py's `ok`), while the consensus argmax itself
+    excludes sub-threshold reads — two different masks by contract."""
     r, l = bases.shape
     contrib, real = _contributions(bases, quals, ok, max_input_qual, min_input_qual)
     cols = [contrib.reshape(r, 4 * l)]
@@ -71,6 +78,12 @@ def _evidence_columns(
             & (real > 0)[:, :, None]
         ).astype(jnp.float32)
         cols.append(oh.reshape(r, 4 * l))
+    if want_fit_counts:
+        ohf = (
+            (bases[:, :, None] == jnp.arange(N_REAL_BASES, dtype=bases.dtype))
+            & ok[:, None, None]
+        ).astype(jnp.float32)
+        cols.append(ohf.reshape(r, 4 * l))
     return jnp.concatenate(cols, axis=1)
 
 
@@ -148,9 +161,10 @@ def ssc_kernel(
     there (advisor r4); the depth>0 mask is used instead.
     """
     r, l = bases.shape
-    if columns not in ("full", "fit"):
+    if columns not in ("full", "fit", "fit_counts"):
         raise ValueError(f"unknown ssc columns mode {columns!r}")
-    fit_mode = columns == "fit"
+    fit_mode = columns in ("fit", "fit_counts")
+    fit_counts = columns == "fit_counts"
     # runsum family sums are differences of two large prefix sums; a
     # tiny contribution (lone Phred-90 read, loglik ~ -1e-9) can cancel
     # to exact 0.0 against ~1e6-magnitude prefixes, so the sign test
@@ -167,7 +181,8 @@ def ssc_kernel(
         # (R, 4L | L | 1 [| 4L]): loglik contributions, depth
         # indicators, read count, optional base counts (want_err)
         big = _evidence_columns(
-            bases, quals, ok, max_input_qual, min_input_qual, want_err, want_depth
+            bases, quals, ok, max_input_qual, min_input_qual, want_err,
+            want_depth, fit_counts,
         )
         if method == "matmul":
             onehot_f = (
@@ -202,6 +217,7 @@ def ssc_kernel(
             min_input_qual,
             want_err,
             want_depth,
+            fit_counts,
         )
         c = big.shape[1]
         if method == "runsum":
@@ -290,15 +306,27 @@ def ssc_kernel(
         # per-family sums; runsum keeps its depth columns (see above)
         # and masks on those instead.
         if want_depth:  # runsum: exact integer depth, sound mask
-            fam_size = out[:, 5 * l].astype(jnp.int32)
+            size_col = 5 * l
+            fam_size = out[:, size_col].astype(jnp.int32)
             has_evidence = out[:, 4 * l : 5 * l] > 0
         else:
-            fam_size = out[:, 4 * l].astype(jnp.int32)
+            size_col = 4 * l
+            fam_size = out[:, size_col].astype(jnp.int32)
             has_evidence = jnp.max(loglik, axis=-1) < 0
         cons_base = jnp.where(
             has_evidence, jnp.argmax(loglik, axis=-1), BASE_N
         ).astype(jnp.int32)
         fam_valid = fam_size >= min_reads
+        if fit_counts:
+            # per-(family, cycle, base) counts of ALL real contributing
+            # bases (min_input_qual deliberately not applied — see
+            # _evidence_columns); f32 sums of 0/1 are exact below 2^24.
+            # Returned FLAT (F, 4L), column l*4+b, and kept f32: a
+            # reshape to (F, L, 4) puts 4 on the minor axis, which TPU
+            # T(8,128) tiling pads to 128 lanes — a 32x memory blowup
+            # (measured: 22.3 GB for the 280-bucket bench class, OOM)
+            counts = out[:, size_col + 1 : size_col + 1 + 4 * l]
+            return cons_base, fam_size, fam_valid, counts
         return cons_base, fam_size, fam_valid
     depth = out[:, 4 * l : 5 * l].astype(jnp.int32)
     fam_size = out[:, 5 * l].astype(jnp.int32)

@@ -63,3 +63,48 @@ def fit_cycle_cap_kernel(
 def apply_cycle_cap(quals: jnp.ndarray, cycle_cap: jnp.ndarray) -> jnp.ndarray:
     """Clip qualities (R, L) at the per-cycle cap (L,)."""
     return jnp.minimum(quals.astype(jnp.int32), cycle_cap[None, :]).astype(quals.dtype)
+
+
+@partial(jax.jit, static_argnames=("max_phred_cap",))
+def fit_cycle_cap_from_counts(
+    cons_base: jnp.ndarray,  # (F, L) i32 unmasked ssc fit argmax (BASE_N = no call)
+    counts: jnp.ndarray,  # (F, 4L) f32 per-base counts, column l*4+b
+    fam_valid: jnp.ndarray,  # (F,) bool
+    *,
+    max_phred_cap: int = 60,
+) -> jnp.ndarray:
+    """Per-cycle Phred cap (L,) i32 — the family-side fit.
+
+    Bit-identical to fit_cycle_cap_kernel but consumes the per-family
+    per-base counts the ssc reduction GEMM already produced instead of
+    re-visiting read space: the read-vs-consensus mismatch tally
+    collapses to  mism[l] = sum_f total_f[l] - counts[f, l*4 + cons],
+    four strided minor-axis slices + selects. Removes the (R, L)
+    consensus row-gather that was the fit's dominant cost (r4 micro:
+    u8 take 30.4 ms standalone at bench shapes; the one-hot-GEMM gather
+    variant measured 33.1 ms — both refuted by this formulation, which
+    adds +4L GEMM columns (~17 ms marginal, measured) and zero gathers).
+    Counts stay in the flat GEMM layout — see ssc_kernel on why a
+    (F, L, 4) reshape is a TPU-tiling memory catastrophe.
+    """
+    cons_real = cons_base < N_REAL_BASES
+    mask = fam_valid[:, None] & cons_real  # (F, L)
+    total_fl = jnp.float32(0)
+    match_fl = jnp.float32(0)
+    for b in range(4):
+        c_b = counts[:, b::4]  # (F, L): base-b counts per cycle
+        total_fl = total_fl + c_b
+        match_fl = match_fl + jnp.where(cons_base == b, c_b, 0.0)
+    total = jnp.sum(jnp.where(mask, total_fl, 0.0), axis=0).astype(jnp.int32)
+    mism = jnp.sum(
+        jnp.where(mask, total_fl - match_fl, 0.0), axis=0
+    ).astype(jnp.int32)
+    from duplexumiconsensusreads_tpu.utils.phred import phred_cap_thresholds
+
+    thr = jnp.asarray(phred_cap_thresholds(max_phred_cap))
+    m = (mism + 1).astype(jnp.float32)
+    t = (total + 2).astype(jnp.float32)
+    count = jnp.sum(
+        (m[:, None] <= t[:, None] * thr[None, :]).astype(jnp.int32), axis=1
+    )
+    return jnp.clip(count - 1, 2, max_phred_cap).astype(jnp.int32)

@@ -25,6 +25,7 @@ from duplexumiconsensusreads_tpu.kernels.consensus import (
 )
 from duplexumiconsensusreads_tpu.kernels.error_model import (
     apply_cycle_cap,
+    fit_cycle_cap_from_counts,
     fit_cycle_cap_kernel,
 )
 from duplexumiconsensusreads_tpu.kernels.grouping import group_kernel
@@ -75,6 +76,16 @@ class PipelineSpec:
     # widens the ssc reduction by 4L count columns, so opt-in
     # (--per-base-tags runs only).
     per_base_counts: bool = False
+    # error-model pass-1 fit formulation: "gather" re-visits read space
+    # with the (R, L) consensus row-gather; "counts" tallies mismatches
+    # family-side from 4L extra GEMM columns (zero gathers). Both exact.
+    # Measured in-pipeline on v5e (2x each, interleaved): gather 164.4 ms
+    # vs counts 170.0 ms full step — the gather fuses into the fused
+    # pipeline (which CSEs the one-hot family matrix across passes)
+    # better than the GEMM widening pays; standalone the order flips
+    # (84 vs 87 ms), which is why only in-pipeline numbers decide.
+    # Journal: tools/tune_ssc.py.
+    fit_impl: str = "gather"
 
     def __post_init__(self):
         if self.consensus.mode == "duplex" and not self.grouping.paired:
@@ -169,10 +180,15 @@ def spec_for_buckets(
     All rounded to powers of two (bounded recompiles), capped at the
     read capacity R which is always sufficient.
     """
+    import os as _os
+
+    # measured choice (see PipelineSpec.fit_impl); env knob so
+    # tools/profile_components.py can A/B the formulations in-pipeline
+    fit_impl = _os.environ.get("DUT_FIT_IMPL", "gather")
     if not buckets:
         return PipelineSpec(
             grouping, consensus, ssc_method=ssc_method, packed_io=packed_io,
-            per_base_counts=per_base_counts,
+            per_base_counts=per_base_counts, fit_impl=fit_impl,
         )
     umi_len = int(buckets[0].umi.shape[1]) if packed_io else None
     r = buckets[0].capacity
@@ -193,6 +209,7 @@ def spec_for_buckets(
         packed_io=packed_io,
         umi_len=umi_len,
         per_base_counts=per_base_counts,
+        fit_impl=fit_impl,
     )
 
 
@@ -330,10 +347,16 @@ def fused_pipeline(
     quals_eff = quals
     if c.error_model == "cycle":
         # pass 1 runs fit-only columns: no depth block in the GEMM, no
-        # consensus-qual math — the cap fit needs only argmax bases and
-        # family sizes (exactness argument in ssc_kernel's docstring)
-        cb0, _sz0, fv0 = ssc(quals, columns="fit")
-        cap = fit_cycle_cap_kernel(bases, red, valid, cb0, fv0)
+        # consensus-qual math — the cap fit needs only argmax bases,
+        # family sizes, and the mismatch tally. fit_impl picks how the
+        # tally is computed (both exact, measured ~equal; see
+        # PipelineSpec.fit_impl and the tune_ssc journal).
+        if spec.fit_impl == "counts":
+            cb0, _sz0, fv0, counts0 = ssc(quals, columns="fit_counts")
+            cap = fit_cycle_cap_from_counts(cb0, counts0, fv0)
+        else:
+            cb0, _sz0, fv0 = ssc(quals, columns="fit")
+            cap = fit_cycle_cap_kernel(bases, red, valid, cb0, fv0)
         quals_eff = apply_cycle_cap(quals, cap)
 
     # per-base disagreement counts only on the FINAL pass (the error

@@ -48,6 +48,32 @@ COLUMN structure instead: the fit-only pass drops the depth block
 (20% fewer columns, exact via the loglik-sign mask) — columns must be
 dropped BEFORE the dot, XLA cannot narrow a GEMM through output
 slices.
+
+r5 fit-gather refutation (the error-model fit's (R, L) consensus
+row-gather, ~30.4 ms standalone at bench shapes, looked like the next
+structural target). Three alternatives, all measured on v5e:
+  one-hot GEMM gather   33.1 ms standalone (A (R,F) bf16 materializes
+                        ~4 MB/bucket of one-hot the take never needs)
+  family-side counts    pass1+fit standalone 84.2 vs gather's 87.1 ms
+  (fit_impl="counts",   — but IN-PIPELINE (the only honest scope) it
+  +4L GEMM columns,     LOSES: full step 170.0 vs 164.4 ms (2x each,
+  tally via strided     interleaved). The fused pipeline CSEs the
+  slices)               one-hot family matrix across passes and fuses
+                        the gather into the fit's reductions, so the
+                        gather's in-situ cost is far below standalone
+                        while the +4L column widening is real MXU work
+                        either way. Kept selectable as
+                        PipelineSpec.fit_impl / DUT_FIT_IMPL with a
+                        bit-parity test (test_fit_from_counts_*).
+  memory footnote: the counts must stay in flat (F, 4L) GEMM layout —
+  reshaping to (F, L, 4) puts 4 lanes on the minor axis and TPU
+  T(8,128) tiling pads it 32x (measured 22.3 GB alloc, OOM).
+So the error model's remaining ~30% share is structurally floored for
+exact oracle parity: pass 1 must reduce ALL evidence (a 4L+1-column
+GEMM, the same work as the final pass), the fit must visit the
+(R, L) grid once in some form (gather, one-hot, or counts — all
+measured), and bf16 was refuted r4. The ~50 ms block is two
+irreducible GEMM-scale passes, not an unoptimized kernel.
 """
 
 from __future__ import annotations
