@@ -250,6 +250,13 @@ def build_parser() -> argparse.ArgumentParser:
         "consumable by samtools/IGV/variant callers) instead of the "
         "tool's own linear partitioning index",
     )
+    x.add_argument(
+        "--csi",
+        action="store_true",
+        help="write the STANDARD .csi index (the BAI generalization "
+        "whose binning depth is sized to the longest header contig — "
+        "required past BAI's 2^29 coordinate limit)",
+    )
 
     vw = sub.add_parser(
         "view",
@@ -361,7 +368,10 @@ def _cmd_call(args) -> int:
     from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
     from duplexumiconsensusreads_tpu.utils.compile_cache import enable_compile_cache
 
-    enable_compile_cache()
+    # per_host_cpu: stale XLA:CPU AOT artifacts from another host can
+    # SIGILL (see utils/compile_cache.py) - JAX_PLATFORMS=cpu runs are
+    # first-class here, so the cache keys on the host CPU
+    enable_compile_cache(per_host_cpu=True)
 
     fileconf = _load_config_file(args.config_file) if args.config_file else {}
     preset = dict(
@@ -1050,6 +1060,14 @@ def _cmd_stats(args) -> int:
 
 
 def _cmd_index(args) -> int:
+    if args.bai and args.csi:
+        raise SystemExit("--bai and --csi are mutually exclusive")
+    if args.csi:
+        from duplexumiconsensusreads_tpu.io.csi import build_csi
+
+        out = build_csi(args.input, args.output)
+        print(f"[duplexumi] wrote standard CSI → {out}", file=sys.stderr)
+        return 0
     if args.bai:
         from duplexumiconsensusreads_tpu.io.bai import build_bai
 
@@ -1120,7 +1138,10 @@ def _cmd_group(args) -> int:
 
     if args.capacity < 1:
         raise SystemExit(f"--capacity must be >= 1 (got {args.capacity})")
-    enable_compile_cache()
+    # per_host_cpu: stale XLA:CPU AOT artifacts from another host can
+    # SIGILL (see utils/compile_cache.py) - JAX_PLATFORMS=cpu runs are
+    # first-class here, so the cache keys on the host CPU
+    enable_compile_cache(per_host_cpu=True)
     header, recs = read_bam(args.input)
     batch, info = records_to_readbatch(recs, duplex=args.duplex)
     from duplexumiconsensusreads_tpu.runtime.executor import resolve_mate_aware
@@ -1286,12 +1307,31 @@ def _cmd_view(args) -> int:
     if beg < 0 or end <= beg:
         raise SystemExit(f"bad region bounds in {args.region!r}")
 
+    # index resolution: an existing .bai, else an existing .csi, else
+    # build one — BAI by default, CSI when a contig exceeds BAI's 2^29
+    # coordinate space (build_bai refuses those loudly)
     bai_path = args.input + ".bai"
-    if not _os.path.exists(bai_path):
-        print(f"[duplexumi] building {bai_path}", file=sys.stderr)
-        build_bai(args.input)
-    idx = read_bai(bai_path)
-    start_v = query_start_voffset(idx, ref_id, beg, end)
+    csi_path = args.input + ".csi"
+    if not _os.path.exists(bai_path) and not _os.path.exists(csi_path):
+        if max(header.ref_lengths, default=0) > (1 << 29):
+            print(f"[duplexumi] building {csi_path}", file=sys.stderr)
+            from duplexumiconsensusreads_tpu.io.csi import build_csi
+
+            build_csi(args.input)
+        else:
+            print(f"[duplexumi] building {bai_path}", file=sys.stderr)
+            build_bai(args.input)
+    if _os.path.exists(bai_path):
+        idx = read_bai(bai_path)
+        start_v = query_start_voffset(idx, ref_id, beg, end)
+    else:
+        from duplexumiconsensusreads_tpu.io.csi import (
+            query_start_voffset_csi,
+            read_csi,
+        )
+
+        idx = read_csi(csi_path)
+        start_v = query_start_voffset_csi(idx, ref_id, beg, end)
 
     kept = []
     if start_v is not None:

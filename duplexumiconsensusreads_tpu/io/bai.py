@@ -108,13 +108,17 @@ class _RefIndex:
         )
 
 
-def build_bai(path: str, bai_path: str | None = None) -> str:
-    """Index a coordinate-sorted BAM; returns the .bai path written.
+def _build_refs(path: str, binner, max_coord: int, fmt: str):
+    """Shared index-builder core: one sequential scan accumulating
+    per-reference bins/linear/metadata, parameterized over the bin
+    function so BAI (fixed 5-level reg2bin) and CSI (io/csi.py,
+    min_shift/depth-generalized) share every other line.
 
-    Raises ValueError if records are not coordinate-sorted (a BAI over
-    unsorted data would silently serve wrong regions).
+    Returns (refs, n_ref, n_no_coor). Raises ValueError if records are
+    not coordinate-sorted (an index over unsorted data would silently
+    serve wrong regions) or a contig exceeds max_coord.
     """
-    from duplexumiconsensusreads_tpu.io.bam import FLAG_UNMAPPED, _reg2bin_vec
+    from duplexumiconsensusreads_tpu.io.bam import FLAG_UNMAPPED
     from duplexumiconsensusreads_tpu.io.index import _record_offsets, _scan_blocks
     from duplexumiconsensusreads_tpu.runtime.stream import BamStreamReader
 
@@ -132,16 +136,22 @@ def build_bai(path: str, bai_path: str | None = None) -> str:
     try:
         header = reader.header  # parsed by the reader's constructor
         n_ref = len(header.ref_names)
-        # BAI bins address coordinates < 2^29 only (reg2bin's deepest
-        # level); a longer contig (some plant/amphibian genomes) would
-        # silently index wrong regions. Refuse loudly — the CSI format
-        # is the spec's answer and is not implemented here.
+        # a contig longer than the binning scheme's address space would
+        # silently index wrong regions. Refuse loudly; for BAI (2^29,
+        # 512 Mbp — some plant/amphibian genomes exceed it) the CSI
+        # format is the spec's answer and io/csi.py sizes its depth to
+        # fit any contig.
         for nm, ln in zip(header.ref_names, header.ref_lengths):
-            if ln > (1 << 29):
+            if ln > max_coord:
                 raise ValueError(
-                    f"{path}: contig {nm!r} length {ln} exceeds the BAI "
-                    f"format's 2^29 (512 Mbp) coordinate limit; this "
-                    f"file needs a CSI index, which is not implemented"
+                    f"{path}: contig {nm!r} length {ln} exceeds the "
+                    f"{fmt} format's {max_coord} coordinate limit"
+                    + (
+                        "; this file needs a CSI index "
+                        "(duplexumi index --csi)"
+                        if fmt == "BAI"
+                        else ""
+                    )
                 )
         refs = [_RefIndex() for _ in range(n_ref)]
         while True:
@@ -233,7 +243,7 @@ def build_bai(path: str, bai_path: str | None = None) -> str:
             # computation (io/bam.py max(pos, 0))
             begs = np.maximum(poss[pidx].astype(np.int64), 0)
             ends = begs + np.maximum(ref_len, 1)
-            bins_ = _reg2bin_vec(begs, ends).astype(np.int64)
+            bins_ = binner(begs, ends).astype(np.int64)
             pv_begs, pv_ends = v_begs[pidx], v_ends[pidx]
             punm = unm[pidx]
             pref = ref_ids[pidx]
@@ -246,6 +256,16 @@ def build_bai(path: str, bai_path: str | None = None) -> str:
                 )
     finally:
         reader.close()
+    return refs, n_ref, n_no_coor
+
+
+def build_bai(path: str, bai_path: str | None = None) -> str:
+    """Index a coordinate-sorted BAM; returns the .bai path written."""
+    from duplexumiconsensusreads_tpu.io.bam import _reg2bin_vec
+
+    refs, n_ref, n_no_coor = _build_refs(
+        path, _reg2bin_vec, 1 << 29, "BAI"
+    )
 
     out = bytearray()
     out += BAI_MAGIC

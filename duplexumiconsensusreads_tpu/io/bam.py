@@ -525,7 +525,14 @@ def _serialize_records_fast(recs: BamRecords) -> bytes | None:
     b = starts + 4
     put_i32(b, np.asarray(recs.ref_id, np.int64))
     put_i32(b + 4, pos)
-    bin_ = _reg2bin_vec(np.maximum(pos, 0), np.maximum(pos, 0) + np.maximum(lengths, 1))
+    b0 = np.maximum(pos, 0)
+    e0 = b0 + np.maximum(lengths, 1)
+    # BAI reg2bin is only DEFINED below 2^29: past it the leaf formula
+    # yields invalid-but-u16-fitting bins (e.g. 41305 at 600 Mbp) that
+    # strict validators flag. Write bin=0 for any record touching the
+    # out-of-scheme range (htslib convention for CSI-indexed files —
+    # no reader trusts the field there).
+    bin_ = np.where(e0 > (1 << 29), 0, _reg2bin_vec(b0, e0))
     # l_read_name(u8) mapq(u8) bin(u16) packed little-endian as one i32
     put_i32(b + 8, name_len | (np.asarray(recs.mapq, np.int64) << 8) | (bin_ << 16))
     # n_cigar_op(u16)=1 | flag(u16)
@@ -588,7 +595,11 @@ def _serialize_uniform(
     col_i32(0, np.full(n, body, np.int64))
     col_i32(4, np.asarray(recs.ref_id, np.int64))
     col_i32(8, pos)
-    bin_ = _reg2bin_vec(np.maximum(pos, 0), np.maximum(pos, 0) + max(l, 1))
+    b0 = np.maximum(pos, 0)
+    # past-BAI coords (end > 2^29): bin=0 — see _serialize_records_fast
+    bin_ = np.where(
+        b0 + max(l, 1) > (1 << 29), 0, _reg2bin_vec(b0, b0 + max(l, 1))
+    )
     col_i32(12, nl | (np.asarray(recs.mapq, np.int64) << 8) | (bin_ << 16))
     col_i32(16, 1 | (np.asarray(recs.flags, np.int64) << 16))
     col_i32(20, np.full(n, l, np.int64))
@@ -646,13 +657,19 @@ def serialize_bam(header: BamHeader, recs: BamRecords) -> bytes:
         qual = recs.qual[i, :l_seq].tobytes()
         aux = recs.aux_raw[i]
         p = int(recs.pos[i])
+        # past-BAI coords (end > 2^29): bin=0 — see _serialize_records_fast
+        rbin = (
+            0
+            if max(p, 0) + max(l_seq, 1) > (1 << 29)
+            else _reg2bin(max(p, 0), max(p, 0) + max(l_seq, 1))
+        )
         body = struct.pack(
             "<iiBBHHHiiii",
             int(recs.ref_id[i]),
             p,
             len(name_b),
             int(recs.mapq[i]),
-            _reg2bin(max(p, 0), max(p, 0) + max(l_seq, 1)),
+            rbin,
             len(cig),
             int(recs.flags[i]),
             l_seq,

@@ -688,9 +688,16 @@ def call_consensus_file(
     )
     write_bam(out_path, header_out, out_recs)
     if write_index:
-        from duplexumiconsensusreads_tpu.io.bai import build_bai
+        # BAI unless a header contig exceeds its 2^29 coordinate space,
+        # then the CSI generalization (depth sized to the contig)
+        if max(header_out.ref_lengths, default=0) > (1 << 29):
+            from duplexumiconsensusreads_tpu.io.csi import build_csi
 
-        build_bai(out_path)
+            build_csi(out_path)
+        else:
+            from duplexumiconsensusreads_tpu.io.bai import build_bai
+
+            build_bai(out_path)
     rep.n_consensus = len(out_recs)
     rep.n_consensus_pairs = count_consensus_pairs(out_recs)
     rep.seconds["write_output"] = round(time.time() - t0, 4)

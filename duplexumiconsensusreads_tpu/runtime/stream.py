@@ -1065,9 +1065,16 @@ def stream_call_consensus(
         except OSError:
             pass
     if write_index:
-        from duplexumiconsensusreads_tpu.io.bai import build_bai
+        # BAI unless a header contig exceeds its 2^29 coordinate space,
+        # then the CSI generalization (depth sized to the contig)
+        if max(header_out.ref_lengths, default=0) > (1 << 29):
+            from duplexumiconsensusreads_tpu.io.csi import build_csi
 
-        build_bai(out_path)
+            build_csi(out_path)
+        else:
+            from duplexumiconsensusreads_tpu.io.bai import build_bai
+
+            build_bai(out_path)
     phase["finalise"] = time.time() - t_fin
     rep.n_chunks_skipped = n_skipped
     rep.n_pipeline_compiles = len(spec_cache)

@@ -9,12 +9,52 @@ cache directory lives next to the benchmark input cache.
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 
-def enable_compile_cache(cache_dir: str | None = None) -> str | None:
+def host_cpu_fingerprint() -> str:
+    """Short hash of this host's CPU feature flags.
+
+    XLA:CPU AOT artifacts encode the compile machine's feature set; an
+    artifact cached on one host and loaded on another can SIGILL
+    mid-execution (observed r5: a cache carrying +prefer-no-scatter/
+    +prefer-no-gather artifacts segfaulted the bench after the
+    benchmark host changed between rounds). Keying the CPU cache
+    directory by this fingerprint makes a host change a clean cache
+    miss instead of a crash."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 says "flags", aarch64 says "Features"
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    if not flags:
+        # parse found nothing (or no /proc): fall back to coarse
+        # platform identity rather than letting every such host share
+        # sha256("") — which would recreate the stale-artifact collision
+        import platform
+
+        flags = "|".join(
+            (platform.processor(), platform.machine(), platform.platform())
+        )
+    return hashlib.sha256(flags.encode()).hexdigest()[:12]
+
+
+def enable_compile_cache(
+    cache_dir: str | None = None, per_host_cpu: bool = False
+) -> str | None:
     """Point jax at a persistent compilation cache; best-effort (a
-    backend that doesn't support it just keeps compiling)."""
+    backend that doesn't support it just keeps compiling).
+
+    per_host_cpu=True suffixes the directory with host_cpu_fingerprint()
+    — required for XLA:CPU caches (see that function's rationale);
+    TPU-side artifacts key on the accelerator, not the host, so the
+    default path stays shared across hosts."""
     import jax
 
     path = (
@@ -22,6 +62,8 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
         or os.environ.get("DUT_COMPILE_CACHE")
         or os.path.expanduser("~/.cache/duplexumi/xla")
     )
+    if per_host_cpu:
+        path = f"{path}-{host_cpu_fingerprint()}"
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
