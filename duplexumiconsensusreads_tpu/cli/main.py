@@ -430,11 +430,6 @@ def _cmd_call(args) -> int:
                 "--ref-projected runs on the whole-file executor "
                 "(omit --chunk-reads / --n-hosts)"
             )
-        if mate_aware == "on":
-            raise SystemExit(
-                "--ref-projected does not support mate-aware pairing yet"
-            )
-        mate_aware = "off"
 
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour

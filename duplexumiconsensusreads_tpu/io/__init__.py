@@ -22,7 +22,7 @@ from duplexumiconsensusreads_tpu.io.npz import load_readbatch, save_readbatch
 
 def load_input(
     path: str, duplex: bool, warn_mixed: bool = True,
-    ref_projected: bool = False,
+    ref_projected: bool = False, mate_aware: str = "off",
 ):
     """ONE input loader for every consumer (call, stats, ...): .npz
     ReadBatch interchange, else native BAM parse when available
@@ -59,7 +59,8 @@ def load_input(
             return res
     header, recs = read_bam(path)
     batch, info = records_to_readbatch(
-        recs, duplex=duplex, warn_mixed=warn_mixed, ref_projected=ref_projected
+        recs, duplex=duplex, warn_mixed=warn_mixed,
+        ref_projected=ref_projected, mate_aware=mate_aware,
     )
     return header, batch, info
 

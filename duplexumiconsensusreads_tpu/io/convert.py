@@ -476,7 +476,7 @@ def downsample_families(batch, max_reads: int) -> int:
 
 def records_to_readbatch(
     recs: BamRecords, duplex: bool = True, warn_mixed: bool = True,
-    ref_projected: bool = False,
+    ref_projected: bool = False, mate_aware: str = "off",
 ) -> tuple[ReadBatch, dict]:
     """Convert parsed BAM records into a padded ReadBatch.
 
@@ -492,7 +492,12 @@ def records_to_readbatch(
     contribute realigned evidence instead of being dropped, and
     info["ref_projection"] carries the column metadata the emission
     side needs. Groups that cannot project (span too wide) keep the
-    classic cycle layout + modal-CIGAR policy.
+    classic cycle layout + modal-CIGAR policy. ``mate_aware`` (the CLI
+    setting: auto/on/off) decides the projection grouping: when it
+    resolves on (auto = mixed mates present — the same rule the
+    executor applies), column tables split by fragment end so each
+    mate side projects around its own alignment span instead of one
+    fragment-length-wide table that would blow the span cap.
     """
     n = len(recs)
     l = recs.seq.shape[1] if n else 0
@@ -553,10 +558,17 @@ def records_to_readbatch(
     if ref_projected:
         from duplexumiconsensusreads_tpu.io.refproject import ref_project
 
+        mate_split = mate_aware == "on" or (
+            mate_aware == "auto" and mixed_present
+        )
+        gk = np.asarray(batch.pos_key) * 2 + (
+            np.asarray(batch.frag_end).astype(np.int64) if mate_split else 0
+        )
         pb, pq, proj, fb = ref_project(
-            batch.bases, batch.quals, batch.valid, batch.pos_key,
+            batch.bases, batch.quals, batch.valid, gk,
             batch.umi, np.asarray(recs.pos), lambda i: recs.cigars[i],
         )
+        proj.mate_split = mate_split
         widened = ReadBatch.empty(n, proj.width, umi_len)
         widened.bases[:] = pb
         widened.quals[:] = pq
@@ -720,6 +732,8 @@ def consensus_to_records(
     read_group: str | None = None,  # RG:Z on every record (fgbio-style
     # single consensus read group; the header gains the matching @RG)
     proj=None,  # RefProjection: reference-column emission (io/refproject)
+    cons_end: np.ndarray | None = None,  # (F,) unit fragment-end bit —
+    # required for proj.mate_split lookups (key = pos_key*2 + end)
 ) -> BamRecords:
     """Build consensus BAM records from (scattered-back) pipeline output.
 
@@ -746,17 +760,20 @@ def consensus_to_records(
     # fell back (or called nothing) keep the legacy full-M emission.
     plan = [None] * n
     if proj is not None:
-        if paired_out:
+        if proj.mate_split and cons_end is None:
             raise ValueError(
-                "ref-projected emission does not support mate-aware "
-                "paired output yet"
+                "mate-split ref-projection needs cons_end (the unit "
+                "fragment-end bits) to address its column tables"
             )
         from duplexumiconsensusreads_tpu.io.refproject import emit_columns
 
         for k in range(n):
             i = int(idx[k])
+            gk = int(fam_pos_key[i]) * 2 + (
+                int(cons_end[i]) if proj.mate_split else 0
+            )
             plan[k] = emit_columns(
-                proj, int(fam_pos_key[i]), fam_umi[i].tobytes(), cons_base[i]
+                proj, gk, fam_umi[i].tobytes(), cons_base[i]
             )
             if plan[k] is not None:
                 pos[k] = plan[k][2]

@@ -74,6 +74,10 @@ class RefProjection:
     n_projected_reads: int = 0
     n_fallback_reads: int = 0
     n_fallback_groups: int = 0
+    # True: column tables were keyed by pos_key*2 + frag_end (mate-aware
+    # runs — each mate side projects around its own alignment span);
+    # False: keyed by pos_key*2. Emission must use the same composite.
+    mate_split: bool = False
 
 
 def _cigar_spans(cig):

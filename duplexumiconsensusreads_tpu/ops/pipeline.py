@@ -433,8 +433,18 @@ def fused_pipeline(
     cons_pair = jax.ops.segment_min(
         pair_read, seg, num_segments=n_rows + 1
     )[:n_rows]
+    # the unit's FRAGMENT end (distinct from cons_mate's read number in
+    # ss-paired modes, where mate = end XOR strand): mate-split
+    # ref-projection keys its column tables by (pos_key, frag_end), so
+    # emission needs the end itself. Constant within a row's reads under
+    # mate-aware grouping (end is in the family key); under non-split
+    # grouping it is only consumed when proj.mate_split is False anyway.
+    cons_end = jax.ops.segment_min(
+        e2_i, seg, num_segments=n_rows + 1
+    )[:n_rows]
     cons_mate = jnp.where(out_v, cons_mate, 0)
     cons_pair = jnp.where(out_v, cons_pair, -1)
+    cons_end = jnp.where(out_v, cons_end, 0)
 
     # Per-family depth stats computed ON DEVICE: the writers only need
     # cD (max depth) and cM (min positive depth) per consensus, so the
@@ -465,6 +475,7 @@ def fused_pipeline(
         "cons_valid": out_v,
         "cons_mate": cons_mate.astype(jnp.uint8),
         "cons_pair": cons_pair,
+        "cons_end": cons_end.astype(jnp.uint8),
         **({"cons_err": out_e} if out_e is not None else {}),
     }
 

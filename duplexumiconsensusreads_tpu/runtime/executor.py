@@ -209,6 +209,7 @@ def scatter_bucket_outputs(
         fam_umi[keep],
         out["cons_mate"][:nb][keep],
         pair_glob[keep],
+        out["cons_end"][:nb][keep],
     )
     if want_depth:
         res = res + (out["cons_depth"][:nb][keep], out["cons_err"][:nb][keep])
@@ -234,6 +235,7 @@ FETCH_KEYS = (
     "depth_min_pos",
     "cons_mate",
     "cons_pair",
+    "cons_end",
 )
 
 
@@ -397,6 +399,7 @@ def call_batch_tpu(
             z((0, u), np.uint8),
             z((0,), np.uint8),
             z((0,), np.int64),
+            z((0,), np.uint8),
         )
         return empty + (
             (z((0, batch.read_len), np.int32),) * 2 if per_base_tags else ()
@@ -519,6 +522,10 @@ def call_batch_cpu(
     np.minimum.at(pair, ids[sel], pair_read[sel])
     mate = np.where(cv, np.minimum(mate, 1), 0).astype(np.uint8)
     pair = np.where(cv & (pair < big), pair, -1)
+    # unit fragment end (host twin of the pipeline's cons_end)
+    endv = np.full(n_out, big, np.int64)
+    np.minimum.at(endv, ids[sel], e2[sel].astype(np.int64))
+    endv = np.where(cv, np.minimum(endv, 1), 0).astype(np.uint8)
 
     res = (
         np.asarray(cons.bases)[cv],
@@ -529,6 +536,7 @@ def call_batch_cpu(
         fam_umi[cv],
         mate[cv],
         pair[cv],
+        endv[cv],
     )
     if per_base_tags:
         res = res + (np.asarray(cons.depth)[cv], np.asarray(cons.err)[cv])
@@ -611,9 +619,16 @@ def call_consensus_file(
     # (auto-on and forced-on runs HANDLE those families)
     header, batch, info = load_input(
         in_path, duplex=duplex, warn_mixed=(mate_aware == "off"),
-        ref_projected=ref_projected,
+        ref_projected=ref_projected, mate_aware=mate_aware,
     )
     grouping = resolve_mate_aware(grouping, info, mate_aware)
+    proj0 = info.get("ref_projection")
+    if proj0 is not None and proj0.mate_split != grouping.mate_aware:
+        # both sides derive the decision from the same mixed-mates
+        # signal; a divergence would mis-key every emission lookup
+        raise RuntimeError(
+            "ref-projection mate split diverged from resolved grouping"
+        )
     rep.mate_aware = grouping.mate_aware
     rep.n_records = info["n_records"]
     rep.n_dropped = (
@@ -646,12 +661,12 @@ def call_consensus_file(
         prof = profile_dir
     try:
         if backend == "tpu":
-            cb, cq, cd, cv, fp, fu, mate, pair, *rest = call_batch_tpu(
+            cb, cq, cd, cv, fp, fu, mate, pair, end, *rest = call_batch_tpu(
                 batch, grouping, consensus, capacity, n_devices, rep,
                 cycle_shards=cycle_shards, per_base_tags=per_base_tags,
             )
         elif backend == "cpu":
-            cb, cq, cd, cv, fp, fu, mate, pair, *rest = call_batch_cpu(
+            cb, cq, cd, cv, fp, fu, mate, pair, end, *rest = call_batch_cpu(
                 batch, grouping, consensus, rep, per_base_tags=per_base_tags
             )
         else:
@@ -672,6 +687,7 @@ def call_consensus_file(
         cons_perr=rest[1] if rest else None,
         read_group=read_group,
         proj=info.get("ref_projection"),
+        cons_end=end,
     )
     if info.get("ref_projection") is not None:
         # projected POS moves to the first called reference column, so

@@ -1144,11 +1144,12 @@ def _finish_chunk(
     paired_out=False, read_group="A",
 ) -> str:
     """Merge one chunk's per-class scattered outputs and write its
-    shard. parts rows are 7-tuples (9 with per-base tags: cols[7] the
-    depth matrix, cols[8] the disagreement counts — consumed
-    positionally below, so extensions must append AFTER them)."""
+    shard. parts rows are 8-tuples — (..., cons_mate, cons_pair,
+    cons_end) — or 10 with per-base tags: cols[8] the depth matrix,
+    cols[9] the disagreement counts; consumed positionally below, so
+    extensions must append AFTER them."""
     cols = sort_consensus_outputs(*(np.concatenate(x) for x in zip(*parts)))
-    cb, cq, cd, fp, fu, mate, pair = cols[:7]
+    cb, cq, cd, fp, fu, mate, pair, end = cols[:8]
     recs = consensus_to_records(
         cb,
         cq,
@@ -1161,9 +1162,10 @@ def _finish_chunk(
         cons_mate=mate,
         cons_pair=pair,
         paired_out=paired_out,
-        cons_pdepth=cols[7] if len(cols) > 7 else None,
-        cons_perr=cols[8] if len(cols) > 8 else None,
+        cons_pdepth=cols[8] if len(cols) > 8 else None,
+        cons_perr=cols[9] if len(cols) > 9 else None,
         read_group=read_group,
+        cons_end=end,
     )
     # record stream only (header stripped) so shards concatenate
     full = serialize_bam(header, recs)

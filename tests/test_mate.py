@@ -217,7 +217,7 @@ def test_duplex_mate_aware_validates_against_both_truths():
         gp = GroupingParams(
             strategy="exact", paired=True, mate_aware=mate_aware
         )
-        cb, cq, cd, cv, fp, fu, mate, pair = call_batch_tpu(
+        cb, cq, cd, cv, fp, fu, mate, pair, _end = call_batch_tpu(
             batch, gp, cp, capacity=512
         )
         # map each output row to its truth molecule via (pos, umi)

@@ -67,7 +67,7 @@ def test_long_umi_32_codes():
         np.asarray(f_cpu.molecule_id), np.asarray(f_tpu.molecule_id)
     )
     cp = ConsensusParams(mode="duplex")
-    cb, cq, cd, cv, fp, fu, _mate, _pair = call_batch_tpu(batch, gp, cp, capacity=256)
+    cb, cq, cd, cv, fp, fu, _mate, _pair, _end = call_batch_tpu(batch, gp, cp, capacity=256)
     assert cv.sum() > 0
     assert fu.shape[1] == 32
 

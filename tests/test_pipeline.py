@@ -298,7 +298,7 @@ def test_cycle_error_model_earns_its_flops():
     stats = {}
     for em in (None, "cycle"):
         cp = ConsensusParams(mode="duplex", error_model=em, min_duplex_reads=1)
-        cb, cq, _cd, cv, fp, fu, _m, _p = call_batch_tpu(
+        cb, cq, _cd, cv, fp, fu, _m, _p, _e = call_batch_tpu(
             batch, gp, cp, capacity=1024
         )
         n_err = n_base = hi_err = hi_base = 0
