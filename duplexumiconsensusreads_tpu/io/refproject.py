@@ -39,6 +39,15 @@ Groups whose projected width would exceed ``cap_factor * L`` columns
 (e.g. distant-mate families sharing a pos_key) FALL BACK to the classic
 cycle-space layout, modal vote and all; the counters report how many.
 
+Whole-file executor only, by design: the projected column width is
+data-dependent (max group span + insertion columns), and per-chunk
+streaming would make every chunk a fresh (R, C) pipeline geometry —
+an XLA recompile per chunk (20-40 s each on the tunneled chip) for a
+host-side transform whose value is per-family, not per-byte-stream.
+Chunk boundaries themselves would be safe (the streaming contract
+never splits a pos_key group); width-quantization could bound the
+compile count if streaming projection is ever needed.
+
 Reference parity note: the reference mount is empty (SURVEY.md §0); the
 semantics here follow the SAM spec's CIGAR/coordinate model and the
 per-column consensus convention of alignment-space duplex callers.
