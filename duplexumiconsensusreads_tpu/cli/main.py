@@ -233,6 +233,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="drop consensus with more than this fraction of N bases "
         "(evaluated after masking)",
     )
+    f.add_argument(
+        "--max-base-error-rate", type=float, default=1.0,
+        help="mask bases whose disagreeing-read fraction (ce/cd, from "
+        "call --per-base-tags) exceeds this (fgbio "
+        "--max-base-error-rate analogue)",
+    )
+    f.add_argument(
+        "--max-read-error-rate", type=float, default=1.0,
+        help="drop consensus whose whole-read disagreeing-read "
+        "fraction (sum ce / sum cd) exceeds this (fgbio "
+        "--max-read-error-rate analogue)",
+    )
     f.add_argument("--chunk-records", type=int, default=200_000)
 
     x = sub.add_parser(
@@ -839,12 +851,25 @@ def _cmd_filter(args) -> int:
 
     from duplexumiconsensusreads_tpu.io.bam import derive_output_header
 
+    def aux_b(a: bytes, tag: bytes):
+        """Integer B-array aux value for ``tag`` (any int subtype —
+        other writers store small depths as B,c/B,s). None if absent."""
+        try:
+            for _s, t, typ, vs, _e in iter_aux_fields(a):
+                sub = a[vs : vs + 1]
+                if t == tag and typ == b"B" and sub in _B_DT:
+                    (cnt,) = struct.unpack_from("<I", a, vs + 1)
+                    return np.frombuffer(a, _B_DT[sub], cnt, vs + 5)
+        except (struct.error, KeyError, IndexError) as e:
+            raise ValueError(f"malformed aux stream: {e}") from e
+        return None
+
     reader = BamStreamReader(args.input)
     # record order is preserved, so the input SO stays truthful
     # (sort_order=None); the run joins the @PG provenance chain with CL
     header = derive_output_header(reader.header, sort_order=None)
     shell = serialize_bam(header, _empty_records())
-    n_in = n_kept = n_masked = n_no_tag = n_no_cd = 0
+    n_in = n_kept = n_masked = n_no_tag = n_no_cd = n_no_ce = 0
     try:
         with open(args.output, "wb") as out_f:
             out_f.write(bgzf.compress_fast(shell, eof=False))
@@ -855,10 +880,15 @@ def _cmd_filter(args) -> int:
                 recs = _records_from_raw(header, raw)
                 n = len(recs)
                 n_in += n
+                err_filters = (
+                    args.max_base_error_rate < 1.0
+                    or args.max_read_error_rate < 1.0
+                )
                 need_mask = (
                     args.mask_qual > 0
                     or args.min_mean_qual > 0
                     or args.max_n_frac < 1.0
+                    or err_filters
                 )
                 if need_mask:
                     lens = np.asarray(recs.lengths)
@@ -877,22 +907,7 @@ def _cmd_filter(args) -> int:
                     # cycles go N so the subsequent max-n-frac/
                     # mean-qual thresholds see the post-mask record.
                     for i, a in enumerate(recs.aux_raw):
-                        arr = None
-                        try:
-                            for _s, t, typ, vs, _e in iter_aux_fields(a):
-                                sub = a[vs : vs + 1]
-                                if t == b"cd" and typ == b"B" and sub in _B_DT:
-                                    (cnt,) = struct.unpack_from("<I", a, vs + 1)
-                                    arr = np.frombuffer(
-                                        a, _B_DT[sub], cnt, vs + 5
-                                    )
-                                    break
-                        except (struct.error, KeyError, IndexError) as e:
-                            # keep the loud-cleanup contract: the outer
-                            # handler only catches ValueError
-                            raise ValueError(
-                                f"malformed aux stream: {e}"
-                            ) from e
+                        arr = aux_b(a, b"cd")
                         li = int(recs.lengths[i])
                         if arr is None or len(arr) < li:
                             # missing tag, or a cd array shorter than
@@ -907,6 +922,39 @@ def _cmd_filter(args) -> int:
                         recs.seq[i][shallow] = BASE_N
                         recs.qual[i][shallow] = NO_CALL_QUAL
                 keep = np.ones(n, bool)
+                if err_filters:
+                    # fgbio FilterConsensusReads' error-rate pair, from
+                    # the ce (disagreeing reads) / cd (depth) per-base
+                    # arrays: base-level masking BEFORE max-n-frac so
+                    # the N-fraction threshold sees the post-mask
+                    # record; read-level rate joins the drop set
+                    for i, a in enumerate(recs.aux_raw):
+                        cdv = aux_b(a, b"cd")
+                        cev = aux_b(a, b"ce")
+                        li = int(recs.lengths[i])
+                        if (
+                            cdv is None or cev is None
+                            or len(cdv) < li or len(cev) < li
+                        ):
+                            n_no_ce += 1
+                            continue
+                        d = cdv[:li].astype(np.int64)
+                        e = cev[:li].astype(np.int64)
+                        if args.max_read_error_rate < 1.0:
+                            tot = int(d.sum())
+                            if tot and int(e.sum()) > args.max_read_error_rate * tot:
+                                keep[i] = False
+                                continue
+                        if args.max_base_error_rate < 1.0:
+                            bad = np.zeros(recs.seq.shape[1], bool)
+                            # e > rate*d (no per-cycle division, so
+                            # zero-depth cycles — already N — never
+                            # divide by zero)
+                            bad[:li] = e > args.max_base_error_rate * d
+                            bad &= recs.seq[i] != BASE_N
+                            n_masked += int(bad.sum())
+                            recs.seq[i][bad] = BASE_N
+                            recs.qual[i][bad] = NO_CALL_QUAL
                 if args.min_depth > 0 or args.min_min_depth > 0:
                     # a tag is only REQUIRED when its threshold is
                     # active (a foreign BAM carrying just cD must still
@@ -971,11 +1019,22 @@ def _cmd_filter(args) -> int:
             "`call --per-base-tags` to emit cd)",
             file=sys.stderr,
         )
+    if n_no_ce:
+        print(
+            f"[duplexumi] filter: WARNING: {n_no_ce} records lack "
+            "usable cd+ce per-base arrays and skipped the error-rate "
+            "filters (run `call --per-base-tags` to emit both)",
+            file=sys.stderr,
+        )
     print(
         f"[duplexumi] filter: kept {n_kept}/{n_in} consensus reads"
         + (
             f", masked {n_masked} bases"
-            if (args.mask_qual > 0 or args.min_base_depth > 0)
+            if (
+                args.mask_qual > 0
+                or args.min_base_depth > 0
+                or args.max_base_error_rate < 1.0
+            )
             else ""
         ),
         file=sys.stderr,

@@ -404,3 +404,82 @@ def test_filter_min_base_depth_masks_shallow_cycles(tmp_path, capsys):
     assert "lack a usable per-base cd array" in err
     _, kept = read_bam(out2)
     assert len(kept) == len(before)  # nothing dropped
+
+
+def test_filter_error_rate_thresholds(tmp_path, capsys):
+    """--max-base-error-rate masks high-disagreement cycles from ce/cd;
+    --max-read-error-rate drops high-disagreement records; inputs
+    lacking the arrays are warned about and skipped (fgbio
+    FilterConsensusReads' error-rate pair)."""
+    import struct
+
+    from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+    from duplexumiconsensusreads_tpu.io.bam import read_bam
+
+    bam = str(tmp_path / "in.bam")
+    assert cli_main([
+        "simulate", "-o", bam, "--molecules", "50", "--read-len", "30",
+        "--positions", "4", "--base-error", "0.08", "--seed", "9",
+        "--sorted",
+    ]) == 0
+    cons = str(tmp_path / "c.bam")
+    assert cli_main([
+        "call", bam, "-o", cons, "--config", "config3", "--capacity",
+        "256", "--per-base-tags",
+    ]) == 0
+    _, before = read_bam(cons)
+
+    def b_arr(a, tag):
+        i = a.find(tag + b"B")
+        sub = a[i + 3 : i + 4]
+        dt = {b"S": "<u2", b"I": "<u4"}[sub]
+        (cnt,) = struct.unpack_from("<I", a, i + 4)
+        return np.frombuffer(a, dt, cnt, i + 8).astype(np.int64)
+
+    # per-record read error rates on the input
+    rates = []
+    for k in range(len(before)):
+        d = b_arr(before.aux_raw[k], b"cd")
+        e = b_arr(before.aux_raw[k], b"ce")
+        rates.append(e.sum() / max(int(d.sum()), 1))
+    rates = np.asarray(rates)
+    thr = float(np.median(rates))
+    want_drop = int((rates > thr).sum())
+    assert 0 < want_drop < len(before)  # threshold splits the records
+
+    out = str(tmp_path / "f.bam")
+    assert cli_main([
+        "filter", cons, "-o", out, "--max-read-error-rate", str(thr),
+    ]) == 0
+    _, after = read_bam(out)
+    assert len(after) == len(before) - want_drop
+
+    # base-level: mask every cycle with ANY disagreement (rate 0 keeps
+    # only unanimous cycles; e > 0*d <=> e > 0)
+    out2 = str(tmp_path / "f2.bam")
+    assert cli_main([
+        "filter", cons, "-o", out2, "--max-base-error-rate", "0.0",
+    ]) == 0
+    _, after2 = read_bam(out2)
+    assert len(after2) == len(before)  # masking only, no drops
+    for k in range(len(after2)):
+        li = int(after2.lengths[k])
+        e = b_arr(after2.aux_raw[k], b"ce")[:li]
+        called = after2.seq[k][:li]
+        assert not np.any((e > 0) & (called != 4)), k
+
+    # input without the arrays: warned, untouched
+    plain = str(tmp_path / "plain.bam")
+    assert cli_main([
+        "call", bam, "-o", plain, "--config", "config3", "--capacity",
+        "256",
+    ]) == 0
+    out3 = str(tmp_path / "f3.bam")
+    capsys.readouterr()
+    assert cli_main([
+        "filter", plain, "-o", out3, "--max-read-error-rate", "0.01",
+    ]) == 0
+    err = capsys.readouterr().err
+    assert "skipped the error-rate filters" in err
+    _, kept = read_bam(out3)
+    assert len(kept) == len(before)
