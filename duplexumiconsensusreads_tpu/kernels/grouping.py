@@ -125,14 +125,28 @@ def _directional_cluster(
     # The seed of column v is argmin-rank over v's ancestors. Instead of
     # materialising the transitive closure (repeated O(u^3) boolean
     # squarings on the MXU — the r1-r4 design), propagate the MIN
-    # ancestor rank directly over the edge grid: each sweep is one
-    # (U, U) masked select + a column min — O(u^2) VPU work — and a
-    # sweep reaches one more hop, so the fixpoint arrives in graph
-    # diameter sweeps (directional chains are shallow, 2-4 hops).
-    # Measured r5 on v5e at bench shapes (280 x 512, jit+vmap): closure
-    # 20.7 ms -> propagation 13.1 ms, bit-identical seeds. The while
-    # loop's extra sweep past the fixpoint is idempotent, so the early
-    # exit is exact.
+    # ancestor COMBINED KEY rank*U + index directly over the edge grid:
+    # each sweep is one (U, U) masked select + a column min — O(u^2)
+    # VPU work — and a sweep reaches one more hop, so the fixpoint
+    # arrives in graph diameter sweeps (directional chains are shallow,
+    # 2-4 hops). The index rides in the low bits, so the seed pops out
+    # of the fixpoint as s_min % U — no (U, U) rank-match + argmax
+    # recovery pass (the r5 first cut carried rank alone and spent one
+    # extra U^2 pass recovering the index). Exactness: ranks are unique
+    # among valid slots within a position group and edges are
+    # position-local, so the min never tie-breaks on the index; invalid
+    # slots get rank U (> every valid rank, no edges) and seed
+    # themselves, exactly as the closure's eye() self-reach did. Fits
+    # i32: (U+1)*U + U < 2^23 at U <= 2048. Measured r5 on v5e at bench
+    # shapes (280 x 512, jit+vmap): closure 20.7 ms -> rank propagation
+    # 13.1 ms; the combined key then measures within chip noise of the
+    # rank-only form in-pipeline (161.8 vs 164.3-164.8 ms full step
+    # across runs) — kept because it is strictly one less (U, U) pass
+    # and bit-identical seeds. The while loop's extra sweep past the
+    # fixpoint is idempotent, so the early exit is exact.
+    idx = jnp.arange(u, dtype=jnp.int32)
+    key0 = jnp.where(u_valid, rank, u) * u + idx
+
     def _step(carry):
         s, i, _ = carry
         cand = jnp.min(jnp.where(edge, s[:, None], I32_MAX), axis=0)
@@ -144,20 +158,9 @@ def _directional_cluster(
         return changed & (i < u)
 
     s_min, _, _ = jax.lax.while_loop(
-        _cond, _step, (rank, jnp.int32(0), jnp.bool_(True))
+        _cond, _step, (key0, jnp.int32(0), jnp.bool_(True))
     )
-    # recover the seed INDEX from its propagated rank: ranks are unique
-    # among valid slots within a position group (see above), edges are
-    # position-local, so exactly one valid same-position slot matches.
-    # Invalid slots (no edges, no valid match) seed themselves, exactly
-    # as the closure's eye() self-reach did.
-    match = (
-        (rank[:, None] == s_min[None, :])
-        & (u_pos[:, None] == u_pos[None, :])
-        & u_valid[:, None]
-    )
-    seed = jnp.argmax(match, axis=0).astype(jnp.int32)
-    return jnp.where(u_valid, seed, jnp.arange(u, dtype=jnp.int32))
+    return (s_min % u).astype(jnp.int32)
 
 
 @partial(
