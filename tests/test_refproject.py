@@ -332,11 +332,14 @@ def test_indel_sim_truth_and_parity(tmp_path, backend, capsys):
     )
 
 
-def test_mate_aware_ref_projected(tmp_path, capsys):
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_mate_aware_ref_projected(tmp_path, capsys, backend):
     """Mate-aware + --ref-projected: mixed-R1/R2 paired input projects
     per (pos_key, fragment end) — each mate side gets its own column
     table — and emits linked consensus R1+R2 pairs whose bases match
-    truth. The indel minority is realigned, not dropped."""
+    truth. The indel minority is realigned, not dropped. Both
+    executors run the same projected grid (cons_end plumbing differs:
+    fused segment-min on tpu, np.minimum.at on cpu)."""
     bam = str(tmp_path / "pair.bam")
     truth = str(tmp_path / "truth.npz")
     assert main([
@@ -348,7 +351,7 @@ def test_mate_aware_ref_projected(tmp_path, capsys):
     rep_p = str(tmp_path / "rp.json")
     assert main([
         "call", bam, "-o", out, "--config", "config3", "--capacity",
-        "512", "--backend", "cpu", "--ref-projected", "--report", rep_p,
+        "512", "--backend", backend, "--ref-projected", "--report", rep_p,
     ]) == 0
     rep = json.load(open(rep_p))
     assert rep["mate_aware"] is True
@@ -365,7 +368,7 @@ def test_mate_aware_ref_projected(tmp_path, capsys):
     out_c = str(tmp_path / "cons_classic.bam")
     assert main([
         "call", bam, "-o", out_c, "--config", "config3", "--capacity",
-        "512", "--backend", "cpu",
+        "512", "--backend", backend,
     ]) == 0
     capsys.readouterr()
     assert main(["validate", out_c, "--truth", truth, "--json"]) == 0
