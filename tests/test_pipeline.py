@@ -380,3 +380,45 @@ def test_per_base_err_counts_match_oracle(gp_kw, cp_kw, ssc_method):
         total_err += int(cons.err.sum())
     assert checked > 50
     assert total_err > 0  # 5% base error must surface disagreements
+
+
+def test_fit_impl_counts_end_to_end(tmp_path, monkeypatch):
+    """The selectable counts-based error-model fit (DUT_FIT_IMPL=counts,
+    the journaled alternative to the default gather) must run the full
+    config5 pipeline end to end with a sane truth-validated error rate —
+    guards the env knob the perf A/B relies on."""
+    import json
+
+    from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+
+    bam = str(tmp_path / "in.bam")
+    truth = str(tmp_path / "t.npz")
+    assert cli_main([
+        "simulate", "-o", bam, "--truth", truth, "--molecules", "150",
+        "--family-size", "5", "--base-error", "0.01",
+        "--cycle-error-slope", "0.002", "--sorted", "--seed", "77",
+    ]) == 0
+    outs = {}
+    for impl in ("gather", "counts"):
+        monkeypatch.setenv("DUT_FIT_IMPL", impl)
+        out = str(tmp_path / f"c_{impl}.bam")
+        assert cli_main([
+            "call", bam, "-o", out, "--config", "config5",
+            "--capacity", "512", "--backend", "tpu",
+        ]) == 0
+        import io as _io
+        from contextlib import redirect_stdout
+
+        buf = _io.StringIO()
+        with redirect_stdout(buf):
+            assert cli_main(["validate", out, "--truth", truth, "--json"]) == 0
+        outs[impl] = json.loads(buf.getvalue().strip().splitlines()[-1])
+    # the slope makes late cycles ~30% raw error (0.01 + 0.002*150);
+    # the consensus must beat the MEAN raw error by >10x, and both
+    # formulations — exact up to GEMM-layout tie cells — must land
+    # near-identical rates
+    mean_raw = 0.01 + 0.002 * 75
+    for impl, v in outs.items():
+        assert v["n_unmatched"] == 0, impl
+        assert v["error_rate"] < mean_raw / 10, (impl, v["error_rate"])
+    assert abs(outs["gather"]["error_rate"] - outs["counts"]["error_rate"]) < 2e-3
