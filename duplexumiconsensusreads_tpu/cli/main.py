@@ -301,6 +301,17 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("consensus", help="consensus BAM from `call`")
     v.add_argument("--truth", required=True, help="truth npz from `simulate --truth`")
     v.add_argument("--json", action="store_true", help="print JSON instead of text")
+    v.add_argument(
+        "--pos-window",
+        type=int,
+        default=0,
+        help="match records to same-UMI truth molecules within this "
+        "many bp when the exact-POS lookup misses — needed for "
+        "--ref-projected output, whose POS legitimately moves to the "
+        "first called reference column. Default 0 (exact only): a "
+        "consensus emitted at a WRONG position must stay a loud "
+        "unmatched record, not a quiet error-rate bump",
+    )
 
     b = sub.add_parser("bench", help="run the reads/sec benchmark")
     b.add_argument("--reads", type=int, default=None)
@@ -691,17 +702,19 @@ def _cmd_validate(args) -> int:
         codes = umi_string_to_codes(recs.umi[i])
         ub = codes.tobytes() if codes is not None else b""
         m = index.get((int(recs.pos[i]), ub))
-        if m is None:
-            # ref-projected records move POS to the first called
-            # reference column, which can differ from the canonical
-            # pos_key coordinate (e.g. uniformly soft-clipped starts) —
-            # fall back to the nearest same-UMI truth molecule within a
-            # read length, so moved-POS records still validate instead
-            # of silently leaving the error-rate denominator
-            w = int(recs.lengths[i])
+        if m is None and args.pos_window > 0:
+            # --pos-window: ref-projected records move POS to the first
+            # called reference column, which can differ from the
+            # canonical pos_key coordinate (e.g. uniformly soft-clipped
+            # starts) — fall back to the nearest same-UMI truth
+            # molecule within the window so moved-POS records still
+            # validate. OPT-IN: with the default exact matching, a
+            # record emitted at a wrong position stays loudly
+            # unmatched (pass 2 classification), never a quiet
+            # error-rate bump.
             cand = [
                 c for c in by_umi.get(ub, ())
-                if abs(int(recs.pos[i]) - int(truth_pos[c])) <= w
+                if abs(int(recs.pos[i]) - int(truth_pos[c])) <= args.pos_window
             ]
             if cand:
                 m = min(cand, key=lambda c: abs(int(recs.pos[i]) - int(truth_pos[c])))

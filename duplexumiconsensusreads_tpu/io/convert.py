@@ -234,6 +234,12 @@ def softclip_rescue(
     strand_ab: np.ndarray,
     read_pos: np.ndarray,  # (N,) i32 each record's OWN alignment start
     get_cigar,  # callable i -> [(n, op), ...]
+    l_cap: int | None = None,  # true cycle width; defaults to the
+    # matrix width, which is ONLY correct for unprojected batches — a
+    # ref-projected caller must pass read_len, since its fallback rows
+    # live in cycle space [0, read_len) inside a wider projected matrix
+    # and a rescue spilling past read_len would be silently truncated
+    # at emission
 ) -> dict:
     """Rescue minority-CIGAR reads whose difference from their family's
     modal CIGAR is SOFT-CLIPPING ONLY (identical aligned core): instead
@@ -291,7 +297,8 @@ def softclip_rescue(
         modal_of: dict = {}
         for row, i in zip(map(tuple, famk.tolist()), kept_idx.tolist()):
             modal_of.setdefault(row, i)
-        l_cap = bases.shape[1]
+        if l_cap is None:
+            l_cap = bases.shape[1]
         for row, i in zip(map(tuple, dfam.tolist()), dropped.tolist()):
             m = modal_of.get(row)
             if m is None:
@@ -589,6 +596,7 @@ def records_to_readbatch(
         batch.bases, batch.quals, keep, policy_valid, batch.pos_key,
         batch.umi, batch.strand_ab, np.asarray(recs.pos),
         lambda i: recs.cigars[i],
+        l_cap=(proj.read_len if proj is not None else None),
     )
     batch.valid &= keep
     batch.strand_ab &= keep
@@ -778,6 +786,20 @@ def consensus_to_records(
             if plan[k] is not None:
                 pos[k] = plan[k][2]
 
+    # per-record emitted lengths + reference spans. In a projected run
+    # the matrices are proj.width wide, but fallback rows only ever
+    # held cycles [0, read_len) — emitting the full width would pad
+    # their SEQ/CIGAR/cd/ce out to the widest projected group. The
+    # reference span (M+D) feeds the mate-pair PNEXT/TLEN below, where
+    # projection can move the two mates' POS apart.
+    base_len = l if proj is None else proj.read_len
+    lens = np.full(n, base_len, np.int32)
+    ref_len_v = np.full(n, base_len, np.int64)
+    for k, p in enumerate(plan):
+        if p is not None:
+            lens[k] = len(p[0])
+            ref_len_v[k] = sum(nn for nn, op in p[1] if op in "MD")
+
     # -------- mate-pair linking (mate-aware emission) --------
     flags_v = np.zeros(n, np.uint16)
     next_ref = np.full(n, -1, np.int32)
@@ -822,10 +844,25 @@ def consensus_to_records(
             | np.where(row_complete_n, FLAG_PROPER_PAIR, FLAG_MATE_UNMAPPED)
         ).astype(np.uint16)
         next_ref = np.where(row_complete_n, ref_id, -1).astype(np.int32)
-        next_pos_v = np.where(row_complete_n, pos, -1).astype(np.int32)
-        tlen_v = np.where(
-            row_complete_n, np.where(mate_n == 1, -l, l), 0
+        # PNEXT/TLEN from the PARTNER row: projection moves each mate's
+        # POS to its own first called reference column, so the mates of
+        # one template no longer share a position (unprojected runs
+        # still do, where this reduces to the old shared-POS ±L form).
+        # Complete pairs sort adjacently (mate 0 then 1), so the
+        # partner is the sorted neighbour.
+        t = np.arange(len(order))
+        partner = np.clip(np.where(mate_s == 0, t + 1, t - 1), 0, max(len(t) - 1, 0))
+        pos_s = pos[order].astype(np.int64)
+        end_s = pos_s + ref_len_v[order]
+        ppos_s = pos_s[partner]
+        pend_s = end_s[partner]
+        span = np.maximum(end_s, pend_s) - np.minimum(pos_s, ppos_s)
+        left = (pos_s < ppos_s) | ((pos_s == ppos_s) & (mate_s == 0))
+        tlen_s = np.where(left, span, -span)
+        next_pos_v = np.where(
+            row_complete_n, ppos_s[inv], -1
         ).astype(np.int32)
+        tlen_v = np.where(row_complete_n, tlen_s[inv], 0).astype(np.int32)
     # vectorised RX strings: code matrix -> ASCII bytes (+ separator
     # column for duplex pairs), one decode per batch instead of a
     # Python join per record
@@ -841,15 +878,6 @@ def consensus_to_records(
     ds = np.asarray(cons_dstats, np.int64)[idx]
     cd_bytes = ds[:, 0].astype("<i4").tobytes()
     cm_bytes = ds[:, 1].astype("<i4").tobytes()
-    # per-record emitted lengths + column selections (projection only).
-    # In a projected run the matrices are proj.width wide, but fallback
-    # rows only ever held cycles [0, read_len) — emitting the full width
-    # would pad their SEQ/CIGAR/cd/ce out to the widest projected group.
-    base_len = l if proj is None else proj.read_len
-    lens = np.full(n, base_len, np.int32)
-    for k, p in enumerate(plan):
-        if p is not None:
-            lens[k] = len(p[0])
 
     def _row_cols(arr, k):
         """One record's emitted per-base values from a padded (F, C)
@@ -865,6 +893,21 @@ def consensus_to_records(
         # u16) — strict fgbio-downstream parsers accept the common case
         import struct as _struct
 
+        if proj is None:
+            # vectorised fast path — the streaming executor calls this
+            # per chunk on the 200M-read path, where per-record Python
+            # costs minutes of host wall (the repo's standing contract)
+            rows = np.asarray(arr)[idx]
+            if rows.size == 0 or int(rows.max()) < 65536:
+                sub, width, dt = b"S", 2, "<u2"
+            else:
+                sub, width, dt = b"I", 4, "<u4"
+            hdr = tag + b"B" + sub + _struct.pack("<I", l)
+            flat = rows.astype(dt).tobytes()
+            return [
+                hdr + flat[width * l * k : width * l * (k + 1)]
+                for k in range(n)
+            ]
         rows = [_row_cols(arr, k) for k in range(n)]
         vmax = max((int(r.max()) for r in rows if r.size), default=0)
         if vmax < 65536:
@@ -909,17 +952,24 @@ def consensus_to_records(
             + (pd_rows[k] if pd_rows is not None else b"")
             + (pe_rows[k] if pe_rows is not None else b"")
         )
-    w_out = int(lens.max()) if n else l
-    seq_m = np.full((n, w_out), 4, np.uint8)
-    qual_m = np.zeros((n, w_out), np.uint8)
-    cigars: list = []
-    for k in range(n):
-        m = int(lens[k])
-        row = _row_cols(cons_base, k)
-        seq_m[k, :m] = np.where(row == BASE_PAD, 4, row)
-        qual_m[k, :m] = _row_cols(cons_qual, k)
-        p = plan[k]
-        cigars.append([(base_len, "M")] if p is None else p[1])
+    if proj is None:
+        # vectorised fast path (streaming hot path — see _pb_rows)
+        rows_b = np.asarray(cons_base)[idx]
+        seq_m = np.where(rows_b == BASE_PAD, 4, rows_b).astype(np.uint8)
+        qual_m = np.asarray(cons_qual)[idx].astype(np.uint8)
+        cigars: list = [[(base_len, "M")] for _ in range(n)]
+    else:
+        w_out = int(lens.max()) if n else l
+        seq_m = np.full((n, w_out), 4, np.uint8)
+        qual_m = np.zeros((n, w_out), np.uint8)
+        cigars = []
+        for k in range(n):
+            m = int(lens[k])
+            row = _row_cols(cons_base, k)
+            seq_m[k, :m] = np.where(row == BASE_PAD, 4, row)
+            qual_m[k, :m] = _row_cols(cons_qual, k)
+            p = plan[k]
+            cigars.append([(base_len, "M")] if p is None else p[1])
     return BamRecords(
         names=names,
         flags=flags_v,

@@ -83,6 +83,11 @@ class RefProjection:
     n_projected_reads: int = 0
     n_fallback_reads: int = 0
     n_fallback_groups: int = 0
+    # reads whose CIGAR consumes no reference (soft-clips + insertions
+    # only): they have no reference-anchored bases to place, so their
+    # projected rows stay PAD and contribute no evidence — the analogue
+    # of the modal-CIGAR drop, counted separately
+    n_unanchored_reads: int = 0
     # True: column tables were keyed by pos_key*2 + frag_end (mate-aware
     # runs — each mate side projects around its own alignment span);
     # False: keyed by pos_key*2. Emission must use the same composite.
@@ -150,10 +155,18 @@ def ref_project(
         for i in g.tolist():
             segs, ref_len = _cigar_spans(cigs[i])
             start = int(rp[i])
+            if ref_len == 0:
+                # no reference-anchored bases: nothing to place, and
+                # its insertion boundaries may lie outside the group
+                # span (they would KeyError at placement and inflate
+                # the cap total for columns no anchored read shares)
+                spans[i] = []
+                continue
             spans[i] = segs
-            if ref_len > 0:
-                lo = start if lo is None else min(lo, start)
-                hi = start + ref_len if hi is None else max(hi, start + ref_len)
+            lo = start if lo is None else min(lo, start)
+            hi = start + ref_len if hi is None else max(hi, start + ref_len)
+            # I boundaries of anchored reads always fall inside
+            # [start, start + ref_len] and hence inside [lo, hi]
             for kind, _q, ln, roff in segs:
                 if kind == "I":
                     p = start + roff
@@ -203,12 +216,18 @@ def ref_project(
         gpk = int(pk[g[0]])
         proj.groups[gpk] = (col_pos, col_ins)
 
-        # per-read placement + span tracking
+        # per-read placement + span tracking (unanchored reads have
+        # empty span lists: their rows stay PAD, counted below)
         first_col = np.full(len(g), cg, np.int64)
         last_col = np.full(len(g), -1, np.int64)
         placed_cols: list[np.ndarray] = []
         placed_rows: list[np.ndarray] = []
+        n_anchored = 0
         for j, i in enumerate(g.tolist()):
+            if not spans[i]:
+                proj.n_unanchored_reads += 1
+                continue
+            n_anchored += 1
             start = int(rp[i])
             for kind, q0, ln, roff in spans[i]:
                 if kind == "M":
@@ -222,7 +241,7 @@ def ref_project(
                 last_col[j] = max(last_col[j], int(cols[-1]))
                 placed_cols.append(cols)
                 placed_rows.append(np.full(len(cols), j, np.int64))
-        proj.n_projected_reads += len(g)
+        proj.n_projected_reads += n_anchored
 
         pc = np.concatenate(placed_cols) if placed_cols else np.zeros(0, np.int64)
         pr = np.concatenate(placed_rows) if placed_rows else np.zeros(0, np.int64)

@@ -314,7 +314,9 @@ def test_indel_sim_truth_and_parity(tmp_path, backend, capsys):
     assert rep["n_projected_reads"] > 0
     assert rep["n_dropped_cigar_ab"] + rep["n_dropped_cigar_ba"] == 0
     capsys.readouterr()
-    assert main(["validate", out, "--truth", truth, "--json"]) == 0
+    assert main([
+        "validate", out, "--truth", truth, "--json", "--pos-window", "200",
+    ]) == 0
     v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert v["n_unmatched"] == 0
     assert v["n_matched_to_truth"] == v["n_consensus"] > 0
@@ -358,8 +360,31 @@ def test_mate_aware_ref_projected(tmp_path, capsys, backend):
     assert rep["n_projected_reads"] > 0
     assert rep["n_dropped_cigar_ab"] + rep["n_dropped_cigar_ba"] == 0
     assert rep["n_consensus_pairs"] > 0
+    # complete pairs must point at EACH OTHER: projection moves each
+    # mate's POS independently, so PNEXT is the partner's (possibly
+    # moved) POS and TLEN spans leftmost-start..rightmost-end with
+    # opposite signs (r5 review regression: PNEXT was the row's own POS)
+    _, cons = read_bam(out)
+    by_name: dict = {}
+    for i in range(len(cons)):
+        if cons.names[i].endswith("p"):
+            by_name.setdefault(cons.names[i], []).append(i)
+    n_pairs_checked = 0
+    for nm, rows in by_name.items():
+        assert len(rows) == 2, nm
+        a, b = rows
+        assert int(cons.next_pos[a]) == int(cons.pos[b]), nm
+        assert int(cons.next_pos[b]) == int(cons.pos[a]), nm
+        ta, tb = int(cons.tlen[a]), int(cons.tlen[b])
+        assert ta == -tb and ta != 0, (nm, ta, tb)
+        lo = min(int(cons.pos[a]), int(cons.pos[b]))
+        assert abs(ta) >= max(int(cons.pos[a]), int(cons.pos[b])) - lo, nm
+        n_pairs_checked += 1
+    assert n_pairs_checked == rep["n_consensus_pairs"] > 0
     capsys.readouterr()
-    assert main(["validate", out, "--truth", truth, "--json"]) == 0
+    assert main([
+        "validate", out, "--truth", truth, "--json", "--pos-window", "200",
+    ]) == 0
     v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert v["n_unmatched"] == 0
     assert v["error_rate"] < 5e-3, v
