@@ -1101,10 +1101,29 @@ def _cmd_stats(args) -> int:
     _, pg_sizes = np.unique(pos, return_counts=True)
     n_mol = int(fams.n_molecules)
     duplex_mols = 0
+    duplex_size_hist: dict = {}
+    duplex_yield: dict = {}
     if args.duplex and n_mol:
         ab = np.bincount(mol_id[strand], minlength=n_mol)
         ba = np.bincount(mol_id[~strand], minlength=n_mol)
         duplex_mols = int(((ab > 0) & (ba > 0)).sum())
+        # CollectDuplexSeqMetrics-style strand-pair metrics: the
+        # (larger, smaller) per-strand size matrix (strand label is
+        # arbitrary, so the histogram is order-free) and the fraction
+        # of molecules whose WEAKER strand clears a min-reads bar —
+        # the duplex yield curve that decides panel sequencing depth
+        hi = np.maximum(ab, ba)
+        lo = np.minimum(ab, ba)
+        keys, cnts = np.unique(hi * 100_000 + lo, return_counts=True)
+        order = np.argsort(-cnts)[:20]  # top pairs; the tail is noise
+        duplex_size_hist = {
+            f"{int(k) // 100_000}+{int(k) % 100_000}": int(c)
+            for k, c in zip(keys[order], cnts[order])
+        }
+        duplex_yield = {
+            f"min_reads={k}": round(float((lo >= k).mean()), 4)
+            for k in (1, 2, 3, 5)
+        }
     out = {
         "n_records": info["n_records"],
         "n_valid_reads": int(valid.sum()),
@@ -1116,6 +1135,8 @@ def _cmd_stats(args) -> int:
         "n_position_groups": int(len(pg_sizes)),
         "max_position_group": int(pg_sizes.max()) if len(pg_sizes) else 0,
         "duplex_complete_molecules": duplex_mols,
+        "duplex_family_size_hist": duplex_size_hist,
+        "duplex_yield": duplex_yield,
         "grouping": args.grouping,
     }
     if args.json:

@@ -200,6 +200,15 @@ def test_stats_subcommand(tmp_path, capsys):
     assert sum(res["family_size_hist"].values()) == res["n_families"]
     assert res["duplex_complete_molecules"] > 0
     assert res["mean_family_size"] > 0
+    # CollectDuplexSeqMetrics-style strand-pair metrics: the size-pair
+    # histogram counts every molecule once, and the yield curve is
+    # monotone with min_reads=1 equal to the duplex-complete fraction
+    assert sum(res["duplex_family_size_hist"].values()) <= res["n_molecules"]
+    y = res["duplex_yield"]
+    assert y["min_reads=1"] == round(
+        res["duplex_complete_molecules"] / res["n_molecules"], 4
+    )
+    assert y["min_reads=1"] >= y["min_reads=2"] >= y["min_reads=3"] >= y["min_reads=5"]
 
 
 def test_npz_input(tmp_path):
