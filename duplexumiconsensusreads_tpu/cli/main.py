@@ -1114,11 +1114,13 @@ def _cmd_stats(args) -> int:
         # the duplex yield curve that decides panel sequencing depth
         hi = np.maximum(ab, ba)
         lo = np.minimum(ab, ba)
-        keys, cnts = np.unique(hi * 100_000 + lo, return_counts=True)
+        pairs, cnts = np.unique(
+            np.stack([hi, lo], axis=1), axis=0, return_counts=True
+        )
         order = np.argsort(-cnts)[:20]  # top pairs; the tail is noise
         duplex_size_hist = {
-            f"{int(k) // 100_000}+{int(k) % 100_000}": int(c)
-            for k, c in zip(keys[order], cnts[order])
+            f"{int(pairs[o, 0])}+{int(pairs[o, 1])}": int(cnts[o])
+            for o in order
         }
         duplex_yield = {
             f"min_reads={k}": round(float((lo >= k).mean()), 4)

@@ -620,6 +620,7 @@ def records_to_readbatch(
         info["n_projected_reads"] = proj.n_projected_reads
         info["n_projection_fallback_reads"] = proj.n_fallback_reads
         info["n_projection_fallback_groups"] = proj.n_fallback_groups
+        info["n_projection_unanchored_reads"] = proj.n_unanchored_reads
     return batch, info
 
 

@@ -66,6 +66,9 @@ class RunReport:
     n_projected_reads: int = 0
     n_projection_fallback_reads: int = 0
     n_projection_fallback_groups: int = 0
+    # reads whose CIGAR consumes no reference (soft-clip+insertion
+    # only): projected rows stay PAD, contributing no evidence
+    n_projection_unanchored_reads: int = 0
     mate_aware: bool = False  # resolved mate-aware mode of this run
     backend: str = ""
     # wire accounting (streaming): bytes of device-input tensors
@@ -645,6 +648,9 @@ def call_consensus_file(
     rep.n_projection_fallback_reads = info.get("n_projection_fallback_reads", 0)
     rep.n_projection_fallback_groups = info.get(
         "n_projection_fallback_groups", 0
+    )
+    rep.n_projection_unanchored_reads = info.get(
+        "n_projection_unanchored_reads", 0
     )
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
     if max_reads > 0:
