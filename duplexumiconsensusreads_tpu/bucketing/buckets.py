@@ -145,7 +145,7 @@ def build_buckets(
     whenever a result-changing fallback fires.
     """
     if grouping is not None:
-        adjacency = adjacency or grouping.strategy == "adjacency"
+        adjacency = adjacency or grouping.strategy in ("adjacency", "cluster")
     valid = np.asarray(batch.valid, bool)
     idx_all = np.nonzero(valid)[0]
     if len(idx_all) == 0:
@@ -291,7 +291,7 @@ def build_buckets(
                     )
 
                     seed_of = directional_seeds(
-                        uu, cnt, g.max_hamming, g.count_ratio
+                        uu, cnt, g.max_hamming, g.effective_count_ratio
                     )
                     new_umi = uu[seed_of][inv]  # (size, B) seed-relabeled
                     w2 = pack_umi_words64(new_umi)

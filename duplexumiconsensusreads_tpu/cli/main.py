@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--config preset > default",
     )
     c.add_argument("--backend", choices=["tpu", "cpu"], default=None)
-    c.add_argument("--grouping", choices=["exact", "adjacency"], default=None)
+    c.add_argument("--grouping", choices=["exact", "adjacency", "cluster"], default=None)
     c.add_argument("--mode", choices=["ss", "duplex"], default=None)
     c.add_argument("--error-model", choices=["none", "cycle"], default=None)
     c.add_argument("--max-hamming", type=int, default=None)
@@ -292,7 +292,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     st.add_argument("input", help="input BAM (or ReadBatch .npz)")
     st.add_argument(
-        "--grouping", choices=["exact", "adjacency"], default="adjacency"
+        "--grouping", choices=["exact", "adjacency", "cluster"], default="adjacency"
     )
     st.add_argument("--duplex", action="store_true", help="paired UMI mode")
     st.add_argument("--json", action="store_true")
@@ -325,7 +325,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     g.add_argument("input", help="input BAM")
     g.add_argument("-o", "--output", required=True, help="annotated BAM")
-    g.add_argument("--grouping", choices=["exact", "adjacency"], default="adjacency")
+    g.add_argument("--grouping", choices=["exact", "adjacency", "cluster"], default="adjacency")
     g.add_argument("--max-hamming", type=int, default=1)
     g.add_argument(
         "--count-ratio", type=int, default=2,
@@ -457,7 +457,7 @@ def _cmd_call(args) -> int:
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour
     _check = {
-        "grouping": {"exact", "adjacency"},
+        "grouping": {"exact", "adjacency", "cluster"},
         "mode": {"ss", "duplex"},
         "error_model": {"none", "cycle"},
         "backend": {"tpu", "cpu"},

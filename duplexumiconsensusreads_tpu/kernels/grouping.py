@@ -213,8 +213,15 @@ def group_kernel(
     no sort of their own: family/unit ids come from order-independent
     presence scatters over (molecule, bits) keys.
     """
-    if strategy not in ("exact", "adjacency"):
+    if strategy not in ("exact", "adjacency", "cluster"):
         raise ValueError(f"unknown grouping strategy {strategy!r}")
+    if strategy == "cluster":
+        # UMI-tools cluster method == adjacency with the count
+        # condition removed: ratio 0 makes the directed edge condition
+        # cnt >= -1 vacuously true, the edge set symmetric, and the
+        # min-rank propagation labels whole connected components by
+        # their highest-count member (types.GroupingParams docstring)
+        count_ratio = 0
     r = pos.shape[0]
     if u_max is None:
         u_max = r

@@ -83,7 +83,7 @@ class UmiGrouper:
             valid_arr[order],
             strategy=p.strategy,
             max_hamming=p.max_hamming,
-            count_ratio=p.count_ratio,
+            count_ratio=p.effective_count_ratio,
             paired=p.paired,
             mate_aware=p.mate_aware,
             u_max=u_max,

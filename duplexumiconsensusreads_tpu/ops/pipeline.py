@@ -300,7 +300,7 @@ def fused_pipeline(
         valid,
         strategy=g.strategy,
         max_hamming=g.max_hamming,
-        count_ratio=g.count_ratio,
+        count_ratio=g.effective_count_ratio,
         paired=g.paired,
         mate_aware=g.mate_aware,
         u_max=spec.u_max,

@@ -99,14 +99,18 @@ def group_reads(batch: ReadBatch, params: GroupingParams) -> FamilyAssignment:
     idx_valid = np.nonzero(valid)[0]
     if params.strategy == "exact":
         cluster_umi[idx_valid] = pack_umi_words64(umi[idx_valid])
-    elif params.strategy == "adjacency":
+    elif params.strategy in ("adjacency", "cluster"):
+        # "cluster" (UMI-tools cluster method) is adjacency with the
+        # count condition removed: effective_count_ratio 0 makes every
+        # Hamming-<=h edge bidirectional, so the BFS labels whole
+        # connected components by their highest-count member
         for p in np.unique(pos[idx_valid]):
             sel = idx_valid[pos[idx_valid] == p]
             uu, inv, cnt = np.unique(
                 umi[sel], axis=0, return_inverse=True, return_counts=True
             )
             seed_of = directional_seeds(
-                uu, cnt, params.max_hamming, params.count_ratio
+                uu, cnt, params.max_hamming, params.effective_count_ratio
             )
             cluster_umi[sel] = pack_umi_words64(uu)[seed_of][inv]
     else:

@@ -166,11 +166,18 @@ class ConsensusBatch:
 class GroupingParams:
     """UmiGrouper configuration (static / hashable — safe as jit static arg).
 
-    strategy:     "exact" (identical UMI) or "adjacency" (directional
-                  clustering, UMI-tools algorithm, Hamming <= max_hamming)
-    max_hamming:  adjacency edge threshold (reference behaviour: 1)
+    strategy:     "exact" (identical UMI), "adjacency" (directional
+                  clustering, UMI-tools algorithm, Hamming <= max_hamming),
+                  or "cluster" (UMI-tools cluster method: symmetric
+                  connected components within Hamming <= max_hamming,
+                  labeled by their highest-count member — identical to
+                  adjacency with the count condition removed, which is
+                  exactly how both implementations realize it:
+                  count_ratio 0 makes the directed edge condition
+                  count >= -1 vacuously true and the edge set symmetric)
+    max_hamming:  adjacency/cluster edge threshold (reference: 1)
     count_ratio:  directional edge condition count(a) >= ratio*count(b)-1
-                  (reference behaviour: 2)
+                  (reference behaviour: 2; forced 0 under "cluster")
     paired:       duplex mode — reads carry a canonicalised UMI pair and
                   strand_ab distinguishes top/bottom families
     mate_aware:   paired-end mode — the fragment-end bit joins the
@@ -189,6 +196,12 @@ class GroupingParams:
     count_ratio: int = 2
     paired: bool = False
     mate_aware: bool = False
+
+    @property
+    def effective_count_ratio(self) -> int:
+        """The directional edge ratio the implementations consume:
+        "cluster" is adjacency with the count condition removed."""
+        return 0 if self.strategy == "cluster" else self.count_ratio
 
 
 @dataclasses.dataclass(frozen=True)

@@ -69,6 +69,16 @@ CASES = [
         SimConfig(n_molecules=20, duplex=True, umi_error=0.03, mean_family_size=5, seed=13),
         GroupingParams(strategy="adjacency", paired=True),
     ),
+    (
+        "cluster_ss",
+        SimConfig(n_molecules=25, duplex=False, umi_error=0.04, mean_family_size=6, seed=14),
+        GroupingParams(strategy="cluster"),
+    ),
+    (
+        "cluster_paired",
+        SimConfig(n_molecules=20, duplex=True, umi_error=0.03, mean_family_size=5, seed=16),
+        GroupingParams(strategy="cluster", paired=True),
+    ),
 ]
 
 

@@ -160,3 +160,32 @@ def test_n_bases_carry_no_evidence():
     np.testing.assert_array_equal(cons.depth[f], depth_expected)
     # zero-depth cycles are N
     assert (cons.bases[f][depth_expected == 0] == BASE_N).all()
+
+
+def test_cluster_merges_where_directional_splits():
+    """UMI-tools semantic distinction: two Hamming-1 neighbours with
+    counts 5 and 4 satisfy NO directional edge (5 >= 2*4-1 and
+    4 >= 2*5-1 both false) so adjacency keeps two molecules; the
+    cluster method has no count condition, so the connected component
+    collapses to ONE molecule seeded by the higher-count UMI."""
+    import numpy as np
+
+    from duplexumiconsensusreads_tpu.types import GroupingParams, ReadBatch
+
+    n, L = 9, 20
+    batch = ReadBatch.empty(n, L, 4)
+    umi_a = np.array([0, 1, 2, 3], np.uint8)
+    umi_b = np.array([0, 1, 2, 0], np.uint8)  # Hamming 1 from a
+    batch.umi[:5] = umi_a
+    batch.umi[5:] = umi_b
+    batch.bases[:] = 1
+    batch.quals[:] = 30
+    batch.valid[:] = True
+    batch.strand_ab[:] = True
+    batch.pos_key[:] = 7
+    adj = group_reads(batch, GroupingParams(strategy="adjacency"))
+    clu = group_reads(batch, GroupingParams(strategy="cluster"))
+    assert int(adj.n_molecules) == 2
+    assert int(clu.n_molecules) == 1
+    # every read joins the same cluster family
+    assert len(set(np.asarray(clu.family_id)[np.asarray(batch.valid)])) == 1
