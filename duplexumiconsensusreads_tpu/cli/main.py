@@ -151,6 +151,21 @@ def build_parser() -> argparse.ArgumentParser:
         "records carry a structural-majority CIGAR (M/I/D). Whole-file "
         "executor only; BAM input only",
     )
+    c.add_argument(
+        "--umi-whitelist",
+        default=None,
+        help="expected-UMI list (one ACGT string per line, fgbio "
+        "CorrectUmis analogue): every read's UMI (each half "
+        "independently in duplex mode) snaps to its unique nearest "
+        "entry within --umi-max-mismatches; too-distant or ambiguous "
+        "reads are dropped and counted. Whole-file executor only",
+    )
+    c.add_argument(
+        "--umi-max-mismatches",
+        type=int,
+        default=None,
+        help="whitelist correction distance bound (default 1)",
+    )
 
     s = sub.add_parser("simulate", help="write a truth-aware synthetic BAM")
     s.add_argument("-o", "--output", required=True, help="output BAM path")
@@ -375,7 +390,7 @@ def _load_config_file(path: str) -> dict:
         "min_input_qual", "capacity", "devices", "cycle_shards",
         "chunk_reads", "max_inflight", "config", "mate_aware", "max_reads",
         "per_base_tags", "read_group_id", "write_index", "count_ratio",
-        "ref_projected",
+        "ref_projected", "umi_whitelist", "umi_max_mismatches",
     }
     unknown = set(conf) - allowed
     if unknown:
@@ -453,6 +468,21 @@ def _cmd_call(args) -> int:
                 "--ref-projected runs on the whole-file executor "
                 "(omit --chunk-reads / --n-hosts)"
             )
+    umi_whitelist = None
+    wl_path = opt("umi_whitelist", None)
+    umi_max_mismatches = int(opt("umi_max_mismatches", 1))
+    if wl_path:
+        if chunk_reads > 0 or args.n_hosts > 0:
+            raise SystemExit(
+                "--umi-whitelist runs on the whole-file executor "
+                "(omit --chunk-reads / --n-hosts)"
+            )
+        from duplexumiconsensusreads_tpu.io.convert import load_umi_whitelist
+
+        try:
+            umi_whitelist = load_umi_whitelist(wl_path)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--umi-whitelist: {e}")
 
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour
@@ -599,6 +629,8 @@ def _cmd_call(args) -> int:
             read_group=read_group,
             write_index=write_index,
             ref_projected=ref_projected,
+            umi_whitelist=umi_whitelist,
+            umi_max_mismatches=umi_max_mismatches,
         )
     pairs = f", {rep.n_consensus_pairs} R1+R2 pairs" if rep.mate_aware else ""
     print(

@@ -23,6 +23,7 @@ from duplexumiconsensusreads_tpu.io.npz import load_readbatch, save_readbatch
 def load_input(
     path: str, duplex: bool, warn_mixed: bool = True,
     ref_projected: bool = False, mate_aware: str = "off",
+    umi_whitelist=None, umi_max_mismatches: int = 1,
 ):
     """ONE input loader for every consumer (call, stats, ...): .npz
     ReadBatch interchange, else native BAM parse when available
@@ -42,16 +43,32 @@ def load_input(
                 "ref-projected consensus requires BAM input (CIGARs); "
                 ".npz interchange carries none"
             )
-        from duplexumiconsensusreads_tpu.io.convert import mixed_ends_present
+        from duplexumiconsensusreads_tpu.io.convert import (
+            correct_umis_whitelist,
+            mixed_ends_present,
+        )
 
         batch = load_readbatch(path)
-        return BamHeader.synthetic(), batch, {
+        info = {
             "n_records": batch.n_reads,
             # same auto-detection semantics as the BAM codecs: on only
             # when some family actually mixes fragment ends
             "mixed_mates": mixed_ends_present(batch),
         }
-    if not ref_projected and not os.environ.get("DUT_NO_NATIVE"):
+        if umi_whitelist is not None:
+            info.update(
+                correct_umis_whitelist(batch, umi_whitelist, umi_max_mismatches)
+            )
+            info["mixed_mates"] = mixed_ends_present(batch)
+        return BamHeader.synthetic(), batch, info
+    # the native fast path applies its family policies (modal-CIGAR
+    # vote) during the fill, which must see CORRECTED UMIs — whitelist
+    # runs force the portable codec, like ref_projected does
+    if (
+        not ref_projected
+        and umi_whitelist is None
+        and not os.environ.get("DUT_NO_NATIVE")
+    ):
         from duplexumiconsensusreads_tpu.io.native_reader import read_bam_native
 
         res = read_bam_native(path, duplex=duplex, warn_mixed=warn_mixed)
@@ -61,6 +78,7 @@ def load_input(
     batch, info = records_to_readbatch(
         recs, duplex=duplex, warn_mixed=warn_mixed,
         ref_projected=ref_projected, mate_aware=mate_aware,
+        umi_whitelist=umi_whitelist, umi_max_mismatches=umi_max_mismatches,
     )
     return header, batch, info
 

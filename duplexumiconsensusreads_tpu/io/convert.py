@@ -96,6 +96,99 @@ def umi_string_to_codes(rx: str) -> np.ndarray | None:
     return codes
 
 
+def load_umi_whitelist(path: str) -> np.ndarray:
+    """Read an expected-UMI list (one ACGT string per line, '#'
+    comments and blanks skipped) into an (W, U) u8 code matrix.
+    All entries must share one length (the fgbio CorrectUmis input
+    contract); raises ValueError otherwise."""
+    entries = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            s = line.strip()
+            if not s or s.startswith("#"):
+                continue
+            codes = umi_string_to_codes(s)
+            if codes is None:
+                raise ValueError(
+                    f"{path}:{ln}: non-ACGT UMI {s!r} in whitelist"
+                )
+            entries.append(codes)
+    if not entries:
+        raise ValueError(f"{path}: empty UMI whitelist")
+    lens = {len(e) for e in entries}
+    if len(lens) != 1:
+        raise ValueError(
+            f"{path}: whitelist mixes UMI lengths {sorted(lens)}"
+        )
+    return np.stack(entries)
+
+
+def correct_umis_whitelist(
+    batch, whitelist: np.ndarray, max_mismatches: int = 1
+) -> dict:
+    """fgbio CorrectUmis analogue, as an input policy: snap every valid
+    read's UMI (each half independently in duplex mode) to its UNIQUE
+    nearest whitelist entry within ``max_mismatches``; reads whose half
+    has no whitelist entry close enough, or ties between two entries,
+    are invalidated (counted, never silently kept — a wrong-molecule
+    merge is the error class UMIs exist to prevent).
+
+    Mutates batch.umi/batch.valid in place. Returns counters:
+    n_umi_corrected (reads with >=1 half changed),
+    n_dropped_whitelist (reads invalidated). Runs BEFORE grouping,
+    mixed-mate detection, and projection, so every family-identity
+    consumer sees corrected UMIs.
+    """
+    v = np.asarray(batch.valid, bool)
+    idx = np.nonzero(v)[0]
+    if not len(idx):
+        return {"n_umi_corrected": 0, "n_dropped_whitelist": 0}
+    u = np.asarray(batch.umi)[idx]  # (n, U)
+    w_len = whitelist.shape[1]
+    total = u.shape[1]
+    if total % w_len != 0 or total // w_len not in (1, 2):
+        raise ValueError(
+            f"whitelist UMI length {w_len} does not divide the input "
+            f"UMI length {total} into 1 or 2 halves"
+        )
+    halves = total // w_len
+    changed = np.zeros(len(idx), bool)
+    bad = np.zeros(len(idx), bool)
+    for h in range(halves):
+        part = u[:, h * w_len : (h + 1) * w_len]
+        # (n, W) mismatch counts, blocked to bound peak memory
+        best = np.full(len(idx), 255, np.uint8)
+        second = np.full(len(idx), 255, np.uint8)
+        best_w = np.zeros(len(idx), np.int64)
+        block = max(1, (32 << 20) // max(len(whitelist) * w_len, 1))
+        for s in range(0, len(idx), block):
+            e = min(s + block, len(idx))
+            d = (part[s:e, None, :] != whitelist[None, :, :]).sum(
+                axis=2
+            ).astype(np.uint8)
+            o = np.argsort(d, axis=1)[:, :2]
+            best[s:e] = d[np.arange(e - s), o[:, 0]]
+            best_w[s:e] = o[:, 0]
+            second[s:e] = (
+                d[np.arange(e - s), o[:, 1]]
+                if d.shape[1] > 1
+                else np.uint8(255)
+            )
+        ok = (best <= max_mismatches) & (second > best)
+        bad |= ~ok
+        hit = ok & (best > 0)
+        changed |= hit
+        part[ok] = whitelist[best_w[ok]]
+        u[:, h * w_len : (h + 1) * w_len] = part
+    batch.umi[idx] = u
+    batch.valid[idx[bad]] = False
+    changed &= ~bad
+    return {
+        "n_umi_corrected": int(changed.sum()),
+        "n_dropped_whitelist": int(bad.sum()),
+    }
+
+
 def umi_codes_to_string(codes: np.ndarray, paired: bool) -> str:
     s = "".join(_CODE_TO_CHAR[int(c)] for c in codes)
     if paired:
@@ -484,6 +577,7 @@ def downsample_families(batch, max_reads: int) -> int:
 def records_to_readbatch(
     recs: BamRecords, duplex: bool = True, warn_mixed: bool = True,
     ref_projected: bool = False, mate_aware: str = "off",
+    umi_whitelist: np.ndarray | None = None, umi_max_mismatches: int = 1,
 ) -> tuple[ReadBatch, dict]:
     """Convert parsed BAM records into a padded ReadBatch.
 
@@ -554,6 +648,16 @@ def records_to_readbatch(
     batch.quals[:] = recs.qual
     batch.pos_key[:] = pos_key
 
+    # whitelist UMI correction FIRST (CorrectUmis analogue): every
+    # family-identity consumer below — mixed-mate detection, the
+    # projection grouping, the modal-CIGAR vote — must see corrected
+    # UMIs, or a heals-to-the-same-molecule read would split a family
+    wl_info = {}
+    if umi_whitelist is not None:
+        wl_info = correct_umis_whitelist(
+            batch, umi_whitelist, umi_max_mismatches
+        )
+
     # mixed-mate detection BEFORE the CIGAR filter: mates often differ
     # in soft-clips, so the modal filter would hide exactly these
     n_mixed, mixed_present = warn_mixed_mates(
@@ -614,6 +718,7 @@ def records_to_readbatch(
         "n_mixed_mate_families": n_mixed,
         "mixed_mates": mixed_present,
         "umi_len": umi_len,
+        **wl_info,
     }
     if proj is not None:
         info["ref_projection"] = proj

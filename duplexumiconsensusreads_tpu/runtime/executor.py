@@ -69,6 +69,10 @@ class RunReport:
     # reads whose CIGAR consumes no reference (soft-clip+insertion
     # only): projected rows stay PAD, contributing no evidence
     n_projection_unanchored_reads: int = 0
+    # --umi-whitelist (CorrectUmis analogue): reads whose UMI was
+    # snapped to a whitelist entry / invalidated (too far or ambiguous)
+    n_umi_corrected: int = 0
+    n_dropped_whitelist: int = 0
     mate_aware: bool = False  # resolved mate-aware mode of this run
     backend: str = ""
     # wire accounting (streaming): bytes of device-input tensors
@@ -596,6 +600,8 @@ def call_consensus_file(
     read_group: str = "A",
     write_index: bool = False,
     ref_projected: bool = False,
+    umi_whitelist=None,  # (W, U) u8 codes (io.convert.load_umi_whitelist)
+    umi_max_mismatches: int = 1,
 ) -> RunReport:
     """End-to-end: read BAM/npz → consensus → write consensus BAM.
 
@@ -623,6 +629,7 @@ def call_consensus_file(
     header, batch, info = load_input(
         in_path, duplex=duplex, warn_mixed=(mate_aware == "off"),
         ref_projected=ref_projected, mate_aware=mate_aware,
+        umi_whitelist=umi_whitelist, umi_max_mismatches=umi_max_mismatches,
     )
     grouping = resolve_mate_aware(grouping, info, mate_aware)
     proj0 = info.get("ref_projection")
@@ -652,6 +659,8 @@ def call_consensus_file(
     rep.n_projection_unanchored_reads = info.get(
         "n_projection_unanchored_reads", 0
     )
+    rep.n_umi_corrected = info.get("n_umi_corrected", 0)
+    rep.n_dropped_whitelist = info.get("n_dropped_whitelist", 0)
     rep.n_valid_reads = int(np.asarray(batch.valid).sum())
     if max_reads > 0:
         from duplexumiconsensusreads_tpu.io.convert import downsample_families

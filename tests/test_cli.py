@@ -462,3 +462,70 @@ def test_per_base_tags(tmp_path):
                  "--capacity", "256"]) == 0
     _, r0 = read_bam(out0)
     assert all(a.find(b"cdB") < 0 and a.find(b"ceB") < 0 for a in r0.aux_raw)
+
+
+def test_umi_whitelist_correction(tmp_path, capsys):
+    """--umi-whitelist (CorrectUmis analogue): 1-mismatch UMIs snap to
+    the whitelist and their reads rejoin the right family; too-distant
+    and ambiguous UMIs are dropped and counted."""
+    from duplexumiconsensusreads_tpu.io.bam import (
+        BamHeader,
+        BamRecords,
+        write_bam,
+    )
+
+    rng = np.random.default_rng(55)
+    L = 30
+    # whitelist of two well-separated UMIs (Hamming 4 apart)
+    wl = tmp_path / "wl.txt"
+    wl.write_text("# expected UMIs\nAAAA\nCCGG\n")
+    seqs = rng.integers(0, 4, (8, L)).astype(np.uint8)
+    umis = [
+        "AAAA", "AAAA", "AAAT",  # third heals to AAAA (1 mismatch)
+        "CCGG", "CCGG", "CCGA",  # sixth heals to CCGG
+        "GGTT",                  # distance 4 from both: dropped
+        "ACGT",                  # dist(AAAA)=3, dist(CCGG)=3: dropped
+    ]
+    n = len(umis)
+    recs = BamRecords(
+        names=[f"r{i}" for i in range(n)],
+        flags=np.zeros(n, np.uint16),
+        ref_id=np.zeros(n, np.int32),
+        pos=np.full(n, 50, np.int32),
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.full(n, -1, np.int32),
+        next_pos=np.full(n, -1, np.int32),
+        tlen=np.zeros(n, np.int32),
+        lengths=np.full(n, L, np.int32),
+        seq=seqs,
+        qual=np.full((n, L), 30, np.uint8),
+        cigars=[[(L, "M")]] * n,
+        umi=umis,
+        aux_raw=[b"RXZ" + u.encode() + b"\x00" for u in umis],
+    )
+    bam = str(tmp_path / "wl.bam")
+    write_bam(bam, BamHeader.synthetic(sort_order="coordinate"), recs)
+    out = str(tmp_path / "c.bam")
+    rep_p = str(tmp_path / "r.json")
+    assert main([
+        "call", bam, "-o", out, "--mode", "ss", "--grouping", "exact",
+        "--capacity", "64", "--backend", "cpu", "--report", rep_p,
+        "--umi-whitelist", str(wl),
+    ]) == 0
+    rep = json.load(open(rep_p))
+    assert rep["n_umi_corrected"] == 2
+    assert rep["n_dropped_whitelist"] == 2, rep
+    _, cons = read_bam(out)
+    # exactly the two whitelist families remain, healed members included
+    assert len(cons) == 2
+    assert sorted(cons.umi) == ["AAAA", "CCGG"]
+    # bad whitelist file fails loudly
+    badwl = tmp_path / "bad.txt"
+    badwl.write_text("AAAA\nCCC\n")
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit, match="length"):
+        main([
+            "call", bam, "-o", out, "--mode", "ss", "--capacity", "64",
+            "--backend", "cpu", "--umi-whitelist", str(badwl),
+        ])
