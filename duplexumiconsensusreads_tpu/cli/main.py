@@ -367,6 +367,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--capacity", type=int, default=2048,
         help="bucket read capacity for the device grouping path",
     )
+    g.add_argument(
+        "--umi-whitelist",
+        default=None,
+        help="expected-UMI list (same semantics as call --umi-whitelist)",
+    )
+    g.add_argument(
+        "--umi-max-mismatches", type=int, default=1,
+        help="whitelist correction distance bound",
+    )
     g.add_argument("--json", action="store_true", help="print summary as JSON")
 
     return p
@@ -1265,7 +1274,18 @@ def _cmd_group(args) -> int:
     # first-class here, so the cache keys on the host CPU
     enable_compile_cache(per_host_cpu=True)
     header, recs = read_bam(args.input)
-    batch, info = records_to_readbatch(recs, duplex=args.duplex)
+    wl = None
+    if args.umi_whitelist:
+        from duplexumiconsensusreads_tpu.io.convert import load_umi_whitelist
+
+        try:
+            wl = load_umi_whitelist(args.umi_whitelist)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--umi-whitelist: {e}")
+    batch, info = records_to_readbatch(
+        recs, duplex=args.duplex,
+        umi_whitelist=wl, umi_max_mismatches=args.umi_max_mismatches,
+    )
     from duplexumiconsensusreads_tpu.runtime.executor import resolve_mate_aware
 
     gp = GroupingParams(
