@@ -410,6 +410,17 @@ def _load_config_file(path: str) -> dict:
     return conf
 
 
+def _load_whitelist_or_exit(path: str):
+    """Shared --umi-whitelist loader: every whitelist problem is a
+    clean CLI error, never a traceback."""
+    from duplexumiconsensusreads_tpu.io.convert import load_umi_whitelist
+
+    try:
+        return load_umi_whitelist(path)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"--umi-whitelist: {e}")
+
+
 def _cmd_call(args) -> int:
     from duplexumiconsensusreads_tpu.runtime.executor import call_consensus_file
     from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
@@ -486,12 +497,7 @@ def _cmd_call(args) -> int:
                 "--umi-whitelist runs on the whole-file executor "
                 "(omit --chunk-reads / --n-hosts)"
             )
-        from duplexumiconsensusreads_tpu.io.convert import load_umi_whitelist
-
-        try:
-            umi_whitelist = load_umi_whitelist(wl_path)
-        except (OSError, ValueError) as e:
-            raise SystemExit(f"--umi-whitelist: {e}")
+        umi_whitelist = _load_whitelist_or_exit(wl_path)
 
     # config-file values bypass argparse's choices= validation; a value
     # typo must fail loudly, not silently select a default behaviour
@@ -621,26 +627,35 @@ def _cmd_call(args) -> int:
             write_index=write_index,
         )
     else:
-        rep = call_consensus_file(
-            args.input,
-            args.output,
-            gp,
-            cp,
-            backend=backend,
-            capacity=capacity,
-            n_devices=devices,
-            report_path=args.report,
-            profile_dir=args.profile,
-            cycle_shards=cycle_shards,
-            mate_aware=mate_aware,
-            max_reads=max_reads,
-            per_base_tags=per_base_tags,
-            read_group=read_group,
-            write_index=write_index,
-            ref_projected=ref_projected,
-            umi_whitelist=umi_whitelist,
-            umi_max_mismatches=umi_max_mismatches,
-        )
+        try:
+            rep = call_consensus_file(
+                args.input,
+                args.output,
+                gp,
+                cp,
+                backend=backend,
+                capacity=capacity,
+                n_devices=devices,
+                report_path=args.report,
+                profile_dir=args.profile,
+                cycle_shards=cycle_shards,
+                mate_aware=mate_aware,
+                max_reads=max_reads,
+                per_base_tags=per_base_tags,
+                read_group=read_group,
+                write_index=write_index,
+                ref_projected=ref_projected,
+                umi_whitelist=umi_whitelist,
+                umi_max_mismatches=umi_max_mismatches,
+            )
+        except ValueError as e:
+            # the whitelist/input length compatibility check can only
+            # run once the input's UMI length is known (inside the
+            # load) — surface it as the same clean CLI error as every
+            # other whitelist problem
+            if umi_whitelist is not None and "whitelist" in str(e):
+                raise SystemExit(f"--umi-whitelist: {e}")
+            raise
     pairs = f", {rep.n_consensus_pairs} R1+R2 pairs" if rep.mate_aware else ""
     print(
         f"[duplexumi] {rep.n_valid_reads}/{rep.n_records} reads → "
@@ -1276,16 +1291,16 @@ def _cmd_group(args) -> int:
     header, recs = read_bam(args.input)
     wl = None
     if args.umi_whitelist:
-        from duplexumiconsensusreads_tpu.io.convert import load_umi_whitelist
-
-        try:
-            wl = load_umi_whitelist(args.umi_whitelist)
-        except (OSError, ValueError) as e:
+        wl = _load_whitelist_or_exit(args.umi_whitelist)
+    try:
+        batch, info = records_to_readbatch(
+            recs, duplex=args.duplex,
+            umi_whitelist=wl, umi_max_mismatches=args.umi_max_mismatches,
+        )
+    except ValueError as e:
+        if wl is not None and "whitelist" in str(e):
             raise SystemExit(f"--umi-whitelist: {e}")
-    batch, info = records_to_readbatch(
-        recs, duplex=args.duplex,
-        umi_whitelist=wl, umi_max_mismatches=args.umi_max_mismatches,
-    )
+        raise
     from duplexumiconsensusreads_tpu.runtime.executor import resolve_mate_aware
 
     gp = GroupingParams(

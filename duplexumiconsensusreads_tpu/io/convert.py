@@ -1033,6 +1033,16 @@ def consensus_to_records(
         b"RGZ" + read_group.encode("ascii") + b"\x00" if read_group else b""
     )
     rid_l, pos_l, idx_l = ref_id.tolist(), pos.tolist(), idx.tolist()
+    # mates must share ONE qname, but projection can move the two
+    # mates' POS apart — embed the pair's LEFTMOST pos in both rows'
+    # names (unprojected pairs share pos anyway, so this is identical
+    # there)
+    pair_pos_l = pos_l
+    if n and int(pair_gid.max()) >= 0:
+        g_min = np.full(int(pair_gid.max()) + 1, np.iinfo(np.int64).max)
+        has = pair_gid >= 0
+        np.minimum.at(g_min, pair_gid[has], pos[has])
+        pair_pos_l = np.where(has, g_min[np.maximum(pair_gid, 0)], pos).tolist()
     gid_l = pair_gid.tolist()
     for k in range(n):
         # fixed-width fields -> identical record layout -> uniform
@@ -1041,7 +1051,7 @@ def consensus_to_records(
         # and pair id spaces from colliding at equal width.
         if gid_l[k] >= 0:
             names.append(
-                f"{name_prefix}:{rid_l[k]}:{pos_l[k]:010d}:{gid_l[k]:07d}p"
+                f"{name_prefix}:{rid_l[k]}:{pair_pos_l[k]:010d}:{gid_l[k]:07d}p"
             )
         else:
             names.append(

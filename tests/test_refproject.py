@@ -403,6 +403,73 @@ def test_mate_aware_ref_projected(tmp_path, capsys, backend):
     )
 
 
+def test_projected_pair_with_real_insert(tmp_path):
+    """Mates at POS 100 / 250 (a real insert): the projected consensus
+    pair must share ONE qname (SAM contract — r5 review found the name
+    embedded each row's own moved POS), cross-point PNEXT at each
+    other's moved POS, and span the full insert in TLEN."""
+    from duplexumiconsensusreads_tpu.io.bam import (
+        FLAG_MATE_REVERSE,
+        FLAG_PAIRED,
+        FLAG_READ1,
+        FLAG_READ2,
+        FLAG_REVERSE,
+    )
+
+    rng = np.random.default_rng(61)
+    L = 40
+    t1 = rng.integers(0, 4, L).astype(np.uint8)
+    t2 = rng.integers(0, 4, L).astype(np.uint8)
+    k = 3  # read pairs
+    n = 2 * k
+    seqs = np.stack([t1] * k + [t2] * k)
+    # top-strand template: R1 forward at 100, R2 reverse at 250
+    flags = np.asarray(
+        [FLAG_PAIRED | FLAG_READ1 | FLAG_MATE_REVERSE] * k
+        + [FLAG_PAIRED | FLAG_READ2 | FLAG_REVERSE] * k,
+        np.uint16,
+    )
+    pos = np.asarray([100] * k + [250] * k, np.int32)
+    npos = np.asarray([250] * k + [100] * k, np.int32)
+    recs = BamRecords(
+        names=[f"t{i % k}" for i in range(n)],
+        flags=flags,
+        ref_id=np.zeros(n, np.int32),
+        pos=pos,
+        mapq=np.full(n, 60, np.uint8),
+        next_ref_id=np.zeros(n, np.int32),
+        next_pos=npos,
+        tlen=np.asarray([190] * k + [-190] * k, np.int32),
+        lengths=np.full(n, L, np.int32),
+        seq=seqs,
+        qual=np.full((n, L), 30, np.uint8),
+        cigars=[[(L, "M")]] * n,
+        umi=["ACGTAA"] * n,
+        aux_raw=[b"RXZACGTAA\x00"] * n,
+    )
+    bam = str(tmp_path / "ins.bam")
+    write_bam(bam, BamHeader.synthetic(sort_order="coordinate"), recs)
+    out = str(tmp_path / "cons.bam")
+    rep_p = str(tmp_path / "rp.json")
+    assert main([
+        "call", bam, "-o", out, "--mode", "ss", "--grouping", "exact",
+        "--capacity", "64", "--backend", "cpu", "--ref-projected",
+        "--mate-aware", "on", "--report", rep_p,
+    ]) == 0
+    rep = json.load(open(rep_p))
+    assert rep["n_consensus_pairs"] == 1
+    _, cons = read_bam(out)
+    prow = [i for i in range(len(cons)) if cons.names[i].endswith("p")]
+    assert len(prow) == 2
+    a, b = prow
+    assert cons.names[a] == cons.names[b], (cons.names[a], cons.names[b])
+    pa, pb = int(cons.pos[a]), int(cons.pos[b])
+    assert sorted([pa, pb]) == [100, 250]
+    assert int(cons.next_pos[a]) == pb and int(cons.next_pos[b]) == pa
+    ta, tb = int(cons.tlen[a]), int(cons.tlen[b])
+    assert ta == -tb and abs(ta) == 250 + L - 100
+
+
 def test_backend_parity_on_projected_grid(tmp_path):
     """cpu (oracle operators) and tpu (fused pipeline) executors consume
     the identical projected batch — outputs must agree record-for-record
