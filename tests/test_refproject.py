@@ -403,7 +403,8 @@ def test_mate_aware_ref_projected(tmp_path, capsys, backend):
     )
 
 
-def test_projected_pair_with_real_insert(tmp_path):
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_projected_pair_with_real_insert(tmp_path, backend):
     """Mates at POS 100 / 250 (a real insert): the projected consensus
     pair must share ONE qname (SAM contract — r5 review found the name
     embedded each row's own moved POS), cross-point PNEXT at each
@@ -453,7 +454,7 @@ def test_projected_pair_with_real_insert(tmp_path):
     rep_p = str(tmp_path / "rp.json")
     assert main([
         "call", bam, "-o", out, "--mode", "ss", "--grouping", "exact",
-        "--capacity", "64", "--backend", "cpu", "--ref-projected",
+        "--capacity", "64", "--backend", backend, "--ref-projected",
         "--mate-aware", "on", "--report", rep_p,
     ]) == 0
     rep = json.load(open(rep_p))
