@@ -230,3 +230,20 @@ class TestNpz:
             np.testing.assert_array_equal(
                 np.asarray(getattr(batch, f)), getattr(batch2, f)
             )
+
+
+def test_host_cpu_fingerprint_stable_and_flagged():
+    """The per-host CPU cache key: 12 hex chars, stable within a host,
+    and derived from real feature flags (not the empty-parse collision
+    the r5 segfault postmortem guards against)."""
+    from duplexumiconsensusreads_tpu.utils.compile_cache import (
+        host_cpu_fingerprint,
+    )
+
+    a = host_cpu_fingerprint()
+    b = host_cpu_fingerprint()
+    assert a == b
+    assert len(a) == 12 and all(c in "0123456789abcdef" for c in a)
+    import hashlib
+
+    assert a != hashlib.sha256(b"").hexdigest()[:12]

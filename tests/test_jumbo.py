@@ -302,3 +302,27 @@ def test_streaming_oversized_group_matches_whole_file(tmp_path, chunk_reads):
     np.testing.assert_array_equal(rw.pos, rs.pos)
     np.testing.assert_array_equal(rw.seq, rs.seq)
     np.testing.assert_array_equal(rw.qual, rs.qual)
+
+
+def test_oversized_position_group_cluster_matches_oracle():
+    """The same oversized-group precluster path under the CLUSTER
+    strategy: the host precluster must use the effective (zeroed) count
+    ratio, or cross-piece components the directional condition would
+    reject stay split — oracle parity catches it."""
+    cfg = SimConfig(
+        n_molecules=200,
+        n_positions=2,
+        mean_family_size=4,
+        umi_error=0.04,
+        duplex=True,
+        seed=43,
+    )
+    batch, _ = simulate_batch(cfg)
+    gp = GroupingParams(strategy="cluster", paired=True)
+    cp = ConsensusParams(mode="duplex", min_duplex_reads=1)
+    capacity = 256
+    pos = np.asarray(batch.pos_key)[np.asarray(batch.valid, bool)]
+    assert np.unique(pos, return_counts=True)[1].max() > 3 * capacity
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _assert_tpu_matches_cpu(batch, gp, cp, capacity)
