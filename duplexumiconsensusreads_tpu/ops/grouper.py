@@ -52,7 +52,7 @@ class UmiGrouper:
         words = pack_umi_words64(np.asarray(batch.umi))
         words[~valid_arr] = 0
         u_max = self.u_max
-        if u_max is None and p.strategy == "adjacency":
+        if u_max is None and p.strategy in ("adjacency", "cluster"):
             # Size the unique-UMI table from the data (cheap host count,
             # rounded to a power of two to bound recompiles) instead of
             # defaulting to n_reads, which would make the all-pairs

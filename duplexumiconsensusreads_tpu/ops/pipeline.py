@@ -224,9 +224,16 @@ def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
     g, c = spec.grouping, spec.consensus
     u = spec.u_max or r
     fl = 0.0
-    if g.strategy == "adjacency":
+    if g.strategy in ("adjacency", "cluster"):
         fl += 2.0 * u * u * 4 * b  # matches = onehot @ onehot.T
-        fl += max(1, (u - 1).bit_length()) * 2.0 * float(u) ** 3  # closure
+        # seed search: min-key propagation sweeps over the (U, U) edge
+        # grid — O(u^2) VPU work per sweep, floored at the 2 sweeps a
+        # fixpoint check needs (the r1-r4 closure-squaring term,
+        # log2(u) * 2u^3, stopped being executed work when r5 replaced
+        # the closure; keeping it inflated analytic TFLOPs/MFU ~25% at
+        # bench shapes, so the r5 builder-side captures' mfu fields
+        # overcount — see bench_logs/README.md)
+        fl += 2 * 2.0 * float(u) ** 2
     # error model adds a fit-only pass: 4l+1 evidence columns (no depth
     # block) vs the final pass's 5l+1
     cols = (5 * l + 1) + ((4 * l + 1) if c.error_model == "cycle" else 0)
