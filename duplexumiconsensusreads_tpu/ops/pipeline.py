@@ -214,12 +214,15 @@ def spec_for_buckets(
 
 
 def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
-    """Executed matmul FLOPs of ONE fused_pipeline call on an (r, l)
-    bucket with b UMI code columns — the denominator-side input of the
-    benchmark's MFU accounting. Counts the three MXU-heavy terms
-    (Hamming one-hot GEMM, reachability closure squarings, ssc segment
-    GEMM); elementwise/VPU work is excluded by design, so the number is
-    a lower bound on executed work and MFU is conservative.
+    """Executed FLOPs of ONE fused_pipeline call on an (r, l) bucket
+    with b UMI code columns — the denominator-side input of the
+    benchmark's MFU accounting. Counts the two MXU-heavy GEMMs
+    (Hamming one-hot, ssc segment reduction) plus a floor on the seed
+    propagation's per-sweep VPU select/min (the r5 replacement for the
+    closure squarings this function used to count — negligible next to
+    the GEMMs, kept so the term list matches the kernel). Other
+    elementwise/VPU work is excluded, so the number is a lower bound
+    on executed work and MFU is conservative.
     """
     g, c = spec.grouping, spec.consensus
     u = spec.u_max or r
