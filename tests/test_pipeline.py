@@ -163,12 +163,15 @@ def test_fused_pipeline_matches_oracle(name, cfg, gp, cp):
         _check_bucket_against_oracle(bucket, out, gp, cp, qual_tol=tol)
 
 
-def test_operator_boundary_backends_agree():
+@pytest.mark.parametrize("strategy", ["adjacency", "cluster"])
+def test_operator_boundary_backends_agree(strategy):
     """UmiGrouper/ConsensusCaller (the preserved operator API) must give
-    identical results on cpu and tpu backends."""
+    identical results on cpu and tpu backends — for the directional AND
+    cluster strategies (the latter also pins the standalone grouper's
+    data-driven u_max sizing under cluster, fixed late r5)."""
     cfg = SimConfig(n_molecules=30, duplex=True, umi_error=0.02, seed=24)
     batch, _ = simulate_batch(cfg)
-    gp = GroupingParams(strategy="adjacency", paired=True)
+    gp = GroupingParams(strategy=strategy, paired=True)
     cp = ConsensusParams(mode="duplex", error_model="cycle")
 
     f_cpu = UmiGrouper(gp, backend="cpu")(batch)
