@@ -355,6 +355,15 @@ def read_bai(path: str) -> dict:
         data = f.read()
     if data[:4] != BAI_MAGIC:
         raise ValueError(f"{path}: not a BAI file")
+    try:
+        return _parse_bai(data)
+    except struct.error as e:
+        # truncated/corrupt index must fail loudly with the path, never
+        # leak a bare struct.error (the repo-wide truncation discipline)
+        raise ValueError(f"{path}: truncated or corrupt BAI: {e}") from e
+
+
+def _parse_bai(data: bytes) -> dict:
     off = 4
     (n_ref,) = struct.unpack_from("<i", data, off)
     off += 4

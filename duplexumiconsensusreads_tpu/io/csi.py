@@ -175,6 +175,15 @@ def read_csi(path: str) -> dict:
         data = f.read()
     if data[:4] != CSI_MAGIC:
         raise ValueError(f"{path}: not a CSI file")
+    try:
+        return _parse_csi(path, data)
+    except struct.error as e:
+        # truncated/corrupt index must fail loudly with the path, never
+        # leak a bare struct.error (the repo-wide truncation discipline)
+        raise ValueError(f"{path}: truncated or corrupt CSI: {e}") from e
+
+
+def _parse_csi(path: str, data: bytes) -> dict:
     min_shift, depth, l_aux = struct.unpack_from("<iii", data, 4)
     off = 16 + l_aux
     (n_ref,) = struct.unpack_from("<i", data, off)

@@ -238,3 +238,21 @@ def test_write_index_auto_csi(tmp_path):
     assert not os.path.exists(out + ".bai")
     idx = read_csi(out + ".csi")
     assert idx["n_ref"] == len(header.ref_names)
+
+
+@pytest.mark.parametrize("fmt", ["bai", "csi"])
+def test_truncated_index_fails_loudly(tmp_path, fmt):
+    """A truncated index must raise a ValueError naming the file, never
+    leak a bare struct.error (the repo-wide truncation discipline)."""
+    from duplexumiconsensusreads_tpu.io.bai import read_bai
+
+    bam = str(tmp_path / "t.bam")
+    _sorted_bam(bam, [100, 500, 900, 40_000])
+    path = build_bai(bam) if fmt == "bai" else build_csi(bam)
+    data = open(path, "rb").read()
+    for cut in (10, len(data) // 2):
+        trunc = str(tmp_path / f"x{cut}.{fmt}")
+        with open(trunc, "wb") as f:
+            f.write(data[:cut])
+        with pytest.raises(ValueError, match="truncated or corrupt"):
+            (read_bai if fmt == "bai" else read_csi)(trunc)
