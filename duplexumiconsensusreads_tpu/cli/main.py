@@ -166,6 +166,17 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="whitelist correction distance bound (default 1)",
     )
+    c.add_argument(
+        "--chaos",
+        default=None,
+        metavar="SCHEDULE",
+        help="deterministic fault injection for the streaming executor "
+        "(testing): comma-separated site:nth:kind entries — the Nth hit "
+        "of a named fault site raises kind (oserror/enospc/kill) — or "
+        "seed:<seed>:<n> for a seeded pseudo-random schedule that "
+        "replays identically. Also settable via DUT_FAULTS. See "
+        "runtime/faults.py for the site list",
+    )
 
     s = sub.add_parser("simulate", help="write a truth-aware synthetic BAM")
     s.add_argument("-o", "--output", required=True, help="output BAM path")
@@ -386,7 +397,17 @@ def _load_config_file(path: str) -> dict:
     with underscores. Unknown keys are rejected — a typo must not
     silently fall back to a default."""
     if path.endswith(".toml"):
-        import tomllib
+        try:
+            import tomllib
+        except ModuleNotFoundError:  # stdlib tomllib is 3.11+
+            try:
+                import tomli as tomllib
+            except ModuleNotFoundError:
+                raise SystemExit(
+                    f"{path}: TOML config files need Python >= 3.11 "
+                    f"(stdlib tomllib) or the tomli package; use a "
+                    f".json config instead"
+                )
 
         with open(path, "rb") as f:
             conf = tomllib.load(f)
@@ -519,6 +540,22 @@ def _cmd_call(args) -> int:
         )
     if capacity < 1:
         raise SystemExit(f"--capacity must be >= 1 (got {capacity})")
+    if args.chaos:
+        if chunk_reads <= 0:
+            # only the streaming executor threads the fault sites and
+            # their recovery ladders; on the whole-file path the flag
+            # would be silently inert (or fire where nothing recovers)
+            raise SystemExit(
+                "--chaos requires the streaming executor (--chunk-reads N)"
+            )
+        from duplexumiconsensusreads_tpu.runtime import faults
+
+        try:
+            # the explicit flag wins over a stale DUT_FAULTS export —
+            # install_from_env leaves a plan with a different spec alone
+            faults.install(faults.FaultPlan.parse(args.chaos))
+        except ValueError as e:
+            raise SystemExit(f"--chaos: {e}")
 
     gp = GroupingParams(
         strategy=grouping,

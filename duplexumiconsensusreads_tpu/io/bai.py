@@ -296,12 +296,11 @@ def build_bai(path: str, bai_path: str | None = None) -> str:
 
     import os
 
+    from duplexumiconsensusreads_tpu.io.durable import write_durable
+
     bai_path = bai_path or path + ".bai"
-    tmp = f"{bai_path}.tmp.{os.getpid()}"  # per-writer: no shared-tmp races
-    with open(tmp, "wb") as f:
-        f.write(bytes(out))
-    os.replace(tmp, bai_path)
-    return bai_path
+    # per-writer tmp: no shared-tmp races
+    return write_durable(bai_path, bytes(out), tmp=f"{bai_path}.tmp.{os.getpid()}")
 
 
 def reg2bins(beg: int, end: int) -> list[int]:
@@ -356,14 +355,15 @@ def read_bai(path: str) -> dict:
     if data[:4] != BAI_MAGIC:
         raise ValueError(f"{path}: not a BAI file")
     try:
-        return _parse_bai(data)
-    except struct.error as e:
+        return _parse_bai(path, data)
+    except (struct.error, IndexError) as e:
         # truncated/corrupt index must fail loudly with the path, never
-        # leak a bare struct.error (the repo-wide truncation discipline)
+        # leak a bare struct.error (or an IndexError from a malformed
+        # chunk list) — the repo-wide truncation discipline
         raise ValueError(f"{path}: truncated or corrupt BAI: {e}") from e
 
 
-def _parse_bai(data: bytes) -> dict:
+def _parse_bai(path: str, data: bytes) -> dict:
     off = 4
     (n_ref,) = struct.unpack_from("<i", data, off)
     off += 4
@@ -382,6 +382,13 @@ def _parse_bai(data: bytes) -> dict:
                 off += 16
                 chunks.append((beg_v, end_v))
             if bin_ == METADATA_BIN:
+                # exactly 2 chunks by construction (file range +
+                # mapped/unmapped counts); see the CSI twin
+                if n_chunk != 2:
+                    raise ValueError(
+                        f"{path}: truncated or corrupt BAI: metadata "
+                        f"pseudo-bin has {n_chunk} chunks (expected 2)"
+                    )
                 meta = (*chunks[0], *chunks[1])
             else:
                 bins[bin_] = chunks

@@ -657,12 +657,15 @@ def serialize_bam(header: BamHeader, recs: BamRecords) -> bytes:
         qual = recs.qual[i, :l_seq].tobytes()
         aux = recs.aux_raw[i]
         p = int(recs.pos[i])
+        # bin covers the record's REFERENCE span (CIGAR M/D/N/=/X
+        # total), not l_seq: a ref-projected consensus with D ops spans
+        # more reference than it has bases, and strict validators check
+        # bin == reg2bin(pos, pos + ref_span). CIGAR-less records keep
+        # the l_seq-based placeholder span (matches the fast path).
         # past-BAI coords (end > 2^29): bin=0 — see _serialize_records_fast
-        rbin = (
-            0
-            if max(p, 0) + max(l_seq, 1) > (1 << 29)
-            else _reg2bin(max(p, 0), max(p, 0) + max(l_seq, 1))
-        )
+        span = sum(n_op for n_op, op in cig if op in "MDN=X") if cig else l_seq
+        end = max(p, 0) + max(span, 1)
+        rbin = 0 if end > (1 << 29) else _reg2bin(max(p, 0), end)
         body = struct.pack(
             "<iiBBHHHiiii",
             int(recs.ref_id[i]),

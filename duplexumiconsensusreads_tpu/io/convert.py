@@ -675,7 +675,7 @@ def records_to_readbatch(
         gk = np.asarray(batch.pos_key) * 2 + (
             np.asarray(batch.frag_end).astype(np.int64) if mate_split else 0
         )
-        pb, pq, proj, fb = ref_project(
+        pb, pq, proj, fb, unanch = ref_project(
             batch.bases, batch.quals, batch.valid, gk,
             batch.umi, np.asarray(recs.pos), lambda i: recs.cigars[i],
         )
@@ -686,6 +686,13 @@ def records_to_readbatch(
         for f in ("umi", "pos_key", "strand_ab", "frag_end", "valid"):
             getattr(widened, f)[:] = getattr(batch, f)
         batch = widened
+        # unanchored reads (CIGAR consumes no reference) placed nothing:
+        # an all-PAD row would inflate family size (min-reads gates,
+        # depth denominators) without contributing evidence — invalidate
+        # them after counting (proj.n_unanchored_reads above)
+        batch.valid &= ~unanch
+        batch.strand_ab &= ~unanch
+        batch.frag_end &= ~unanch
         # the classic policy applies only to the fallback groups, whose
         # rows kept the cycle layout in columns [0, L)
         policy_valid = batch.valid & fb
@@ -706,6 +713,10 @@ def records_to_readbatch(
     batch.strand_ab &= keep
     batch.frag_end &= keep
     n_cigar = n_before - int(batch.valid.sum())
+    if proj is not None:
+        # unanchored invalidations have their own counter
+        # (n_projection_unanchored_reads); keep the drop counters disjoint
+        n_cigar -= proj.n_unanchored_reads
 
     info = {
         "n_records": n,

@@ -159,12 +159,11 @@ def build_csi(
 
     import os
 
+    from duplexumiconsensusreads_tpu.io.durable import write_durable
+
     csi_path = csi_path or path + ".csi"
-    tmp = f"{csi_path}.tmp.{os.getpid()}"  # per-writer: no shared-tmp races
-    with open(tmp, "wb") as f:
-        f.write(bytes(out))
-    os.replace(tmp, csi_path)
-    return csi_path
+    # per-writer tmp: no shared-tmp races
+    return write_durable(csi_path, bytes(out), tmp=f"{csi_path}.tmp.{os.getpid()}")
 
 
 def read_csi(path: str) -> dict:
@@ -177,9 +176,10 @@ def read_csi(path: str) -> dict:
         raise ValueError(f"{path}: not a CSI file")
     try:
         return _parse_csi(path, data)
-    except struct.error as e:
+    except (struct.error, IndexError) as e:
         # truncated/corrupt index must fail loudly with the path, never
-        # leak a bare struct.error (the repo-wide truncation discipline)
+        # leak a bare struct.error (or an IndexError from a malformed
+        # chunk list) — the repo-wide truncation discipline
         raise ValueError(f"{path}: truncated or corrupt CSI: {e}") from e
 
 
@@ -205,6 +205,15 @@ def _parse_csi(path: str, data: bytes) -> dict:
                 off += 16
                 chunks.append((beg_v, end_v))
             if bin_ == meta_bin:
+                # the htslib metadata pseudo-bin carries exactly 2
+                # chunks (file range + mapped/unmapped counts); any
+                # other count is corruption, and chunks[1] below would
+                # otherwise escape as a bare IndexError
+                if n_chunk != 2:
+                    raise ValueError(
+                        f"{path}: truncated or corrupt CSI: metadata "
+                        f"pseudo-bin has {n_chunk} chunks (expected 2)"
+                    )
                 meta = (*chunks[0], *chunks[1])
             else:
                 bins[bin_] = chunks

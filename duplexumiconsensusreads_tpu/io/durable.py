@@ -1,0 +1,64 @@
+"""Crash-safe write primitives shared by every persistent writer.
+
+``os.replace`` makes a rename ATOMIC but not DURABLE: after a power cut
+the directory entry may still be the old one unless the file's bytes
+AND the containing directory were fsynced. Every writer whose output a
+later run trusts by existence (checkpoint manifests, chunk shards, the
+finalised BAM, index files) must therefore write
+
+    tmp -> fsync(tmp) -> os.replace(tmp, dst) -> fsync(dirname(dst))
+
+or a crash can leave a file that LOOKS complete but holds truncated or
+stale bytes — exactly the failure mode the chaos suite's kill tests
+pin down.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_file(f) -> None:
+    """Flush + fsync an open file object (raises on real I/O failure —
+    callers wrap in their bounded-retry ladders)."""
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort directory fsync: makes a preceding rename durable.
+
+    Some filesystems refuse O_RDONLY directory fsync (and on those the
+    rename durability is the mount's problem) — never fail the run
+    over it.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def replace_durable(tmp: str, dst: str) -> None:
+    """Atomic rename + directory fsync — the publish step of the
+    tmp-write protocol."""
+    os.replace(tmp, dst)
+    fsync_dir(os.path.dirname(os.path.abspath(dst)))
+
+
+def write_durable(dst: str, payload: bytes, tmp: str | None = None) -> str:
+    """The whole tmp-write protocol in one call, so no writer can
+    half-apply it. ``tmp`` overrides the staging name (e.g. a
+    pid-suffixed tmp when uncoordinated hosts may write the same
+    path)."""
+    tmp = tmp or dst + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        fsync_file(f)
+    replace_durable(tmp, dst)
+    return dst

@@ -56,6 +56,11 @@ class BamLinearIndex:
         # whose exists() check lands mid-write would load a corrupt npz
         import os as _os
 
+        from duplexumiconsensusreads_tpu.io.durable import (
+            fsync_file,
+            replace_durable,
+        )
+
         # per-writer tmp name: two uncoordinated hosts saving the same
         # index must never interleave into one tmp file
         tmp = f"{path}.tmp.{_os.getpid()}"
@@ -69,7 +74,8 @@ class BamLinearIndex:
                 every=self.every,
                 n_records=self.n_records,
             )
-        _os.replace(tmp, path)
+            fsync_file(f)
+        replace_durable(tmp, path)
 
     @staticmethod
     def load(path: str) -> "BamLinearIndex":

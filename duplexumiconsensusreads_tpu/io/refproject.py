@@ -85,8 +85,11 @@ class RefProjection:
     n_fallback_groups: int = 0
     # reads whose CIGAR consumes no reference (soft-clips + insertions
     # only): they have no reference-anchored bases to place, so their
-    # projected rows stay PAD and contribute no evidence — the analogue
-    # of the modal-CIGAR drop, counted separately
+    # projected rows stay PAD — the analogue of the modal-CIGAR drop,
+    # counted separately. The caller INVALIDATES them (ref_project's
+    # returned ``unanchored`` mask): an all-PAD row would inflate
+    # family size (min-reads gates, depth denominators) without
+    # contributing evidence
     n_unanchored_reads: int = 0
     # True: column tables were keyed by pos_key*2 + frag_end (mate-aware
     # runs — each mate side projects around its own alignment span);
@@ -130,14 +133,18 @@ def ref_project(
     """Project valid reads onto per-position-group reference columns.
 
     Returns (proj_bases (N, C), proj_quals (N, C), RefProjection,
-    fallback (N,) bool). Fallback rows are copied unchanged into columns
-    [0, L) — the caller applies the classic modal-CIGAR policy to them.
+    fallback (N,) bool, unanchored (N,) bool). Fallback rows are copied
+    unchanged into columns [0, L) — the caller applies the classic
+    modal-CIGAR policy to them. Unanchored rows (CIGAR consumes no
+    reference) stay PAD; the caller must invalidate them so they don't
+    inflate family size without contributing evidence.
     """
     n, l = bases.shape
     pk = np.asarray(pos_key)
     rp = np.asarray(read_pos)
     v = np.asarray(valid, bool)
     fallback = np.zeros(n, bool)
+    unanchored = np.zeros(n, bool)
 
     # ---- pass 1: per-group column tables ----
     order = np.argsort(pk[v], kind="stable")
@@ -226,6 +233,7 @@ def ref_project(
         for j, i in enumerate(g.tolist()):
             if not spans[i]:
                 proj.n_unanchored_reads += 1
+                unanchored[i] = True
                 continue
             n_anchored += 1
             start = int(rp[i])
@@ -285,7 +293,7 @@ def ref_project(
         for fi, (kb, members) in enumerate(fam_list):
             proj.fam_emit[(gpk, kb)] = decide(np.asarray(members), nb_f[fi])
 
-    return proj_b, proj_q, proj, fallback
+    return proj_b, proj_q, proj, fallback, unanchored
 
 
 def emit_columns(

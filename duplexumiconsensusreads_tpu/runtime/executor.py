@@ -17,6 +17,7 @@ import time
 import numpy as np
 
 from duplexumiconsensusreads_tpu.constants import NO_FAMILY
+from duplexumiconsensusreads_tpu.runtime.faults import fault_point
 from duplexumiconsensusreads_tpu.types import (
     ConsensusParams,
     FamilyAssignment,
@@ -263,6 +264,9 @@ def start_fetch(out: dict, extra: tuple = ()) -> dict:
 def fetch_outputs(out: dict) -> dict:
     """Blocking conversion of an ALREADY-SELECTED start_fetch dict to
     host NumPy arrays (re-selecting here would drop extra keys)."""
+    # chaos site: a scheduled fault here lands in the streaming
+    # executor's materialize() retry/isolation ladder
+    fault_point("fetch.result")
     return {k: np.asarray(v) for k, v in out.items()}
 
 

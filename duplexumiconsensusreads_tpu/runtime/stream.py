@@ -28,13 +28,20 @@ import hashlib
 import json
 import os
 import struct
+import sys
 import threading
 import time
+import zlib
 from collections import deque
 
 import numpy as np
 
 from duplexumiconsensusreads_tpu.io import bgzf
+from duplexumiconsensusreads_tpu.io.durable import (
+    fsync_file,
+    replace_durable,
+    write_durable,
+)
 from duplexumiconsensusreads_tpu.io.bam import BamHeader, BamRecords, parse_bam
 from duplexumiconsensusreads_tpu.io.convert import (
     UNMAPPED_POS_KEY,
@@ -55,7 +62,55 @@ from duplexumiconsensusreads_tpu.runtime.executor import (
     sort_consensus_outputs,
     start_fetch,
 )
+from duplexumiconsensusreads_tpu.runtime.faults import (
+    fault_point,
+    install_from_env,
+)
 from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+
+# ------------------------------------------------------- host I/O retry
+
+# Transient HOST I/O failures (NFS blips, EIO, ENOSPC races on shared
+# pod storage) get the same bounded-exponential-backoff treatment the
+# device path's materialize() gives dispatch failures. Each attempt
+# passes the step's named fault site first, so chaos schedules
+# (runtime/faults.py) exercise exactly this ladder.
+_HOST_IO_RETRIES = 3
+
+
+def _io_retry(site: str, fn, what: str):
+    last: OSError | None = None
+    for attempt in range(_HOST_IO_RETRIES + 1):
+        try:
+            fault_point(site)
+            return fn()
+        except OSError as e:
+            last = e
+            if attempt == _HOST_IO_RETRIES:
+                break
+            delay = min(0.05 * (2 ** attempt), 2.0)
+            print(
+                f"[duplexumi] transient {what} failure ({e!r}); retry "
+                f"{attempt + 1}/{_HOST_IO_RETRIES} in {delay:.2f}s",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+    raise last
+
+
+def _read_ingest(f, n: int) -> bytes:
+    # re-seek per attempt: a real transient error can fire after the fd
+    # offset already advanced past partially-read bytes, and a naive
+    # re-read would silently skip them (desynced BGZF framing at best,
+    # silently wrong records at worst)
+    pos = f.tell()
+
+    def _once():
+        f.seek(pos)
+        return f.read(n)
+
+    return _io_retry("ingest.read", _once, "ingest read")
 
 
 # --------------------------------------------------------------- input
@@ -95,21 +150,30 @@ def _iter_bgzf_stream(f, read_size=4 << 20, native_lib=None, n_threads=0):
     the streaming analogue of the whole-file native path, so host
     ingest no longer serialises on Python zlib at 200M-read scale.
     """
-    head = f.read(18)
+    head = _read_ingest(f, 18)
     if head[:2] == b"\x1f\x8b":
         buf = head
         while True:
-            data = f.read(read_size)
+            data = _read_ingest(f, read_size)
             if data:
                 buf += data
             off = _complete_prefix(buf)
             if off:
+                block = buf[:off]
                 if native_lib is not None:
-                    yield _inflate_native(native_lib, buf[:off], n_threads)
+                    yield _io_retry(
+                        "bgzf.inflate",
+                        lambda: _inflate_native(native_lib, block, n_threads),
+                        "BGZF inflate",
+                    )
                 else:
-                    yield b"".join(
-                        bgzf.decompress_block(buf, o, s)
-                        for o, s in bgzf.iter_block_offsets(buf[:off])
+                    yield _io_retry(
+                        "bgzf.inflate",
+                        lambda: b"".join(
+                            bgzf.decompress_block(block, o, s)
+                            for o, s in bgzf.iter_block_offsets(block)
+                        ),
+                        "BGZF inflate",
                     )
             buf = buf[off:]
             if not data:
@@ -119,7 +183,7 @@ def _iter_bgzf_stream(f, read_size=4 << 20, native_lib=None, n_threads=0):
     else:
         yield head
         while True:
-            data = f.read(read_size)
+            data = _read_ingest(f, read_size)
             if not data:
                 return
             yield data
@@ -575,43 +639,103 @@ def _concat_records(a: BamRecords, b: BamRecords) -> BamRecords:
 
 # ------------------------------------------------------------ checkpoint
 
+def _verify_shard(entry) -> bool:
+    """Trust a manifest entry only when the shard's bytes still match
+    the size + CRC32 recorded at write time. Existence alone would let
+    a torn shard (crash mid-write before the durable rename, or later
+    corruption) be spliced silently into the final BAM on resume —
+    verification failure just means the chunk is recomputed."""
+    if not isinstance(entry, dict):  # pre-CRC manifest format: recompute
+        return False
+    path = entry.get("path")
+    try:
+        if not path or os.path.getsize(path) != entry.get("size"):
+            return False
+        # bounded-memory streaming CRC: a shard can be a whole chunk's
+        # records, and resume verifies every one of them
+        crc = 0
+        with open(path, "rb") as f:
+            while True:
+                block = f.read(1 << 20)
+                if not block:
+                    break
+                crc = zlib.crc32(block, crc)
+    except OSError:
+        return False
+    return crc == entry.get("crc32")
+
+
 @dataclasses.dataclass
 class Checkpoint:
     path: str
     fingerprint: str
-    done: dict  # chunk index (str) -> shard path
+    done: dict  # chunk index (str) -> {"path", "size", "crc32"}
 
     @staticmethod
-    def load_or_create(path: str, fingerprint: str) -> "Checkpoint":
+    def load_or_create(
+        path: str, fingerprint: str, verify: bool = True
+    ) -> "Checkpoint":
         """Load the manifest, pruning entries that no longer apply.
 
         Whatever this returns is immediately persisted if it differs
         from the on-disk state: a diverging manifest (mismatched
-        fingerprint, dead shard paths) must not survive on disk, where
-        a crash-before-first-mark would let a later --resume splice
-        stale shard bytes from a different run into the output."""
+        fingerprint, dead or checksum-failing shards, torn/garbage
+        JSON) must not survive on disk, where a crash-before-first-mark
+        would let a later --resume splice stale shard bytes from a
+        different run into the output.
+
+        ``verify=False`` skips the per-shard size+CRC re-read — for
+        callers about to discard ``done`` anyway (resume=False), where
+        re-reading every prior shard (~ the whole output BAM) would be
+        thrown-away I/O."""
         done: dict = {}
         on_disk = None
+        torn = False
         if os.path.exists(path):
-            with open(path) as f:
-                on_disk = json.load(f)
-            if on_disk.get("fingerprint") == fingerprint:
-                done = {
-                    k: v for k, v in on_disk.get("done", {}).items() if os.path.exists(v)
-                }
+            try:
+                with open(path) as f:
+                    on_disk = json.load(f)
+                if not isinstance(on_disk, dict) or not isinstance(
+                    on_disk.get("done", {}), dict
+                ):
+                    raise ValueError("manifest is not a JSON object")
+            except (OSError, ValueError) as e:
+                # torn or garbage manifest (crash mid-write where the
+                # rename wasn't durable, external corruption): never
+                # fatal — recomputing the chunks is always safe
+                print(
+                    f"[duplexumi] discarding unreadable checkpoint "
+                    f"manifest {path} ({e})",
+                    file=sys.stderr,
+                )
+                on_disk, torn = None, True
+            else:
+                if on_disk.get("fingerprint") == fingerprint:
+                    done = {
+                        k: v
+                        for k, v in on_disk.get("done", {}).items()
+                        if not verify or _verify_shard(v)
+                    }
         ckpt = Checkpoint(path, fingerprint, done)
-        if on_disk is not None and on_disk != {"fingerprint": fingerprint, "done": done}:
+        if torn or (
+            on_disk is not None
+            and on_disk != {"fingerprint": fingerprint, "done": done}
+        ):
             ckpt.save()
         return ckpt
 
     def save(self) -> None:
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"fingerprint": self.fingerprint, "done": self.done}, f)
-        os.replace(tmp, self.path)
+        payload = json.dumps(
+            {"fingerprint": self.fingerprint, "done": self.done}
+        ).encode()
+        _io_retry(
+            "ckpt.save",
+            lambda: write_durable(self.path, payload),
+            "checkpoint save",
+        )
 
-    def mark(self, chunk: int, shard_path: str) -> None:
-        self.done[str(chunk)] = shard_path
+    def mark(self, chunk: int, shard_path: str, size: int, crc: int) -> None:
+        self.done[str(chunk)] = {"path": shard_path, "size": size, "crc32": crc}
         self.save()
 
 
@@ -728,6 +852,9 @@ def stream_call_consensus(
     rep = RunReport(backend="tpu-stream")
     duplex = consensus.mode == "duplex"
     t_start = time.time()
+    # chaos harness: a DUT_FAULTS schedule gets fresh hit counters per
+    # run (a no-op when unset and no plan was installed programmatically)
+    install_from_env()
 
     # auto-checkpoint: chunked runs are long; a crash mid-file must
     # always be resumable without the user having had the foresight to
@@ -745,7 +872,9 @@ def stream_call_consensus(
             mate_aware=mate_aware, max_reads=max_reads,
             per_base_tags=per_base_tags, read_group=read_group,
         )
-        ckpt = Checkpoint.load_or_create(checkpoint_path, fp)
+        # resume=False discards `done` just below — skip the per-shard
+        # CRC re-read (it would read ~ the whole prior output for nothing)
+        ckpt = Checkpoint.load_or_create(checkpoint_path, fp, verify=resume)
         if not resume:
             # persist a fresh manifest NOW, unconditionally: a stale
             # on-disk manifest (same OR different fingerprint) must not
@@ -810,6 +939,9 @@ def stream_call_consensus(
 
     def dispatch(buckets, spec):
         t0 = time.time()
+        # runs on a transfer worker; a fault here surfaces through the
+        # submit future into materialize's retry/isolation ladder
+        fault_point("dispatch.device_put")
         stacked = stack_buckets(buckets, multiple_of=n_data)
         if spec.packed_io:
             # one byte per cycle instead of two: base|qual packed on the
@@ -837,8 +969,6 @@ def stream_call_consensus(
         """Device results -> host arrays, with failure recovery:
         bounded exponential-backoff class retries, then bucket-by-bucket
         re-dispatch to isolate a poisoned bucket."""
-        import sys
-
         err: Exception | None = None
         if out is not None and hasattr(out, "result"):
             try:
@@ -917,14 +1047,14 @@ def stream_call_consensus(
             phase["scatter"] += time.time() - t0
             pair_base += len(cbuckets)
         t0 = time.time()
-        shard = _finish_chunk(
+        shard, size, crc = _finish_chunk(
             k, parts, duplex, shard_dir, serialize_bam, header_out, name_tag,
             paired_out=grouping.mate_aware, read_group=read_group,
         )
         phase["shard_write"] += time.time() - t0
         shards[k] = shard
         if ckpt:
-            ckpt.mark(k, shard)
+            ckpt.mark(k, shard, size, crc)
         if progress:
             progress(k, rep)
 
@@ -949,7 +1079,9 @@ def stream_call_consensus(
                 read_group = unique_read_group_id(header.text, read_group)
             rep.n_chunks += 1
             if ckpt and str(k) in ckpt.done:
-                shards[k] = ckpt.done[str(k)]
+                # entries surviving load_or_create passed the size+CRC
+                # verification — safe to splice at finalise
+                shards[k] = ckpt.done[str(k)]["path"]
                 n_skipped += 1
                 continue
             # per-read counters cover FRESH work only, so a resumed
@@ -989,9 +1121,10 @@ def stream_call_consensus(
                 setattr(rep, fk, getattr(rep, fk) + fv)
             rep.n_buckets += len(buckets)
             if not buckets:
-                shards[k] = _write_shard(shard_dir, k, b"")
+                spath, ssize, scrc = _write_shard(shard_dir, k, b"")
+                shards[k] = spath
                 if ckpt:
-                    ckpt.mark(k, shards[k])
+                    ckpt.mark(k, spath, ssize, scrc)
                 continue
             entries = []
             for cbuckets, cspec in partition_buckets(
@@ -1035,19 +1168,36 @@ def stream_call_consensus(
         header_out, sort_order="coordinate", rg_id=read_group
     )
     shell = serialize_bam(header_out, _empty_records())
-    with open(out_path, "wb") as f:
-        f.write(bgzf.compress_fast(shell, eof=False))
-        for k in sorted(shards):
-            with open(shards[k], "rb") as s:
-                data = s.read()
-            if data:
-                f.write(bgzf.compress_fast(data, eof=False))
-            n_rec, n_pairs = _count_records(data)
-            # counted from the shard BYTES (not per-chunk returns) so
-            # checkpoint-resumed chunks contribute to both totals
-            rep.n_consensus += n_rec
-            rep.n_consensus_pairs += n_pairs
-        f.write(bgzf.BGZF_EOF)
+
+    def _finalise_once():
+        # atomic + durable: assemble into out_path + ".tmp", fsync,
+        # THEN rename — a crash mid-finalise can never leave a
+        # truncated BAM at the real path that looks final. The whole
+        # assembly is idempotent (shards are immutable inputs), so the
+        # transient-I/O retry simply rewrites the tmp from scratch.
+        tmp = out_path + ".tmp"
+        n_rec = n_pairs = 0
+        with open(tmp, "wb") as f:
+            f.write(bgzf.compress_fast(shell, eof=False))
+            for k in sorted(shards):
+                fault_point("finalise.write")
+                with open(shards[k], "rb") as s:
+                    data = s.read()
+                if data:
+                    f.write(bgzf.compress_fast(data, eof=False))
+                nr, npair = _count_records(data)
+                # counted from the shard BYTES (not per-chunk returns)
+                # so checkpoint-resumed chunks contribute to both totals
+                n_rec += nr
+                n_pairs += npair
+            f.write(bgzf.BGZF_EOF)
+            fsync_file(f)
+        replace_durable(tmp, out_path)
+        return n_rec, n_pairs
+
+    nr_total, npair_total = _io_retry("finalise.write", _finalise_once, "finalise")
+    rep.n_consensus += nr_total
+    rep.n_consensus_pairs += npair_total
     if auto_ckpt:
         # implicit checkpoint: after a successful finalise the shards
         # and manifest have served their purpose
@@ -1106,13 +1256,18 @@ def _empty_records() -> BamRecords:
     )
 
 
-def _write_shard(shard_dir: str, k: int, payload: bytes) -> str:
+def _write_shard(shard_dir: str, k: int, payload: bytes) -> tuple[str, int, int]:
+    """Durable shard write: tmp + fsync + atomic rename + dir fsync,
+    inside the bounded transient-I/O retry. Returns (path, size,
+    crc32) — the manifest triple resume verification re-checks."""
     path = os.path.join(shard_dir, f"chunk{k:06d}.recs")
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)
-    return path
+    crc = zlib.crc32(payload)
+
+    def _once():
+        write_durable(path, payload)
+        return path, len(payload), crc
+
+    return _io_retry("shard.write", _once, f"shard {k} write")
 
 
 def _count_records(data: bytes) -> tuple[int, int]:
@@ -1142,7 +1297,7 @@ def _count_records(data: bytes) -> tuple[int, int]:
 def _finish_chunk(
     k, parts, duplex, shard_dir, serialize_bam, header, name_tag="",
     paired_out=False, read_group="A",
-) -> str:
+) -> tuple[str, int, int]:
     """Merge one chunk's per-class scattered outputs and write its
     shard. parts rows are 8-tuples — (..., cons_mate, cons_pair,
     cons_end) — or 10 with per-base tags: cols[8] the depth matrix,

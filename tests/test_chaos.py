@@ -1,0 +1,270 @@
+"""Chaos suite: deterministic fault injection against the streaming
+executor (runtime/faults.py).
+
+Every recovery claim is pinned to the STRONGEST observable contract:
+after injected transient faults the final BAM is byte-identical to the
+fault-free run, and after an injected hard kill at each phase boundary
+a resume=True rerun converges to the same bytes. A corrupted shard
+under resume must be caught by the manifest size+CRC verification and
+recomputed, never spliced.
+
+All schedules are seeded/explicit, so every failure here replays
+identically. The suite is deliberately small and fast (tier-1, not
+slow): one shared simulated input, one shared fault-free reference.
+"""
+
+import json
+import os
+import zlib
+
+import pytest
+
+from duplexumiconsensusreads_tpu.io import read_bam, simulated_bam
+from duplexumiconsensusreads_tpu.runtime import faults
+from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+from duplexumiconsensusreads_tpu.simulate import SimConfig
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+pytestmark = pytest.mark.chaos
+
+GP = GroupingParams(strategy="adjacency", paired=True)
+CP = ConsensusParams(mode="duplex")
+KW = dict(capacity=128, chunk_reads=90)
+
+
+@pytest.fixture(scope="module")
+def sim(tmp_path_factory):
+    """(input path, fault-free reference output bytes) — computed once;
+    every chaos run must reproduce these bytes exactly."""
+    d = tmp_path_factory.mktemp("chaos")
+    path = str(d / "in.bam")
+    cfg = SimConfig(n_molecules=70, n_positions=9, umi_error=0.02, seed=31)
+    simulated_bam(cfg, path=path, sort=True)
+    ref = str(d / "ref.bam")
+    rep = stream_call_consensus(path, ref, GP, CP, **KW)
+    assert rep.n_chunks >= 3  # enough phase-boundary hits for every nth below
+    with open(ref, "rb") as f:
+        return path, f.read()
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep_and_clean_plan(monkeypatch):
+    # retries back off via stream.time.sleep; don't spend wall time on it
+    monkeypatch.setattr(
+        "duplexumiconsensusreads_tpu.runtime.stream.time.sleep",
+        lambda s: None,
+    )
+    yield
+    faults.uninstall()
+
+
+class TestPlanParsing:
+    def test_parse_and_seeded_replay(self):
+        p1 = faults.FaultPlan.parse("seed:1234:6")
+        p2 = faults.FaultPlan.parse("seed:1234:6")
+        assert p1.schedule == p2.schedule  # seeded schedules replay identically
+        p3 = faults.FaultPlan.parse("shard.write:2:enospc,ckpt.save:1:kill")
+        assert p3.schedule["shard.write"][2] == "enospc"
+        assert p3.schedule["ckpt.save"][1] == "kill"
+
+    def test_parse_rejects_garbage(self):
+        for bad in (
+            "bogus.site:1:oserror",
+            "shard.write:0:oserror",
+            "shard.write:1:frobnicate",
+            "shard.write:1",
+        ):
+            with pytest.raises(ValueError):
+                faults.FaultPlan.parse(bad)
+
+    def test_env_malformed_spec_names_the_var(self, monkeypatch):
+        monkeypatch.setenv("DUT_FAULTS", "shard.write:1")
+        faults.uninstall()
+        with pytest.raises(ValueError, match="DUT_FAULTS"):
+            faults.install_from_env()
+
+    def test_fault_point_is_noop_when_uninstalled(self):
+        faults.uninstall()
+        faults.fault_point("shard.write")  # must not raise or count
+
+    def test_fires_exactly_once_per_entry(self):
+        plan = faults.FaultPlan.parse("shard.write:2:oserror")
+        faults.install(plan)
+        faults.fault_point("shard.write")
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("shard.write")
+        faults.fault_point("shard.write")  # hit 3: schedule exhausted
+        assert plan.n_fired == 1 and plan.hits("shard.write") == 3
+
+
+@pytest.mark.parametrize("site", faults.KNOWN_SITES)
+def test_transient_fault_at_each_site_byte_identical(site, sim, tmp_path):
+    """One seeded transient fault at each named site: the run must
+    absorb it through its retry/isolation ladders and produce a final
+    BAM byte-identical to the fault-free run."""
+    path, ref_bytes = sim
+    plan = faults.FaultPlan.seeded(
+        zlib.crc32(site.encode()), sites=(site,), n_faults=1, max_nth=1
+    )
+    faults.install(plan)
+    out = str(tmp_path / "out.bam")
+    stream_call_consensus(path, out, GP, CP, **KW)
+    assert plan.n_fired >= 1  # the schedule really injected
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+
+
+def test_seeded_multi_fault_schedule_byte_identical(sim, tmp_path):
+    """A seeded schedule spraying several transient faults across sites
+    mid-run still converges to the reference bytes."""
+    path, ref_bytes = sim
+    plan = faults.FaultPlan.seeded(20260803, n_faults=8)
+    faults.install(plan)
+    out = str(tmp_path / "multi.bam")
+    stream_call_consensus(path, out, GP, CP, **KW)
+    assert plan.n_fired >= 1
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+
+
+# the four phase boundaries of the write/recover spine:
+#   shard.write:1    killed during the first shard write (tmp only —
+#                    the durable rename never happened)
+#   ckpt.save:2      post-shard-write, pre-mark persist (save 1 is the
+#                    manifest clear in the run preamble)
+#   finalise.write:1 pre-finalise: all shards + manifest complete
+#   finalise.write:2 mid-finalise: out.tmp partially assembled
+BOUNDARY_KILLS = [
+    ("shard.write", 1),
+    ("ckpt.save", 2),
+    ("finalise.write", 1),
+    ("finalise.write", 2),
+]
+
+
+@pytest.mark.parametrize("site,nth", BOUNDARY_KILLS)
+def test_kill_at_phase_boundary_then_resume_converges(site, nth, sim, tmp_path):
+    path, ref_bytes = sim
+    out = str(tmp_path / "k.bam")
+    faults.install(faults.FaultPlan.parse(f"{site}:{nth}:kill"))
+    with pytest.raises(faults.InjectedKill):
+        stream_call_consensus(path, out, GP, CP, **KW)
+    faults.uninstall()
+    # atomic finalise: no half-written BAM may be visible at the real
+    # path after ANY kill — resume decides from the manifest alone
+    assert not os.path.exists(out)
+    rep = stream_call_consensus(path, out, GP, CP, resume=True, **KW)
+    if site == "finalise.write":
+        # everything was durable before the kill: pure re-finalise
+        assert rep.n_chunks_skipped == rep.n_chunks
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+    assert not os.path.exists(out + ".ckpt")  # auto-ckpt cleaned on success
+
+
+@pytest.mark.parametrize("damage", ["flip", "truncate"])
+def test_corrupted_shard_detected_and_recomputed(damage, sim, tmp_path):
+    """Resume against a deliberately corrupted shard: the manifest
+    size+CRC verification must drop the entry and recompute the chunk,
+    not splice the bad bytes into the output."""
+    path, ref_bytes = sim
+    out = str(tmp_path / "c.bam")
+    ck = str(tmp_path / "ck.json")  # explicit checkpoint: shards survive
+    stream_call_consensus(path, out, GP, CP, checkpoint_path=ck, **KW)
+    with open(ck) as f:
+        manifest = json.load(f)
+    entry = manifest["done"]["0"]
+    assert {"path", "size", "crc32"} <= set(entry)
+    assert entry["size"] > 0
+    if damage == "flip":
+        # size unchanged: only the CRC can catch this
+        with open(entry["path"], "r+b") as f:
+            f.seek(entry["size"] // 2)
+            b = f.read(1)
+            f.seek(entry["size"] // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+    else:
+        with open(entry["path"], "r+b") as f:
+            f.truncate(entry["size"] // 2)
+    rep = stream_call_consensus(
+        path, out, GP, CP, checkpoint_path=ck, resume=True, **KW
+    )
+    assert rep.n_chunks_skipped == rep.n_chunks - 1  # only chunk 0 recomputed
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+
+
+@pytest.mark.parametrize(
+    "garbage",
+    ['{"fingerprint": "x", "done"', "[1, 2]", "", '{"done": null}'],
+)
+def test_torn_manifest_discarded_not_fatal(garbage, sim, tmp_path):
+    """A torn/garbage checkpoint manifest (crash mid-write where the
+    rename wasn't durable, external corruption) must be discarded and
+    the run recomputed — never a JSON traceback that needs a manual
+    rm of the .ckpt."""
+    path, ref_bytes = sim
+    out = str(tmp_path / "t.bam")
+    ck = str(tmp_path / "ck.json")
+    with open(ck, "w") as f:
+        f.write(garbage)
+    rep = stream_call_consensus(
+        path, out, GP, CP, checkpoint_path=ck, resume=True, **KW
+    )
+    assert rep.n_chunks_skipped == 0  # nothing trustworthy to skip
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+    with open(ck) as f:
+        assert len(json.load(f)["done"]) == rep.n_chunks  # manifest healed
+
+
+def test_env_var_activates_schedule(sim, tmp_path, monkeypatch):
+    """DUT_FAULTS installs a fresh plan (fresh counters) per run."""
+    path, ref_bytes = sim
+    monkeypatch.setenv("DUT_FAULTS", "shard.write:1:enospc")
+    out = str(tmp_path / "env.bam")
+    stream_call_consensus(path, out, GP, CP, **KW)
+    plan = faults.get_active()
+    assert plan is not None and plan.n_fired == 1
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+
+
+def test_cli_chaos_flag(sim, tmp_path, monkeypatch):
+    """`call --chaos` wires a schedule through the CLI; a bad schedule
+    is a clean CLI error."""
+    from duplexumiconsensusreads_tpu.cli import main
+
+    path, ref_bytes = sim
+    out = str(tmp_path / "cli.bam")
+    # a stale env schedule must NOT override the explicit flag
+    monkeypatch.setenv("DUT_FAULTS", "shard.write:1:kill")
+    rc = main(
+        ["call", path, "-o", out, "--config", "config3", "--capacity", "128",
+         "--chunk-reads", "90", "--chaos", "fetch.result:1:oserror"]
+    )
+    assert rc == 0
+    plan = faults.get_active()
+    assert plan is not None and plan.n_fired == 1
+    assert plan.spec == "fetch.result:1:oserror"
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+    with pytest.raises(SystemExit, match="--chaos"):
+        main(
+            ["call", path, "-o", out, "--chunk-reads", "90",
+             "--chaos", "nope:1:oserror"]
+        )
+    # only the streaming executor threads the fault sites — on the
+    # whole-file path the flag would be silently inert
+    with pytest.raises(SystemExit, match="--chunk-reads"):
+        main(["call", path, "-o", out, "--chaos", "fetch.result:1:oserror"])
+
+
+def test_ingest_retry_is_bounded(sim, tmp_path):
+    """More consecutive transient failures than the retry budget at one
+    site must surface the error, not loop forever."""
+    path, _ = sim
+    spec = ",".join(f"ingest.read:{n}:oserror" for n in range(1, 6))
+    faults.install(faults.FaultPlan.parse(spec))
+    with pytest.raises(OSError, match="injected"):
+        stream_call_consensus(path, str(tmp_path / "x.bam"), GP, CP, **KW)

@@ -256,3 +256,38 @@ def test_truncated_index_fails_loudly(tmp_path, fmt):
             f.write(data[:cut])
         with pytest.raises(ValueError, match="truncated or corrupt"):
             (read_bai if fmt == "bai" else read_csi)(trunc)
+
+
+@pytest.mark.parametrize("n_chunk", [0, 1, 3])
+def test_metadata_pseudo_bin_chunk_count_validated(tmp_path, n_chunk):
+    """A metadata pseudo-bin with n_chunk != 2 must raise the loud
+    ValueError-with-path, not escape as a bare IndexError (n_chunk < 2)
+    or silently misparse (n_chunk > 2) — ADVICE r5."""
+    from duplexumiconsensusreads_tpu.io.bai import METADATA_BIN, read_bai
+    from duplexumiconsensusreads_tpu.io.csi import _n_bins
+
+    chunks = struct.pack("<QQ", 0, 0) * n_chunk
+    bai = (
+        b"BAI\x01" + struct.pack("<i", 1)  # magic, n_ref
+        + struct.pack("<i", 1)  # n_bin
+        + struct.pack("<Ii", METADATA_BIN, n_chunk) + chunks
+        + struct.pack("<i", 0)  # n_intv
+        + struct.pack("<Q", 0)  # n_no_coor
+    )
+    p = tmp_path / "meta.bai"
+    p.write_bytes(bai)
+    with pytest.raises(ValueError, match=r"meta\.bai.*pseudo-bin"):
+        read_bai(str(p))
+
+    meta_bin = _n_bins(5) + 1
+    csi = (
+        CSI_MAGIC + struct.pack("<iii", 14, 5, 0)  # min_shift, depth, l_aux
+        + struct.pack("<i", 1)  # n_ref
+        + struct.pack("<i", 1)  # n_bin
+        + struct.pack("<IQi", meta_bin, 0, n_chunk) + chunks
+        + struct.pack("<Q", 0)  # n_no_coor
+    )
+    p2 = tmp_path / "meta.csi"
+    p2.write_bytes(csi)
+    with pytest.raises(ValueError, match=r"meta\.csi.*pseudo-bin"):
+        read_csi(str(p2))

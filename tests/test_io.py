@@ -247,3 +247,46 @@ def test_host_cpu_fingerprint_stable_and_flagged():
     import hashlib
 
     assert a != hashlib.sha256(b"").hexdigest()[:12]
+
+
+def test_record_bin_uses_cigar_reference_span():
+    """The per-record serializer's bin field must cover the CIGAR
+    reference span (M/D/N ops), not l_seq: a consensus record with a
+    deletion spans more reference than it has bases, and strict
+    validators check bin == reg2bin(pos, pos + ref_span) (ADVICE r5)."""
+    import struct
+
+    from duplexumiconsensusreads_tpu.io.bam import BamRecords, _reg2bin
+
+    L = 20
+    # pos chosen so pos + L stays inside one 16 kb leaf window while
+    # pos + 25 (the M+D+M reference span) crosses into the next — the
+    # two candidate bins genuinely differ
+    pos = 70 * 16384 - 22
+    recs = BamRecords(
+        names=["r0"],
+        flags=np.zeros(1, np.uint16),
+        ref_id=np.zeros(1, np.int32),
+        pos=np.array([pos], np.int32),
+        mapq=np.full(1, 60, np.uint8),
+        next_ref_id=np.full(1, -1, np.int32),
+        next_pos=np.full(1, -1, np.int32),
+        tlen=np.zeros(1, np.int32),
+        lengths=np.array([L], np.int32),
+        seq=np.zeros((1, L), np.uint8),
+        qual=np.full((1, L), 30, np.uint8),
+        cigars=[[(10, "M"), (5, "D"), (10, "M")]],
+        umi=["ACGT"],
+        aux_raw=[b""],
+    )
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:coordinate\n",
+        ref_names=["chr1"],
+        ref_lengths=[10_000_000],
+    )
+    assert _reg2bin(pos, pos + 25) != _reg2bin(pos, pos + L)  # test is live
+    data = serialize_bam(header, recs)
+    text_len = len(header.text.encode())
+    rec_off = 4 + 4 + text_len + 4 + (4 + len(b"chr1\x00") + 4)
+    (got_bin,) = struct.unpack_from("<H", data, rec_off + 4 + 10)
+    assert got_bin == _reg2bin(pos, pos + 25)

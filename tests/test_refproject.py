@@ -200,7 +200,7 @@ def test_wide_group_falls_back(tmp_path):
     from duplexumiconsensusreads_tpu.io.refproject import ref_project
 
     pk = np.zeros(2, np.int64)  # force one shared group
-    pb, pq, proj, fb = ref_project(
+    pb, pq, proj, fb, _ = ref_project(
         np.asarray(r2.seq), np.asarray(r2.qual), np.ones(2, bool), pk,
         np.zeros((2, 4), np.uint8), np.asarray(r2.pos),
         lambda i: r2.cigars[i],
@@ -501,3 +501,42 @@ def test_backend_parity_on_projected_grid(tmp_path):
     for i in range(len(a)):
         la = int(a.lengths[i])
         np.testing.assert_array_equal(a.seq[i, :la], b.seq[i, :la])
+
+
+def test_unanchored_reads_invalidated(tmp_path):
+    """A read whose CIGAR consumes no reference (soft-clip/insertion
+    only) places nothing on the projected grid: it must be counted in
+    n_projection_unanchored_reads AND invalidated — an all-PAD row
+    would inflate family size (min-reads gates, depth denominators)
+    without contributing evidence (ADVICE r5)."""
+    rng = np.random.default_rng(3)
+    L = 40
+    true = rng.integers(0, 4, L).astype(np.uint8)
+    seqs = np.broadcast_to(true, (4, L)).copy()
+    cigars = [[(L, "M")] for _ in range(4)]
+    cigars[3] = [(L, "S")]  # fully soft-clipped: no reference anchor
+    bam = tmp_path / "unanch.bam"
+    _family_bam(str(bam), cigars, seqs, L=L)
+    _, recs = read_bam(str(bam))
+    batch, info = records_to_readbatch(recs, duplex=False, ref_projected=True)
+    assert info["n_projection_unanchored_reads"] == 1
+    assert not batch.valid[3]
+    assert int(np.asarray(batch.valid).sum()) == 3
+    assert info["n_valid"] == 3
+    assert info["n_dropped_cigar"] == 0  # drop counters stay disjoint
+
+    # end-to-end: consensus depth counts only the anchored evidence
+    out = tmp_path / "cons.bam"
+    rep = _call(bam, out, tmp_path)
+    assert rep["n_projection_unanchored_reads"] == 1
+    _, cons = read_bam(str(out))
+    assert len(cons) == 1
+    import struct as _struct
+
+    from duplexumiconsensusreads_tpu.io.bam import iter_aux_fields
+
+    cd = None
+    for _s, t, _typ, vs, _e in iter_aux_fields(cons.aux_raw[0]):
+        if t == b"cD":
+            cd = _struct.unpack_from("<i", cons.aux_raw[0], vs)[0]
+    assert cd == 3
