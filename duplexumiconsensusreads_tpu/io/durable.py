@@ -11,6 +11,13 @@ finalised BAM, index files) must therefore write
 or a crash can leave a file that LOOKS complete but holds truncated or
 stale bytes — exactly the failure mode the chaos suite's kill tests
 pin down.
+
+Incremental assembly (the streaming executor's pipelined finalise)
+stays inside this protocol: appends go to the ``.tmp`` staging file
+only, each append is made idempotent with :func:`rewrite_from` (seek +
+truncate + write, so a bounded retry after a torn append cannot
+duplicate bytes), and the ``os.replace`` publish still happens exactly
+once, at the very end.
 """
 
 from __future__ import annotations
@@ -42,6 +49,16 @@ def fsync_dir(path: str) -> None:
         pass
     finally:
         os.close(fd)
+
+
+def rewrite_from(f, offset: int, payload: bytes) -> None:
+    """Idempotent append to a staging file: truncate back to ``offset``
+    and write ``payload`` there. A transient failure mid-write can be
+    retried with the same arguments without duplicating or interleaving
+    bytes — the append-side twin of the tmp-write protocol."""
+    f.seek(offset)
+    f.truncate(offset)
+    f.write(payload)
 
 
 def replace_durable(tmp: str, dst: str) -> None:

@@ -44,6 +44,7 @@ class RunReport:
     n_size_classes: int = 0
     n_pipeline_compiles: int = 0
     n_retries: int = 0  # streaming: chunks re-dispatched after a failure
+    n_drain_workers: int = 0  # streaming: drain worker pool size
     n_mixed_mate_families: int = 0  # see io.convert.warn_mixed_mates
     n_consensus_pairs: int = 0  # mate-aware: consensus R1+R2 pairs emitted
     # result-changing bucketing fallbacks (bucketing.FALLBACK_COUNTERS):
@@ -86,6 +87,66 @@ class RunReport:
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
+
+
+# Transfer-pool size for the streaming executor (runtime/stream.py
+# builds its ThreadPoolExecutor from this, and the busy-wall canary
+# thresholds below must agree with the real pool — one constant, no
+# cross-module drift).
+XFER_WORKERS = 4
+DRAIN_PHASES = ("device_wait_fetch", "scatter", "shard_write")
+# rep.seconds entries that are not per-stage busy seconds
+# (main_loop_stall is main-thread blocked-on-back-pressure wall, shown
+# via its dedicated summary line, not a stage row)
+_NON_STAGE_KEYS = ("total", "drain_utilization", "main_loop_stall")
+
+
+def busy_wall_table(
+    seconds: dict, drain_workers: int = 1
+) -> tuple[list[str], list[str]]:
+    """Render ``RunReport.seconds`` as overlapped busy-time vs wall rows.
+
+    Since the pipelined drain, phases are per-stage BUSY seconds accrued
+    on whichever thread runs the stage — they overlap each other, so
+    summing them no longer gives the wall. A stage can legitimately
+    exceed the wall only up to its worker-pool size; busy beyond
+    wall x pool is impossible with honest clocks, so such stages are
+    returned as accounting-bug canaries (second element) and flagged
+    BUSY>WALL in the rendered rows.
+    """
+    wall = float(seconds.get("total") or 0.0)
+    lines = [
+        f"{'stage':<18} {'busy_s':>9} {'wall_s':>9} {'busy/wall':>9}  note"
+    ]
+    bugs: list[str] = []
+    for k, v in seconds.items():
+        if k in _NON_STAGE_KEYS:
+            continue
+        if k == "dispatch":
+            # dispatch normally runs on the xfer pool, but materialize's
+            # retry path re-dispatches on drain workers too — the
+            # canary threshold must cover both or retry-heavy runs trip
+            # a false accounting bug
+            pool = XFER_WORKERS + drain_workers
+        else:
+            pool = drain_workers if k in DRAIN_PHASES else 1
+        frac = (v / wall) if wall else 0.0
+        if wall and v > wall * pool + 0.05:
+            note = "BUSY>WALL (accounting bug)"
+            bugs.append(k)
+        elif pool > 1:
+            note = f"pool x{pool}"
+        else:
+            note = ""
+        lines.append(f"{k:<18} {v:9.3f} {wall:9.3f} {frac:9.2f}  {note}")
+    if "drain_utilization" in seconds:
+        lines.append(f"drain_utilization  {seconds['drain_utilization']:.3f}")
+    if "main_loop_stall" in seconds and wall:
+        lines.append(
+            f"main loop stalled on drain back-pressure "
+            f"{seconds['main_loop_stall'] / wall:.0%} of the wall"
+        )
+    return lines, bugs
 
 
 def representative_per_family(
@@ -391,13 +452,13 @@ def call_batch_tpu(
     rep = report or RunReport()
     duplex = consensus.mode == "duplex"
 
-    t0 = time.time()
+    t0 = time.monotonic()
     fb: dict = {}
     buckets = build_buckets(batch, capacity=capacity, grouping=grouping, counters=fb)
     for k, v in fb.items():
         setattr(rep, k, getattr(rep, k) + v)
     rep.n_buckets = len(buckets)
-    rep.seconds["bucketing"] = round(time.time() - t0, 4)
+    rep.seconds["bucketing"] = round(time.monotonic() - t0, 4)
     if not buckets:
         u = batch.umi_len
         z = np.zeros
@@ -434,7 +495,7 @@ def call_batch_tpu(
         per_base_counts=per_base_tags,
     )
 
-    t0 = time.time()
+    t0 = time.monotonic()
     pending = []
     for cbuckets, cspec in part:
         stacked = stack_buckets(cbuckets, multiple_of=n_data)
@@ -451,9 +512,9 @@ def call_batch_tpu(
                 ),
             )
         )
-    rep.seconds["device_dispatch"] = round(time.time() - t0, 4)
+    rep.seconds["device_dispatch"] = round(time.monotonic() - t0, 4)
 
-    t0 = time.time()
+    t0 = time.monotonic()
     parts = []
     pair_base = 0
     for cbuckets, out in pending:
@@ -468,7 +529,7 @@ def call_batch_tpu(
             )
         )
         pair_base += n_real
-    rep.seconds["device_pipeline_and_scatter"] = round(time.time() - t0, 4)
+    rep.seconds["device_pipeline_and_scatter"] = round(time.monotonic() - t0, 4)
     rep.n_size_classes = len(part)
 
     cols = sort_consensus_outputs(
@@ -489,10 +550,10 @@ def call_batch_cpu(
     from duplexumiconsensusreads_tpu.ops import ConsensusCaller, UmiGrouper
 
     rep = report or RunReport()
-    t0 = time.time()
+    t0 = time.monotonic()
     fams: FamilyAssignment = UmiGrouper(grouping, backend="cpu")(batch)
     cons = ConsensusCaller(consensus, backend="cpu")(batch, fams)
-    rep.seconds["cpu_pipeline"] = round(time.time() - t0, 4)
+    rep.seconds["cpu_pipeline"] = round(time.monotonic() - t0, 4)
     rep.n_families = int(fams.n_families)
     rep.n_molecules = int(fams.n_molecules)
 
@@ -627,7 +688,7 @@ def call_consensus_file(
     rep = RunReport(backend=backend)
     duplex = consensus.mode == "duplex"
 
-    t0 = time.time()
+    t0 = time.monotonic()
     # the mixed-mate warning only applies when mate-aware stays off
     # (auto-on and forced-on runs HANDLE those families)
     header, batch, info = load_input(
@@ -670,7 +731,7 @@ def call_consensus_file(
         from duplexumiconsensusreads_tpu.io.convert import downsample_families
 
         rep.n_downsampled_reads = downsample_families(batch, max_reads)
-    rep.seconds["read_input"] = round(time.time() - t0, 4)
+    rep.seconds["read_input"] = round(time.monotonic() - t0, 4)
 
     prof = None
     if profile_dir:
@@ -696,7 +757,7 @@ def call_consensus_file(
 
             jax.profiler.stop_trace()
 
-    t0 = time.time()
+    t0 = time.monotonic()
     # collision-free id FIRST: the RG:Z tags must match the header @RG
     read_group = unique_read_group_id(header.text, read_group)
     out_recs = consensus_to_records(
@@ -735,7 +796,7 @@ def call_consensus_file(
             build_bai(out_path)
     rep.n_consensus = len(out_recs)
     rep.n_consensus_pairs = count_consensus_pairs(out_recs)
-    rep.seconds["write_output"] = round(time.time() - t0, 4)
+    rep.seconds["write_output"] = round(time.monotonic() - t0, 4)
 
     if report_path:
         with open(report_path, "w") as f:

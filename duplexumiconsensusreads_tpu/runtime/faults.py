@@ -53,9 +53,10 @@ KNOWN_SITES = (
     "bgzf.inflate",  # block-batch decompression (native or Python)
     "dispatch.device_put",  # stack/pack/device dispatch (xfer worker)
     "fetch.result",  # device->host materialisation of outputs
-    "shard.write",  # per-chunk shard tmp-write + durable rename
+    "drain.scatter",  # scatter-back of device outputs (drain worker)
+    "shard.write",  # per-chunk shard serialize+deflate+durable rename
     "ckpt.save",  # checkpoint manifest persist
-    "finalise.write",  # final BAM assembly (hit once per attempt + per shard)
+    "finalise.write",  # incremental finalise appends + terminal EOF/rename
 )
 
 _EXC_ERRNO = {

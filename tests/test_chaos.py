@@ -15,9 +15,15 @@ slow): one shared simulated input, one shared fault-free reference.
 
 import json
 import os
+import time
 import zlib
 
 import pytest
+
+# the autouse fixture no-ops time.sleep (retry backoff); the
+# out-of-order drain tests need a REAL sleep to stagger worker
+# completion, captured before any patching
+_REAL_SLEEP = time.sleep
 
 from duplexumiconsensusreads_tpu.io import read_bam, simulated_bam
 from duplexumiconsensusreads_tpu.runtime import faults
@@ -127,13 +133,19 @@ def test_seeded_multi_fault_schedule_byte_identical(sim, tmp_path):
         assert f.read() == ref_bytes
 
 
-# the four phase boundaries of the write/recover spine:
+# the phase boundaries of the write/recover spine. With the pipelined
+# drain, finalise is INCREMENTAL: finalise.write hits happen mid-run
+# (header write + per-shard appends into out.tmp, in frontier order)
+# and the terminal EOF/fsync/rename hits come last:
 #   shard.write:1    killed during the first shard write (tmp only —
-#                    the durable rename never happened)
+#                    the durable rename never happened), on a drain
+#                    worker; the kill must surface through the future
 #   ckpt.save:2      post-shard-write, pre-mark persist (save 1 is the
 #                    manifest clear in the run preamble)
-#   finalise.write:1 pre-finalise: all shards + manifest complete
-#   finalise.write:2 mid-finalise: out.tmp partially assembled
+#   finalise.write:1 killed writing the tmp's header — chunk 0 was
+#                    already durably marked (mark precedes append)
+#   finalise.write:2 mid-incremental-finalise: out.tmp partially
+#                    assembled, a prefix of chunks durable
 BOUNDARY_KILLS = [
     ("shard.write", 1),
     ("ckpt.save", 2),
@@ -155,8 +167,10 @@ def test_kill_at_phase_boundary_then_resume_converges(site, nth, sim, tmp_path):
     assert not os.path.exists(out)
     rep = stream_call_consensus(path, out, GP, CP, resume=True, **KW)
     if site == "finalise.write":
-        # everything was durable before the kill: pure re-finalise
-        assert rep.n_chunks_skipped == rep.n_chunks
+        # finalise.write fires only at commit time, and the commit
+        # marks BEFORE it appends — so at least the frontier chunk was
+        # durable and resume must skip it
+        assert rep.n_chunks_skipped >= 1
     with open(out, "rb") as f:
         assert f.read() == ref_bytes
     assert not os.path.exists(out + ".ckpt")  # auto-ckpt cleaned on success
@@ -258,6 +272,80 @@ def test_cli_chaos_flag(sim, tmp_path, monkeypatch):
     # whole-file path the flag would be silently inert
     with pytest.raises(SystemExit, match="--chunk-reads"):
         main(["call", path, "-o", out, "--chaos", "fetch.result:1:oserror"])
+
+
+def _force_reverse_drain(monkeypatch, order_log=None):
+    """Delay _finish_chunk so drain workers complete early chunks LAST
+    (chunk 0 slowest): with a wide pool, completion order inverts chunk
+    order and the ordered frontier is what must restore it."""
+    import duplexumiconsensusreads_tpu.runtime.stream as stream_mod
+
+    real = stream_mod._finish_chunk
+
+    def reordering(k, *a, **kw):
+        _REAL_SLEEP(0.45 * max(0, 3 - k))
+        res = real(k, *a, **kw)
+        if order_log is not None:
+            order_log.append(k)
+        return res
+
+    monkeypatch.setattr(stream_mod, "_finish_chunk", reordering)
+
+
+OOO_KW = dict(capacity=128, chunk_reads=90, drain_workers=4, max_inflight=4)
+
+
+def test_out_of_order_drain_byte_identical_marks_in_order(
+    sim, tmp_path, monkeypatch
+):
+    """Drain workers forced to finish chunks in reverse order: output
+    bytes must be identical to the serial reference and checkpoint
+    marks must still be committed strictly in chunk order (the
+    ordered-completion frontier)."""
+    import duplexumiconsensusreads_tpu.runtime.stream as stream_mod
+
+    path, ref_bytes = sim
+    done_order: list = []
+    _force_reverse_drain(monkeypatch, done_order)
+    marks: list = []
+    real_mark = stream_mod.Checkpoint.mark
+
+    def recording_mark(self, chunk, *a, **kw):
+        marks.append(chunk)
+        return real_mark(self, chunk, *a, **kw)
+
+    monkeypatch.setattr(stream_mod.Checkpoint, "mark", recording_mark)
+    out = str(tmp_path / "ooo.bam")
+    rep = stream_call_consensus(path, out, GP, CP, **OOO_KW)
+    assert rep.n_chunks >= 3
+    # the delays really inverted completion order...
+    assert done_order != sorted(done_order)
+    # ...yet marks landed strictly in chunk order, gap-free
+    assert marks == list(range(rep.n_chunks))
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+
+
+def test_kill_mid_out_of_order_drain_then_resume_converges(
+    sim, tmp_path, monkeypatch
+):
+    """A hard kill at the new drain.scatter site while workers are
+    completing out of order: on-disk state is whatever prefix the
+    frontier made durable, and --resume must converge to the reference
+    bytes (extends the boundary-kill matrix to the pipelined drain)."""
+    path, ref_bytes = sim
+    _force_reverse_drain(monkeypatch)
+    out = str(tmp_path / "oookill.bam")
+    faults.install(faults.FaultPlan.parse("drain.scatter:2:kill"))
+    with pytest.raises(faults.InjectedKill):
+        stream_call_consensus(path, out, GP, CP, **OOO_KW)
+    faults.uninstall()
+    assert not os.path.exists(out)  # rename is still terminal-only
+    rep = stream_call_consensus(path, out, GP, CP, resume=True, **OOO_KW)
+    assert rep.n_chunks >= 3
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+    assert not os.path.exists(out + ".ckpt")
 
 
 def test_ingest_retry_is_bounded(sim, tmp_path):

@@ -39,6 +39,8 @@ def _simulate(tmp_path, **kw):
     ]
     if kw.get("single_strand"):
         args.append("--single-strand")
+    if kw.get("sorted"):
+        args.append("--sorted")
     assert main(args) == 0
     return bam, truth
 
@@ -168,11 +170,22 @@ def test_config_file_layer(tmp_path):
     ) == 0
     rep = json.load(open(rep_path))
     assert rep["n_consensus"] > 0
-    # file can be TOML too
+    # file can be TOML too; drain_workers round-trips through the
+    # config schema onto the streaming executor (which needs a
+    # coordinate-sorted input)
+    bam_s, _ = _simulate(tmp_path, molecules=40, seed=21, sorted=True)
     conf_t = str(tmp_path / "c.toml")
     with open(conf_t, "w") as f:
-        f.write('config = "config3"\ncapacity = 256\n')
-    assert main(["call", bam, "-o", out, "--config-file", conf_t]) == 0
+        f.write(
+            'config = "config3"\ncapacity = 256\n'
+            "chunk_reads = 120\ndrain_workers = 3\n"
+        )
+    rep_t_path = str(tmp_path / "rt.json")
+    assert main(
+        ["call", bam_s, "-o", out, "--config-file", conf_t,
+         "--report", rep_t_path]
+    ) == 0
+    assert json.load(open(rep_t_path))["n_drain_workers"] == 3
     # unknown keys must be rejected, not ignored
     bad = str(tmp_path / "bad.json")
     with open(bad, "w") as f:

@@ -491,6 +491,63 @@ class TestFaultRecovery:
         np.testing.assert_array_equal(r_ref.seq, r_out.seq)
 
 
+def test_drain_workers_ab_byte_identical(tmp_path):
+    """The acceptance A/B: serial drain (--drain-workers 1) vs a wide
+    pool must produce byte-identical output, and the report must carry
+    the overlapped busy-time accounting fields."""
+    path, _, _ = _sorted_bam(tmp_path)
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex")
+    outs = {}
+    for n in (1, 3):
+        out = str(tmp_path / f"dw{n}.bam")
+        rep = stream_call_consensus(
+            path, out, gp, cp, capacity=256, chunk_reads=150, drain_workers=n
+        )
+        assert rep.n_drain_workers == n
+        assert "main_loop_stall" in rep.seconds
+        assert "drain_utilization" in rep.seconds
+        assert 0.0 <= rep.seconds["drain_utilization"] <= 1.0
+        with open(out, "rb") as f:
+            outs[n] = f.read()
+    assert outs[1] == outs[3]
+
+
+def test_drain_workers_validated():
+    gp = GroupingParams(strategy="exact", paired=True)
+    cp = ConsensusParams(mode="duplex")
+    with pytest.raises(ValueError, match="drain_workers"):
+        stream_call_consensus(
+            "nonexistent.bam", "out.bam", gp, cp, chunk_reads=10,
+            drain_workers=0,
+        )
+
+
+def test_busy_wall_table_flags_impossible_accounting():
+    """The profile/CI canary: a single-threaded stage reporting more
+    busy time than the wall is an accounting bug; pooled stages may
+    exceed the wall up to their pool size."""
+    from duplexumiconsensusreads_tpu.runtime.executor import busy_wall_table
+
+    seconds = {
+        "ingest": 12.0,  # > wall on a 1-thread stage: impossible
+        "dispatch": 30.0,  # 4-worker pool, <= 4 * wall: legitimate
+        "scatter": 15.0,  # 2 drain workers, <= 2 * wall: legitimate
+        "main_loop_stall": 1.0,
+        "drain_utilization": 0.75,
+        "total": 10.0,
+    }
+    lines, bugs = busy_wall_table(seconds, drain_workers=2)
+    assert bugs == ["ingest"]
+    assert any("BUSY>WALL" in ln for ln in lines)
+    assert not any("scatter" in b for b in bugs)
+    # all-sane report: no flags
+    _, bugs2 = busy_wall_table(
+        {"ingest": 3.0, "scatter": 12.0, "total": 10.0}, drain_workers=2
+    )
+    assert bugs2 == []
+
+
 def test_cli_stream_and_validate(tmp_path):
     bam = str(tmp_path / "s.bam")
     truth = str(tmp_path / "t.npz")

@@ -76,10 +76,10 @@ def main() -> None:
     def timed(label, fn):
         for o in fn():
             np.asarray(o["n_families"])  # compile + barrier
-        t0 = time.time()
+        t0 = time.monotonic()
         outs = [fn() for _ in range(reps)]
         np.asarray(outs[-1][-1]["n_families"])
-        dt = (time.time() - t0) / reps
+        dt = (time.monotonic() - t0) / reps
         print(f"{label:14s} {dt*1e3:8.1f} ms  {n_reads/dt/1e6:6.3f} M reads/s")
         return dt
 

@@ -4,6 +4,12 @@ exact grouping (no Hamming/closure), no cycle error model (one ssc
 pass instead of two) — to see which device stage owns the wall.
 
 Run: python tools/profile_phases.py
+     python tools/profile_phases.py --report report.json
+       (render a `call --report` / streaming RunReport JSON as
+        overlapped busy-time vs wall columns; any stage whose busy
+        time exceeds wall x its pool size is flagged BUSY>WALL — an
+        accounting-bug canary, since that is impossible with honest
+        monotonic clocks)
 
 Journal (v5e-1, axon tunnel, 2026-07-30, 527k reads, capacity 2048):
   full config5 (adj+cycle)   0.211s   2.25M reads/s
@@ -30,9 +36,34 @@ Related measurements feeding benchmark.py decisions:
 
 from __future__ import annotations
 
+import json
+import sys
 import time
 
 import numpy as np
+
+
+def report_busy_wall(path: str) -> int:
+    """Print the overlapped busy-vs-wall table for a RunReport JSON
+    (from `call --report`). Exit status 1 when any stage's busy time
+    exceeds wall x pool — the accounting-bug canary for CI."""
+    from duplexumiconsensusreads_tpu.runtime.executor import busy_wall_table
+
+    with open(path) as f:
+        rep = json.load(f)
+    lines, bugs = busy_wall_table(
+        rep.get("seconds", {}), drain_workers=max(rep.get("n_drain_workers", 1), 1)
+    )
+    for ln in lines:
+        print(ln)
+    if bugs:
+        print(
+            f"ACCOUNTING BUG: stage(s) {', '.join(bugs)} report more busy "
+            f"time than wall x pool allows",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 def main() -> None:
@@ -89,14 +120,23 @@ def main() -> None:
         for o in run_all():
             np.asarray(o["n_families"])
         reps = 8
-        t0 = time.time()
+        t0 = time.monotonic()
         outs = [run_all() for _ in range(reps)]
         for ro in outs:
             for o in ro:
                 np.asarray(o["n_families"])
-        dt = (time.time() - t0) / reps
+        dt = (time.monotonic() - t0) / reps
         print(f"{name:28s} step={dt:.3f}s  {n_reads/dt/1e6:.3f}M reads/s")
 
 
 if __name__ == "__main__":
+    import os as _os
+
+    sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    if len(sys.argv) > 1 and sys.argv[1] == "--report":
+        if len(sys.argv) < 3:
+            # a forgotten path must not fall through into the
+            # multi-minute device-profiling run
+            raise SystemExit("usage: profile_phases.py --report REPORT_JSON")
+        raise SystemExit(report_busy_wall(sys.argv[2]))
     main()
