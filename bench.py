@@ -1,7 +1,10 @@
 """Driver entry point: delegates to the installable benchmark module.
 
 Prints ONE JSON line (see duplexumiconsensusreads_tpu/benchmark.py for
-the metric definition and env knobs).
+the metric definition and env knobs). The human journal on stderr now
+includes the canonical e2e capture's busy-vs-wall table, and the JSON
+carries per-chunk latency percentiles reconstructed from the e2e span
+capture (left in the bench cache for tools/trace_report.py).
 """
 
 import sys

@@ -106,7 +106,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="shard the read-length axis this many ways (long reads); "
         "devices must be divisible by it",
     )
-    c.add_argument("--report", help="write run counters/timings JSON here")
+    c.add_argument(
+        "--report",
+        help="write run counters/timings JSON here ('-' writes to "
+        "stdout; seconds are rounded to milliseconds with stable key "
+        "order, so reports diff cleanly)",
+    )
     c.add_argument("--profile", help="write a jax.profiler trace to this dir")
     c.add_argument(
         "--chunk-reads",
@@ -175,6 +180,28 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         default=None,
         help="whitelist correction distance bound (default 1)",
+    )
+    c.add_argument(
+        "--trace",
+        default=None,
+        metavar="TRACE_JSONL",
+        help="record a per-chunk span + event capture (JSONL) of the "
+        "streaming executor to this path: every pipeline stage with "
+        "its lane (main / xfer-N / drain-N), plus fault, retry, "
+        "back-pressure and resume events. Analyse with "
+        "tools/trace_report.py, validate with tools/check_trace.py, "
+        "or export to Perfetto (trace_report --chrome). Zero overhead "
+        "when omitted; requires --chunk-reads",
+    )
+    c.add_argument(
+        "--heartbeat",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="print a liveness line to stderr every N seconds during a "
+        "streaming run (chunks done/inflight, stall fraction, retries, "
+        "drain utilization); with --trace the samples also land in the "
+        "capture. Requires --chunk-reads",
     )
     c.add_argument(
         "--chaos",
@@ -554,6 +581,21 @@ def _cmd_call(args) -> int:
         )
     if capacity < 1:
         raise SystemExit(f"--capacity must be >= 1 (got {capacity})")
+    if args.trace and chunk_reads <= 0:
+        # only the streaming executor is span-instrumented; on the
+        # whole-file path the flag would silently record nothing
+        raise SystemExit(
+            "--trace requires the streaming executor (--chunk-reads N)"
+        )
+    if args.heartbeat:
+        if args.heartbeat < 0:
+            raise SystemExit(
+                f"--heartbeat must be > 0 seconds (got {args.heartbeat})"
+            )
+        if chunk_reads <= 0:
+            raise SystemExit(
+                "--heartbeat requires the streaming executor (--chunk-reads N)"
+            )
     if args.chaos:
         if chunk_reads <= 0:
             # only the streaming executor threads the fault sites and
@@ -625,6 +667,16 @@ def _cmd_call(args) -> int:
         host_ckpt = (
             f"{args.checkpoint}.host{args.host_id}" if args.checkpoint else None
         )
+        # same per-host suffix discipline as the output/checkpoint: a
+        # shared --trace/--report path would have every host clobber
+        # one file on shared pod storage ('-' stays stdout, per-host
+        # by nature)
+        host_trace = f"{args.trace}.host{args.host_id}" if args.trace else None
+        host_report = (
+            f"{args.report}.host{args.host_id}"
+            if args.report and args.report != "-"
+            else args.report
+        )
         rep = multihost_call(
             args.input,
             host_out,
@@ -640,7 +692,7 @@ def _cmd_call(args) -> int:
             drain_workers=drain_workers,
             checkpoint_path=host_ckpt,
             resume=args.resume,
-            report_path=args.report,
+            report_path=host_report,
             profile_dir=args.profile,
             cycle_shards=cycle_shards,
             mate_aware=mate_aware,
@@ -648,6 +700,8 @@ def _cmd_call(args) -> int:
             per_base_tags=per_base_tags,
             read_group=read_group,
             write_index=write_index,
+            trace_path=host_trace,
+            heartbeat_s=args.heartbeat,
         )
         if rep is None:
             print("[duplexumi] host has no records in range; idle", file=sys.stderr)
@@ -678,6 +732,8 @@ def _cmd_call(args) -> int:
             per_base_tags=per_base_tags,
             read_group=read_group,
             write_index=write_index,
+            trace_path=args.trace,
+            heartbeat_s=args.heartbeat,
         )
     else:
         try:

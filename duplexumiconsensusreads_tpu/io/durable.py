@@ -23,6 +23,13 @@ once, at the very end.
 from __future__ import annotations
 
 import os
+import time
+
+# observability hook only (stdlib-only module, no import cycle): every
+# completed durable publish is a trace event when a recorder is
+# installed — fsync stalls on shared pod storage are a classic hidden
+# wall cost, and the capture should name them
+from duplexumiconsensusreads_tpu.telemetry.trace import get_active as _trace_active
 
 
 def fsync_file(f) -> None:
@@ -73,9 +80,16 @@ def write_durable(dst: str, payload: bytes, tmp: str | None = None) -> str:
     half-apply it. ``tmp`` overrides the staging name (e.g. a
     pid-suffixed tmp when uncoordinated hosts may write the same
     path)."""
+    tr = _trace_active()
+    t0 = time.monotonic() if tr is not None else 0.0
     tmp = tmp or dst + ".tmp"
     with open(tmp, "wb") as f:
         f.write(payload)
         fsync_file(f)
     replace_durable(tmp, dst)
+    if tr is not None:
+        tr.event(
+            "durable_write", path=dst, bytes=len(payload),
+            dur=round(time.monotonic() - t0, 6),
+        )
     return dst

@@ -86,7 +86,30 @@ class RunReport:
     seconds: dict = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
-        return json.dumps(dataclasses.asdict(self), indent=2)
+        """Serialize with STABLE key order and `seconds` rounded to
+        milliseconds: reports from different runs diff cleanly (keys
+        never reorder, values never carry float noise past the ms the
+        measurements are honest to)."""
+        d = dataclasses.asdict(self)
+        # sort_keys below orders every dict (seconds included); this
+        # comprehension only normalises the values
+        d["seconds"] = {k: round(float(v), 3) for k, v in self.seconds.items()}
+        return json.dumps(d, indent=2, sort_keys=True)
+
+
+def write_report(rep: "RunReport", path: str) -> None:
+    """Write a RunReport JSON to ``path``; ``-`` means stdout (pipe a
+    report straight into jq/diff without a temp file). Shared by both
+    executors so the CLI's --report contract cannot drift."""
+    text = rep.to_json() + "\n"
+    if path == "-":
+        import sys
+
+        sys.stdout.write(text)
+        sys.stdout.flush()
+    else:
+        with open(path, "w") as f:
+            f.write(text)
 
 
 # Transfer-pool size for the streaming executor (runtime/stream.py
@@ -94,7 +117,7 @@ class RunReport:
 # thresholds below must agree with the real pool — one constant, no
 # cross-module drift).
 XFER_WORKERS = 4
-DRAIN_PHASES = ("device_wait_fetch", "scatter", "shard_write")
+DRAIN_PHASES = ("device_wait_fetch", "scatter", "deflate", "shard_write")
 # rep.seconds entries that are not per-stage busy seconds
 # (main_loop_stall is main-thread blocked-on-back-pressure wall, shown
 # via its dedicated summary line, not a stage row)
@@ -114,13 +137,26 @@ def busy_wall_table(
     returned as accounting-bug canaries (second element) and flagged
     BUSY>WALL in the rendered rows.
     """
-    wall = float(seconds.get("total") or 0.0)
+    # ONE tolerant-numeric predicate for the whole observability
+    # contract: the busy>wall canary here and the trace schema
+    # validator/sum-check must never diverge on what counts as a number
+    from duplexumiconsensusreads_tpu.telemetry.report import _is_num
+
+    def _num(v):
+        # foreign/older report shapes can carry anything here; a
+        # rendering tool must tolerate every field it touches
+        return v if _is_num(v) else None
+
+    wall = float(_num(seconds.get("total")) or 0.0)
     lines = [
         f"{'stage':<18} {'busy_s':>9} {'wall_s':>9} {'busy/wall':>9}  note"
     ]
     bugs: list[str] = []
     for k, v in seconds.items():
         if k in _NON_STAGE_KEYS:
+            continue
+        if _num(v) is None:
+            lines.append(f"{k:<18} {'-':>9} {wall:9.3f} {'-':>9}  (non-numeric)")
             continue
         if k == "dispatch":
             # dispatch normally runs on the xfer pool, but materialize's
@@ -139,12 +175,14 @@ def busy_wall_table(
         else:
             note = ""
         lines.append(f"{k:<18} {v:9.3f} {wall:9.3f} {frac:9.2f}  {note}")
-    if "drain_utilization" in seconds:
-        lines.append(f"drain_utilization  {seconds['drain_utilization']:.3f}")
-    if "main_loop_stall" in seconds and wall:
+    du = _num(seconds.get("drain_utilization"))
+    if du is not None:
+        lines.append(f"drain_utilization  {du:.3f}")
+    stall = _num(seconds.get("main_loop_stall"))
+    if stall is not None and wall:
         lines.append(
             f"main loop stalled on drain back-pressure "
-            f"{seconds['main_loop_stall'] / wall:.0%} of the wall"
+            f"{stall / wall:.0%} of the wall"
         )
     return lines, bugs
 
@@ -799,6 +837,5 @@ def call_consensus_file(
     rep.seconds["write_output"] = round(time.monotonic() - t0, 4)
 
     if report_path:
-        with open(report_path, "w") as f:
-            f.write(rep.to_json() + "\n")
+        write_report(rep, report_path)
     return rep

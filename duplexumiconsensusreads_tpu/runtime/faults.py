@@ -155,6 +155,13 @@ class FaultPlan:
             if kind is None:
                 return
             self.n_fired += 1
+        # a chaos trigger is a first-class trace event: a capture of a
+        # chaos run must show the injected fault AND the retry ladder
+        # it exercised as distinct records (lazy import: faults is on
+        # the hot path and telemetry must stay optional)
+        from duplexumiconsensusreads_tpu.telemetry.trace import emit_event
+
+        emit_event("fault_injected", site=site, hit=n, kind=kind)
         if kind == "kill":
             raise InjectedKill(f"injected kill at {site} (hit {n})")
         raise InjectedFault(

@@ -59,6 +59,7 @@ from duplexumiconsensusreads_tpu.io.convert import (
 from duplexumiconsensusreads_tpu.io.convert import records_pos_keys as _rec_pos_keys
 from duplexumiconsensusreads_tpu.ops.pipeline import pack_stacked
 from duplexumiconsensusreads_tpu.runtime.executor import (
+    DRAIN_PHASES,
     RunReport,
     fetch_outputs,
     packed_io_ok,
@@ -71,6 +72,8 @@ from duplexumiconsensusreads_tpu.runtime.faults import (
     fault_point,
     install_from_env,
 )
+from duplexumiconsensusreads_tpu.telemetry import trace as telemetry
+from duplexumiconsensusreads_tpu.telemetry.trace import Heartbeat, TraceRecorder
 from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 
 
@@ -95,6 +98,14 @@ def _io_retry(site: str, fn, what: str):
             if attempt == _HOST_IO_RETRIES:
                 break
             delay = min(0.05 * (2 ** attempt), 2.0)
+            # every retry attempt is a structured trace event (site +
+            # attempt + backoff): a capture must explain a slow run's
+            # retry churn without stderr archaeology
+            telemetry.emit_event(
+                "retry", site=site, attempt=attempt + 1,
+                max_attempts=_HOST_IO_RETRIES, backoff_s=round(delay, 3),
+                error=repr(e)[:200],
+            )
             print(
                 f"[duplexumi] transient {what} failure ({e!r}); retry "
                 f"{attempt + 1}/{_HOST_IO_RETRIES} in {delay:.2f}s",
@@ -852,6 +863,96 @@ def stream_call_consensus(
     write_index: bool = False,  # write the standard .bai after finalise
     packed: str = "auto",  # wire packing: "auto" (packed_io_ok gate) or
     # "off" — the bench A/B measures both on the same input
+    trace_path: str | None = None,  # per-chunk span capture (JSONL;
+    # telemetry/trace.py). None = tracing off, and every hook in the
+    # hot path is a single None check — the zero-cost contract
+    heartbeat_s: float = 0.0,  # >0: periodic liveness line to stderr
+    # (chunks done/inflight, stall fraction, retries, drain util)
+    trace_max_events: int = 1_000_000,  # bounded-capture cap
+) -> RunReport:
+    """Chunked, async-pipelined consensus calling (TPU backend).
+
+    Public entry point: a telemetry wrapper around :func:`_stream_call`
+    (the executor body — see its docstring for the pipeline/recovery
+    semantics). The trace recorder and heartbeat are owned HERE so they
+    are torn down on every exit path — normal return, device failure,
+    injected kill, Ctrl-C — and a crashed run still leaves a valid
+    (summary-less) capture on disk for post-mortem. The recorder is
+    also installed as the process-global telemetry hook so the fault
+    switchboard (runtime/faults.py) and durable-write layer
+    (io/durable.py) can emit events without threading a handle through
+    every call."""
+    tr: TraceRecorder | None = None
+    hb_box: list = []  # the body parks its Heartbeat here for teardown
+    hooked = False
+    if trace_path:
+        tr = TraceRecorder(trace_path, max_events=trace_max_events)
+        # the global hook is single-run (same assumption the faults
+        # switchboard makes): a concurrent traced run in this process
+        # keeps its direct spans but must not steal another run's
+        # fault/retry/durable events — or tear down its hook
+        if telemetry.get_active() is None:
+            telemetry.install(tr)
+            hooked = True
+        else:
+            print(
+                "[duplexumi] another trace recorder is active in this "
+                "process; fault/retry/durable events will be recorded "
+                "by that run, not this capture",
+                file=sys.stderr,
+            )
+    try:
+        return _stream_call(
+            in_path, out_path, grouping, consensus,
+            capacity=capacity, chunk_reads=chunk_reads,
+            n_devices=n_devices, max_inflight=max_inflight,
+            drain_workers=drain_workers, checkpoint_path=checkpoint_path,
+            resume=resume, report_path=report_path,
+            profile_dir=profile_dir, cycle_shards=cycle_shards,
+            progress=progress, max_retries=max_retries,
+            input_range=input_range, name_tag=name_tag,
+            mate_aware=mate_aware, max_reads=max_reads,
+            per_base_tags=per_base_tags, read_group=read_group,
+            write_index=write_index, packed=packed,
+            tr=tr, heartbeat_s=heartbeat_s, hb_box=hb_box,
+        )
+    finally:
+        for hb in hb_box:
+            hb.stop()
+        if tr is not None:
+            if hooked:
+                telemetry.uninstall()
+            tr.close()
+
+
+def _stream_call(
+    in_path: str,
+    out_path: str,
+    grouping: GroupingParams,
+    consensus: ConsensusParams,
+    capacity: int = 2048,
+    chunk_reads: int = 500_000,
+    n_devices: int | None = None,
+    max_inflight: int = 4,
+    drain_workers: int = 2,
+    checkpoint_path: str | None = None,
+    resume: bool = False,
+    report_path: str | None = None,
+    profile_dir: str | None = None,
+    cycle_shards: int = 1,
+    progress=None,
+    max_retries: int = 3,
+    input_range=None,
+    name_tag: str = "",
+    mate_aware: str = "auto",
+    max_reads: int = 0,
+    per_base_tags: bool = False,
+    read_group: str = "A",
+    write_index: bool = False,
+    packed: str = "auto",
+    tr: TraceRecorder | None = None,
+    heartbeat_s: float = 0.0,
+    hb_box: list | None = None,
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -998,13 +1099,17 @@ def stream_call_consensus(
     # views are "main_loop_stall" (time the main loop spent blocked on
     # the drain back-pressure window) and "drain_utilization"
     # (drain busy seconds / (drain_workers * wall)), emitted alongside.
+    # Every += below is paired with a trace span carrying the SAME
+    # (t0, dt), so a capture's per-stage sums reproduce these totals
+    # exactly (the trace_report sum-check).
     phase = {
         "ingest": 0.0, "bucketing": 0.0, "dispatch": 0.0,
-        "device_wait_fetch": 0.0, "scatter": 0.0, "shard_write": 0.0,
-        "finalise": 0.0, "main_loop_stall": 0.0,
+        "device_wait_fetch": 0.0, "scatter": 0.0, "deflate": 0.0,
+        "shard_write": 0.0, "ckpt": 0.0, "finalise": 0.0,
+        "main_loop_stall": 0.0,
     }
 
-    def dispatch(buckets, spec):
+    def dispatch(buckets, spec, chunk=None):
         t0 = time.monotonic()
         # runs on a transfer worker; a fault here surfaces through the
         # submit future into materialize's retry/isolation ladder
@@ -1030,6 +1135,8 @@ def stream_call_consensus(
         with phase_lock:  # dict += from concurrent workers would race
             phase["dispatch"] += dt
             rep.bytes_h2d += h2d
+        if tr is not None:
+            tr.span("dispatch", t0, dt, chunk=chunk, n_buckets=len(buckets))
         return out
 
     def materialize(out, cbuckets, cspec, k):
@@ -1055,6 +1162,12 @@ def stream_call_consensus(
             with phase_lock:  # drain workers retry concurrently
                 rep.n_retries += 1
             delay = min(0.5 * (2 ** attempt), 8.0)
+            if tr is not None:
+                tr.event(
+                    "retry", chunk=k, site="device.execute",
+                    attempt=attempt + 1, max_attempts=max_retries,
+                    backoff_s=round(delay, 3), error=repr(err)[:200],
+                )
             print(
                 f"[duplexumi] chunk {k} device execution failed ({err!r}); "
                 f"retry {attempt + 1}/{max_retries} in {delay:.1f}s",
@@ -1062,11 +1175,13 @@ def stream_call_consensus(
             )
             time.sleep(delay)
             try:
-                return fetch_outputs(dispatch(cbuckets, cspec))
+                return fetch_outputs(dispatch(cbuckets, cspec, chunk=k))
             except Exception as e:
                 err = e
         # class keeps failing: isolate per bucket so one bad bucket
         # cannot take down the chunk
+        if tr is not None:
+            tr.event("bucket_isolation", chunk=k, n_buckets=len(cbuckets))
         print(
             f"[duplexumi] chunk {k}: class retries exhausted; "
             f"re-dispatching {len(cbuckets)} buckets individually",
@@ -1081,13 +1196,20 @@ def stream_call_consensus(
                         f"chunk {k} bucket {bi}: run aborting"
                     ) from (last or err)
                 try:
-                    single = dispatch([bk], cspec)
+                    single = dispatch([bk], cspec, chunk=k)
                     single = {key: np.asarray(v)[0] for key, v in single.items()}
                     break
                 except Exception as e:
                     last = e
                     with phase_lock:
                         rep.n_retries += 1
+                    if tr is not None:
+                        tr.event(
+                            "retry", chunk=k, site="device.execute",
+                            attempt=attempt + 1, max_attempts=max_retries,
+                            backoff_s=round(min(0.5 * (2 ** attempt), 8.0), 3),
+                            bucket=bi, error=repr(e)[:200],
+                        )
                     time.sleep(min(0.5 * (2 ** attempt), 8.0))
             else:
                 raise RuntimeError(
@@ -1107,6 +1229,15 @@ def stream_call_consensus(
         and appends land in chunk order whatever order workers finish
         in. A fault/kill raised here surfaces through the future into
         the main loop unchanged."""
+        def on_stage(stage, t0, dt):
+            # _finish_chunk's accounting callback: one pair of phase +=
+            # and span per sub-stage (deflate vs serialize/write), so
+            # the drain worker's shard work decomposes in the capture
+            with phase_lock:
+                phase[stage] += dt
+            if tr is not None:
+                tr.span(stage, t0, dt, chunk=k)
+
         parts = []
         pair_base = 0
         for out, cbuckets, cspec in entries:
@@ -1120,6 +1251,8 @@ def stream_call_consensus(
                 )
                 rep.n_families += int(out["n_families"].sum())
                 rep.n_molecules += int(out["n_molecules"].sum())
+            if tr is not None:
+                tr.span("device_wait_fetch", t0, dt, chunk=k)
             t0 = time.monotonic()
             # chaos site drain.scatter rides the same bounded-retry
             # ladder as the host I/O steps (scatter is pure compute, so
@@ -1134,16 +1267,17 @@ def stream_call_consensus(
                     f"chunk {k} scatter",
                 )
             )
+            dt = time.monotonic() - t0
             with phase_lock:
-                phase["scatter"] += time.monotonic() - t0
+                phase["scatter"] += dt
+            if tr is not None:
+                tr.span("scatter", t0, dt, chunk=k)
             pair_base += len(cbuckets)
-        t0 = time.monotonic()
         res = _finish_chunk(
             k, parts, duplex, shard_dir, serialize_bam, header_out, name_tag,
             paired_out=grouping.mate_aware, read_group=read_group,
+            on_stage=on_stage,
         )
-        with phase_lock:
-            phase["shard_write"] += time.monotonic() - t0
         return res + (False,)  # marked=False: commit still owes the mark
 
     # ---- ordered-completion frontier: chunk k is committed (checkpoint
@@ -1194,12 +1328,20 @@ def stream_call_consensus(
 
     def _commit(k, payload):
         """Main-thread commit of a drained chunk: durable mark first,
-        then the idempotent append into the tmp assembly."""
+        then the idempotent append into the tmp assembly. The mark is
+        its own phase ("ckpt") since PR 3: on shared pod storage the
+        per-chunk manifest fsync is a real cost that used to hide
+        inside "finalise"."""
         shard, size, crc, n_rec, n_pairs, data, marked = payload
-        t0 = time.monotonic()
         shards[k] = shard
         if ckpt and not marked:
+            t0 = time.monotonic()
             ckpt.mark(k, shard, size, crc, n_rec, n_pairs)
+            dt = time.monotonic() - t0
+            phase["ckpt"] += dt
+            if tr is not None:
+                tr.span("ckpt", t0, dt, chunk=k)
+        t0 = time.monotonic()
         if fin["f"] is None:
             _fin_open()
         if data is None:
@@ -1221,7 +1363,10 @@ def stream_call_consensus(
             )
         rep.n_consensus += n_rec
         rep.n_consensus_pairs += n_pairs
-        phase["finalise"] += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        phase["finalise"] += dt
+        if tr is not None:
+            tr.span("finalise", t0, dt, chunk=k)
         if progress:
             progress(k, rep)
 
@@ -1238,18 +1383,57 @@ def stream_call_consensus(
         k, fut = inflight.popleft()
         t0 = time.monotonic()
         res = fut.result()
-        phase["main_loop_stall"] += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        phase["main_loop_stall"] += dt
+        if tr is not None:
+            # the back-pressure record: main blocked this long waiting
+            # for chunk k's drain — the span IS the stall event
+            tr.span("main_loop_stall", t0, dt, chunk=k)
         done_q[k] = res
         _advance_frontier()
 
     def timed_chunks(it):
+        i = 0
         while True:
             t0 = time.monotonic()
             item = next(it, None)
-            phase["ingest"] += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            phase["ingest"] += dt
+            if tr is not None:
+                # the final (None-returning) read keeps its span too —
+                # chunkless, so the per-stage sums still match phase
+                tr.span("ingest", t0, dt, chunk=i if item is not None else None)
             if item is None:
                 return
+            i += 1
             yield item
+
+    # live liveness line: a long run must be observable without waiting
+    # for the report (and without a trace file to post-process). Started
+    # here so the stats closure reads fully-initialised loop state; the
+    # caller (stream_call_consensus) owns teardown via hb_box.
+    if heartbeat_s and heartbeat_s > 0:
+
+        def _hb_stats():
+            elapsed = max(time.monotonic() - t_start, 1e-9)
+            with phase_lock:
+                stall = phase["main_loop_stall"]
+                drain_busy = sum(phase[k] for k in DRAIN_PHASES)
+                retries = rep.n_retries
+            return {
+                "elapsed_s": round(elapsed, 1),
+                "chunks_done": frontier,
+                "chunks_inflight": len(inflight),
+                "stall_frac": round(stall / elapsed, 3),
+                "retries": retries,
+                "drain_util": round(
+                    min(drain_busy / (drain_workers * elapsed), 1.0), 3
+                ),
+            }
+
+        hb = Heartbeat(heartbeat_s, _hb_stats, recorder=tr).start()
+        if hb_box is not None:
+            hb_box.append(hb)
 
     n_skipped = 0
     try:
@@ -1268,6 +1452,8 @@ def stream_call_consensus(
                 # still flows through the frontier so appends stay in
                 # chunk order relative to in-flight fresh chunks.
                 e = ckpt.done[str(k)]
+                if tr is not None:
+                    tr.event("resume", chunk=k, decision="reused")
                 done_q[k] = (
                     e["path"], e["size"], e["crc32"],
                     e["n_records"], e["n_pairs"], None, True,
@@ -1275,6 +1461,11 @@ def stream_call_consensus(
                 n_skipped += 1
                 _advance_frontier()
                 continue
+            if tr is not None and resume:
+                # the chunk was NOT served from the manifest under an
+                # explicit resume: either never finished or its shard
+                # failed size+CRC verification — recomputing now
+                tr.event("resume", chunk=k, decision="recomputed")
             # per-read counters cover FRESH work only, so a resumed
             # run's report is internally consistent (n_records matches
             # n_valid_reads + drops); skipped chunks show up in
@@ -1307,7 +1498,10 @@ def stream_call_consensus(
             buckets = build_buckets(
                 batch, capacity=capacity, grouping=grouping, counters=fb
             )
-            phase["bucketing"] += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            phase["bucketing"] += dt
+            if tr is not None:
+                tr.span("bucketing", t0, dt, chunk=k, n_buckets=len(buckets))
             for fk, fv in fb.items():
                 setattr(rep, fk, getattr(rep, fk) + fv)
             rep.n_buckets += len(buckets)
@@ -1326,7 +1520,9 @@ def stream_call_consensus(
                 # transfer workers: host->device copies ride the tunnel
                 # while the main loop ingests/buckets the next chunk;
                 # submit never raises — failures surface in materialize
-                entries.append((xfer.submit(dispatch, cbuckets, cspec), cbuckets, cspec))
+                entries.append(
+                    (xfer.submit(dispatch, cbuckets, cspec, k), cbuckets, cspec)
+                )
             inflight.append((k, drain.submit(drain_chunk, k, entries, batch)))
             while len(inflight) >= max_inflight:
                 _wait_oldest()
@@ -1416,7 +1612,11 @@ def stream_call_consensus(
             from duplexumiconsensusreads_tpu.io.bai import build_bai
 
             build_bai(out_path)
-    phase["finalise"] += time.monotonic() - t_fin
+    dt_fin = time.monotonic() - t_fin
+    phase["finalise"] += dt_fin
+    if tr is not None:
+        # terminal EOF/fsync/rename (+ optional index): chunkless span
+        tr.span("finalise", t_fin, dt_fin)
     rep.n_chunks_skipped = n_skipped
     rep.n_pipeline_compiles = len(spec_cache)
     total = time.monotonic() - t_start
@@ -1425,16 +1625,36 @@ def stream_call_consensus(
     # drain-side occupancy: busy seconds across the drain stages over
     # the pool's total capacity. ~1.0 means the drain pool, not the
     # device, is the bottleneck — raise --drain-workers.
-    drain_busy = (
-        phase["device_wait_fetch"] + phase["scatter"] + phase["shard_write"]
-    )
+    drain_busy = sum(phase[k] for k in DRAIN_PHASES)
     rep.seconds["drain_utilization"] = round(
         min(drain_busy / max(drain_workers * total, 1e-9), 1.0), 3
     )
     rep.seconds["total"] = round(total, 3)
+    if tr is not None:
+        # stop the heartbeat BEFORE the summary: the summary must be
+        # the capture's last record (schema contract), and a beat
+        # landing after it would flake the check_trace CI gate on a
+        # perfectly healthy run (the recorder also seals itself, but
+        # stopping here keeps the final samples instead of dropping
+        # them); the caller's finally will re-stop harmlessly
+        if hb_box:
+            for _hb in hb_box:
+                _hb.stop()
+        # clean shutdown: embed the report's busy totals so a capture
+        # is self-contained for the trace_report sum-check
+        tr.write_summary(
+            seconds=dict(rep.seconds),
+            counters={
+                "n_chunks": rep.n_chunks,
+                "n_chunks_skipped": rep.n_chunks_skipped,
+                "n_retries": rep.n_retries,
+                "n_drain_workers": rep.n_drain_workers,
+            },
+        )
     if report_path:
-        with open(report_path, "w") as f:
-            f.write(rep.to_json() + "\n")
+        from duplexumiconsensusreads_tpu.runtime.executor import write_report
+
+        write_report(rep, report_path)
     return rep
 
 
@@ -1498,7 +1718,7 @@ def _count_records(data: bytes) -> tuple[int, int]:
 
 def _finish_chunk(
     k, parts, duplex, shard_dir, serialize_bam, header, name_tag="",
-    paired_out=False, read_group="A",
+    paired_out=False, read_group="A", on_stage=None,
 ) -> tuple[str, int, int, int, int, bytes]:
     """Merge one chunk's per-class scattered outputs and write its
     shard. parts rows are 8-tuples — (..., cons_mate, cons_pair,
@@ -1510,7 +1730,13 @@ def _finish_chunk(
     built): the deflate cost lands on the drain worker instead of the
     finalise path, and the incremental finalise append becomes a plain
     byte copy (BGZF members concatenate). Returns (path, size, crc32,
-    n_records, n_pairs, shard_bytes) — the commit payload."""
+    n_records, n_pairs, shard_bytes) — the commit payload.
+
+    ``on_stage(stage, t0, dt)`` is the caller's accounting hook: the
+    serialize+write segments report as "shard_write" and the BGZF
+    compression as "deflate" — per-stage busy phases AND trace spans
+    both flow through it, so they can never disagree."""
+    t0 = time.monotonic()
     cols = sort_consensus_outputs(*(np.concatenate(x) for x in zip(*parts)))
     cb, cq, cd, fp, fu, mate, pair, end = cols[:8]
     recs = consensus_to_records(
@@ -1538,6 +1764,14 @@ def _finish_chunk(
     # in the manifest, so checkpoint-resumed chunks contribute to the
     # report totals without a decompress pass at finalise
     n_rec, n_pairs = _count_records(raw)
+    if on_stage:
+        on_stage("shard_write", t0, time.monotonic() - t0)
+    t0 = time.monotonic()
     comp = bgzf.compress_fast(raw, eof=False)
+    if on_stage:
+        on_stage("deflate", t0, time.monotonic() - t0)
+    t0 = time.monotonic()
     path, size, crc = _write_shard(shard_dir, k, comp)
+    if on_stage:
+        on_stage("shard_write", t0, time.monotonic() - t0)
     return path, size, crc, n_rec, n_pairs, comp

@@ -1,0 +1,98 @@
+"""Chrome trace-event exporter: open a capture in Perfetto.
+
+Maps a JSONL capture (telemetry/trace.py) onto the Trace Event Format
+consumed by https://ui.perfetto.dev and chrome://tracing — spans become
+complete ('X') slices, point events become instants ('i'), and each
+LANE becomes one named pseudo-thread so the main loop, transfer
+workers, and every drain worker render as parallel tracks. That
+side-by-side rendering is the whole point: overlap that hides the
+critical path in aggregate numbers is visible at a glance.
+
+Timestamps: trace seconds (monotonic-relative) -> microseconds, the
+unit the format requires.
+"""
+
+from __future__ import annotations
+
+import json
+
+# one synthetic process for the whole capture
+_PID = 1
+
+
+def _lane_order(lane: str) -> tuple:
+    """Stable track order: main first, then xfer, then drain, then any
+    stray lanes, each numerically within its pool."""
+    for rank, prefix in ((0, "main"), (1, "xfer-"), (2, "drain-")):
+        if lane == prefix or lane.startswith(prefix):
+            tail = lane[len(prefix):]
+            return (rank, int(tail) if tail.isdigit() else 0, lane)
+    return (3, 0, lane)
+
+
+def to_chrome(records) -> dict:
+    """Convert parsed capture records to a Chrome trace-event dict.
+
+    ``records`` is any iterable of the dicts a JSONL capture holds
+    (``telemetry.report.load_trace`` output). Returns the JSON-object
+    form ({"traceEvents": [...]}), which Perfetto accepts directly.
+    """
+    spans, instants, lanes = [], [], set()
+    for rec in records:
+        kind = rec.get("type")
+        if kind not in ("span", "event"):
+            continue
+        lane = rec.get("lane", "?")
+        lanes.add(lane)
+        # "dur" maps onto the X-event field for spans only; on point
+        # events (e.g. durable_write's fsync cost) it is a payload
+        # attribute and must survive into args
+        drop = ("type", "stage", "name", "t", "lane")
+        drop += ("dur",) if kind == "span" else ()
+        args = {k: v for k, v in rec.items() if k not in drop}
+        if kind == "span":
+            spans.append((rec, lane, args))
+        else:
+            instants.append((rec, lane, args))
+
+    tid = {
+        lane: i + 1 for i, lane in enumerate(sorted(lanes, key=_lane_order))
+    }
+    events = [
+        {
+            "name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
+            "args": {"name": "duplexumi streaming executor"},
+        }
+    ]
+    for lane, t in tid.items():
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": _PID, "tid": t,
+            "args": {"name": lane},
+        })
+        events.append({
+            "name": "thread_sort_index", "ph": "M", "pid": _PID, "tid": t,
+            "args": {"sort_index": t},
+        })
+    for rec, lane, args in spans:
+        events.append({
+            "name": rec.get("stage", "?"), "cat": "stage", "ph": "X",
+            "ts": round(float(rec.get("t", 0.0)) * 1e6, 3),
+            "dur": round(float(rec.get("dur", 0.0)) * 1e6, 3),
+            "pid": _PID, "tid": tid[lane], "args": args,
+        })
+    for rec, lane, args in instants:
+        events.append({
+            "name": rec.get("name", "?"), "cat": "event", "ph": "i",
+            "ts": round(float(rec.get("t", 0.0)) * 1e6, 3),
+            "pid": _PID, "tid": tid[lane], "s": "t", "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome(records, out_path: str) -> int:
+    """Export ``records`` as a Chrome trace JSON file; returns the
+    number of traceEvents written."""
+    doc = to_chrome(records)
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])

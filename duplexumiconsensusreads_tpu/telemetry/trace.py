@@ -1,0 +1,328 @@
+"""Thread-safe span recorder for the streaming executor.
+
+Since the pipelined drain (PR 2), per-stage busy seconds overlap each
+other and the main loop, so `RunReport.seconds` can say how much work
+each stage did but not WHERE the wall went: a slow run might be
+ingest-bound, stalled on drain back-pressure, or serialized on one hot
+drain worker, and the aggregate cannot tell them apart. This module is
+the missing lens — the Dapper-lineage span model applied to the
+per-chunk pipeline:
+
+  span   one timed occurrence of a pipeline stage for one chunk, on
+         one LANE (the thread that ran it: "main", "xfer-N",
+         "drain-N"). The executor records the SAME (t0, dt) pair it
+         adds to its busy-time phase accumulators, so summing a
+         stage's spans reproduces `RunReport.seconds[stage]` exactly —
+         the sum-check `tools/trace_report.py` enforces.
+  event  one structured point occurrence: a fault-injection trigger,
+         a retry attempt (site + attempt + backoff), a resume decision
+         (shard reused vs recomputed), a durable write, a heartbeat.
+
+Capture format: JSONL, one record per line, strictly in write order —
+a `meta` line first, then spans/events as they complete (NOT in start
+order: a span is written when it ends), and a `summary` line last on
+clean shutdown (a crashed run's capture simply lacks it; the file is
+still valid for post-mortem). Timestamps are seconds relative to the
+recorder's monotonic epoch — wall-clock never appears, so an NTP step
+cannot corrupt a capture any more than it can the phase accounting.
+
+The recorder is BOUNDED: past ``max_events`` records it drops (and
+counts) instead of growing the capture without limit — a 200M-read run
+must not be able to fill the disk with its own telemetry.
+
+Cost contract: when no recorder is installed, every hook in the hot
+path is a single global load + ``None`` check (the same discipline as
+``faults.fault_point``) — measured <1% on the e2e capture. When
+recording, each span costs one dict build + one ``json.dumps`` + one
+buffered write under a lock, per STAGE per CHUNK (not per read).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+TRACE_VERSION = 1
+
+# Every span stage the streaming executor records — one per step of the
+# per-chunk pipeline plus the main loop's back-pressure stall. Keep in
+# sync with the instrumentation in runtime/stream.py, the phase dict it
+# feeds, and the "Telemetry" section of ARCHITECTURE.md.
+KNOWN_STAGES = (
+    "ingest",  # rolling BGZF read + native inflate + chunk parse (main)
+    "bucketing",  # build_buckets on the parsed chunk (main)
+    "dispatch",  # stack/pack/device_put (xfer worker; drain on retry)
+    "device_wait_fetch",  # device execution wait + d2h materialise (drain)
+    "scatter",  # scatter-back to batch coordinates (drain)
+    "deflate",  # BGZF-compress the shard's record stream (drain)
+    "shard_write",  # serialize + durable shard write, minus deflate (drain)
+    "ckpt",  # per-chunk checkpoint manifest mark (main)
+    "finalise",  # incremental tmp appends + terminal EOF/fsync/rename (main)
+    "main_loop_stall",  # main loop blocked on drain back-pressure (main)
+)
+
+# Structured point events. Attrs are per-name (see the emitting sites);
+# unknown extra attrs are legal — the validator checks names and the
+# core envelope only, so new context can ride along without a schema
+# bump.
+KNOWN_EVENTS = (
+    "fault_injected",  # runtime/faults.py: a scheduled fault fired
+    "retry",  # a bounded-backoff retry attempt (site/attempt/backoff_s)
+    "bucket_isolation",  # class retries exhausted -> per-bucket re-dispatch
+    "resume",  # per-chunk resume decision: reused vs recomputed
+    "durable_write",  # io/durable.py: a tmp+fsync+rename completed
+    "heartbeat",  # periodic liveness sample (also printed to stderr)
+    "truncated",  # the bounded recorder hit max_events; tail dropped
+)
+
+
+def current_lane() -> str:
+    """Lane id of the calling thread. The executor's pools carry
+    ``dut-`` thread-name prefixes precisely so spans can self-identify:
+    ``main`` / ``xfer-N`` / ``drain-N``; anything else keeps its raw
+    thread name (still a valid lane)."""
+    name = threading.current_thread().name
+    if name == "MainThread":
+        return "main"
+    for prefix, lane in (("dut-xfer_", "xfer-"), ("dut-drain_", "drain-")):
+        if name.startswith(prefix):
+            return lane + name[len(prefix):]
+    return name
+
+
+class TraceRecorder:
+    """Bounded JSONL span/event recorder on one shared monotonic epoch.
+
+    Writes through to ``path`` as records arrive (buffered file I/O —
+    a crash loses at most the OS buffer, never corrupts earlier lines).
+    All methods are thread-safe; the executor's drain/xfer workers and
+    the heartbeat thread all write to one recorder.
+    """
+
+    def __init__(self, path: str, max_events: int = 1_000_000):
+        if max_events < 1:
+            raise ValueError(f"max_events must be >= 1 (got {max_events})")
+        self.path = path
+        self.max_events = max_events
+        self.n_events = 0  # admitted spans + events (meta/summary free)
+        self.n_dropped = 0
+        self._truncated = False
+        self._sealed = False  # summary written: no records may follow it
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        # rotate, don't truncate: a capture at this path is most often
+        # the PREVIOUS (possibly crashed) run's post-mortem evidence,
+        # and the documented recovery flow is to rerun the same command
+        # with --resume — which would otherwise destroy it here
+        try:
+            if os.path.getsize(path) > 0:
+                os.replace(path, path + ".prev")
+        except OSError:
+            pass
+        self._f = open(path, "w")
+        self._line({"type": "meta", "version": TRACE_VERSION,
+                    "clock": "monotonic-relative"})
+
+    # ------------------------------------------------------- internals
+
+    def rel(self, t_monotonic: float) -> float:
+        """Map a ``time.monotonic()`` reading onto the trace epoch."""
+        return t_monotonic - self._t0
+
+    def _line(self, rec: dict) -> None:
+        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+    def _emit(self, rec: dict) -> None:
+        with self._lock:
+            if self._f is None or self._sealed:
+                # closed, or the terminal summary is already written (a
+                # straggling heartbeat/worker): drop silently — summary
+                # must stay the last record, the validator checks it
+                return
+            if self.n_events >= self.max_events:
+                self.n_dropped += 1
+                if not self._truncated:
+                    self._truncated = True
+                    self._line({
+                        "type": "event", "name": "truncated",
+                        "t": round(time.monotonic() - self._t0, 6),
+                        "lane": current_lane(),
+                        "max_events": self.max_events,
+                    })
+                return
+            self.n_events += 1
+            self._line(rec)
+
+    # ------------------------------------------------------ record API
+
+    def span(
+        self,
+        stage: str,
+        t_start: float,
+        dur: float,
+        chunk: int | None = None,
+        lane: str | None = None,
+        **attrs,
+    ) -> None:
+        """Record one completed span. ``t_start`` is the raw
+        ``time.monotonic()`` reading at stage start and ``dur`` the
+        measured duration — pass the SAME dt the busy-time phase
+        accumulator receives, so the capture's per-stage sums and
+        ``RunReport.seconds`` agree by construction."""
+        rec = {
+            "type": "span", "stage": stage,
+            "t": round(self.rel(t_start), 6), "dur": round(dur, 6),
+            "lane": lane or current_lane(),
+        }
+        if chunk is not None:
+            rec["chunk"] = int(chunk)
+        if attrs:
+            rec.update(attrs)
+        self._emit(rec)
+
+    def event(
+        self,
+        name: str,
+        chunk: int | None = None,
+        lane: str | None = None,
+        **attrs,
+    ) -> None:
+        """Record one structured point event at 'now'."""
+        rec = {
+            "type": "event", "name": name,
+            "t": round(self.rel(time.monotonic()), 6),
+            "lane": lane or current_lane(),
+        }
+        if chunk is not None:
+            rec["chunk"] = int(chunk)
+        if attrs:
+            rec.update(attrs)
+        self._emit(rec)
+
+    def write_summary(self, **fields) -> None:
+        """Append the terminal summary record (clean shutdown only).
+        The executor passes its ``RunReport.seconds`` busy totals here;
+        ``tools/trace_report.py`` sum-checks span totals against them."""
+        with self._lock:
+            if self._f is None or self._sealed:
+                return
+            self._sealed = True  # nothing may be recorded after this
+            self._line({
+                "type": "summary",
+                "t": round(time.monotonic() - self._t0, 6),
+                "n_events": self.n_events,
+                "n_dropped": self.n_dropped,
+                **fields,
+            })
+
+    def close(self) -> None:
+        """Flush and close the capture. Idempotent; safe to call from a
+        ``finally`` on every exit path — a crashed run's capture simply
+        ends without a summary record."""
+        with self._lock:
+            f, self._f = self._f, None
+        if f is not None:
+            f.flush()
+            f.close()
+
+
+# ------------------------------------------------- global hook registry
+#
+# faults.py, io/durable.py and the executor's module-level retry helper
+# are not threaded a recorder handle; they emit through this registry.
+# Mirrors the faults.py switchboard: one module-global, a single load +
+# None check when tracing is off.
+
+_active: TraceRecorder | None = None
+
+
+def install(recorder: TraceRecorder | None) -> None:
+    global _active
+    _active = recorder
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def get_active() -> TraceRecorder | None:
+    return _active
+
+
+def emit_event(name: str, chunk: int | None = None, **attrs) -> None:
+    """Hot-path event hook: no-op unless a recorder is installed."""
+    tr = _active
+    if tr is not None:
+        tr.event(name, chunk=chunk, **attrs)
+
+
+# ------------------------------------------------------------ heartbeat
+
+class Heartbeat:
+    """Periodic liveness line for long streaming runs.
+
+    Every ``interval_s`` a daemon thread calls ``stats_fn`` (a cheap
+    closure over the executor's live counters) and prints one
+    ``[duplexumi] heartbeat`` line to stderr: chunks done/inflight,
+    stall fraction, retries, drain utilization. With a recorder
+    attached the same sample is also written as a ``heartbeat`` event,
+    so a capture carries the run's liveness curve. The thread is a
+    daemon and ``stop()`` is join-bounded: a wedged sink can never hold
+    the run open.
+    """
+
+    def __init__(
+        self,
+        interval_s: float,
+        stats_fn,
+        recorder: TraceRecorder | None = None,
+        sink=None,  # overridable for tests; defaults to stderr print
+    ):
+        if interval_s <= 0:
+            raise ValueError(f"heartbeat interval must be > 0 (got {interval_s})")
+        self.interval_s = interval_s
+        self._stats_fn = stats_fn
+        self._recorder = recorder
+        self._sink = sink
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="dut-heartbeat", daemon=True
+        )
+
+    def start(self) -> "Heartbeat":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            # set() wakes the interval wait immediately, so the only
+            # thing worth waiting on is an in-flight beat(); a wedged
+            # sink must be bounded by ~1s, never the full interval
+            self._thread.join(timeout=min(self.interval_s, 1.0))
+
+    def beat(self) -> None:
+        """One sample -> stderr line (+ trace event). Exposed for tests
+        and for a final sample at shutdown."""
+        stats = dict(self._stats_fn())
+        line = "[duplexumi] heartbeat " + " ".join(
+            f"{k}={v}" for k, v in stats.items()
+        )
+        if self._sink is not None:
+            self._sink(line)
+        else:
+            import sys
+
+            print(line, file=sys.stderr, flush=True)
+        if self._recorder is not None:
+            self._recorder.event("heartbeat", **stats)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat()
+            except Exception:
+                # telemetry must never take down the run it observes
+                pass

@@ -123,15 +123,23 @@ def test_cli_multihost_per_host_outputs(tmp_path):
 
     build_linear_index(path, every=60).save(path + ".dlix")
     outs = []
+    trace = str(tmp_path / "mh.trace.jsonl")
+    report = str(tmp_path / "mh.report.json")
     for pid in range(2):
         out = str(tmp_path / "mh.bam")
         assert main(
             ["call", path, "-o", out, "--config", "config3",
              "--capacity", "128", "--chunk-reads", "100",
-             "--n-hosts", "2", "--host-id", str(pid)]
+             "--n-hosts", "2", "--host-id", str(pid),
+             "--trace", trace, "--report", report]
         ) == 0
         hp = str(tmp_path / f"mh.host{pid}.bam")
         assert os.path.exists(hp)
+        # --trace/--report get the same per-host suffix as the output:
+        # pod hosts share storage, a verbatim path would clobber
+        assert os.path.exists(f"{trace}.host{pid}")
+        assert os.path.exists(f"{report}.host{pid}")
+        assert not os.path.exists(trace) and not os.path.exists(report)
         outs.append(hp)
     total = sum(len(read_bam(p)[1]) for p in outs)
     assert total > 0

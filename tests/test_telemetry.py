@@ -1,0 +1,623 @@
+"""Telemetry suite: the span recorder, the streaming executor's
+per-chunk capture, the offline analysis (critical path, lane
+utilization, percentiles, sum-check), the Chrome exporter, the
+heartbeat, the capture schema validator, and the report-shape
+satellites (--report -, profile_phases tolerance, RunReport golden
+schema).
+
+The load-bearing contract: a capture's per-stage span totals must
+reproduce ``RunReport.seconds`` busy totals exactly (the recorder logs
+the same measured dt), chaos/retry/resume machinery must leave
+structured events, and with tracing off the executor behaves
+byte-identically to an untraced run.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from duplexumiconsensusreads_tpu.io import read_bam, simulated_bam
+from duplexumiconsensusreads_tpu.runtime import faults
+from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+from duplexumiconsensusreads_tpu.simulate import SimConfig
+from duplexumiconsensusreads_tpu.telemetry import chrome, report, trace
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+GP = GroupingParams(strategy="adjacency", paired=True)
+CP = ConsensusParams(mode="duplex")
+KW = dict(capacity=128, chunk_reads=90)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every per-chunk stage a fresh (non-resumed) streaming run must record
+CHUNK_STAGES = (
+    "ingest", "bucketing", "dispatch", "device_wait_fetch", "scatter",
+    "deflate", "shard_write", "ckpt", "finalise",
+)
+
+
+@pytest.fixture(scope="module")
+def traced(tmp_path_factory):
+    """One traced + heartbeat streaming run shared by the read-only
+    assertions: (records, report dict, paths dict)."""
+    d = tmp_path_factory.mktemp("telemetry")
+    in_path = str(d / "in.bam")
+    cfg = SimConfig(n_molecules=70, n_positions=9, umi_error=0.02, seed=31)
+    simulated_bam(cfg, path=in_path, sort=True)
+    paths = {
+        "in": in_path,
+        "out": str(d / "out.bam"),
+        "trace": str(d / "trace.jsonl"),
+        "report": str(d / "report.json"),
+    }
+    stream_call_consensus(
+        in_path, paths["out"], GP, CP,
+        trace_path=paths["trace"], heartbeat_s=0.05,
+        report_path=paths["report"], **KW,
+    )
+    records = report.load_trace(paths["trace"])
+    with open(paths["report"]) as f:
+        rep = json.load(f)
+    return records, rep, paths
+
+
+# ------------------------------------------------------------- recorder
+
+class TestRecorder:
+    def test_meta_first_summary_last(self, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        tr = trace.TraceRecorder(p)
+        tr.span("ingest", tr._t0, 0.5, chunk=0)
+        tr.event("retry", site="ingest.read", attempt=1)
+        tr.write_summary(seconds={"ingest": 0.5, "total": 1.0})
+        tr.close()
+        recs = report.load_trace(p)
+        assert recs[0]["type"] == "meta"
+        assert recs[0]["version"] == trace.TRACE_VERSION
+        assert recs[-1]["type"] == "summary"
+        assert recs[-1]["n_events"] == 2
+        assert report.validate_trace(recs) == []
+        # span carries the relative timestamp + attrs envelope
+        sp = [r for r in recs if r["type"] == "span"][0]
+        assert sp["stage"] == "ingest" and sp["chunk"] == 0
+        assert sp["t"] == 0.0 and sp["dur"] == 0.5
+
+    def test_lane_from_thread_name(self, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        tr = trace.TraceRecorder(p)
+
+        def record():
+            tr.span("scatter", tr._t0, 0.1, chunk=1)
+
+        for name in ("dut-drain_3", "dut-xfer_0"):
+            t = threading.Thread(target=record, name=name)
+            t.start()
+            t.join()
+        tr.span("finalise", tr._t0, 0.1)
+        tr.close()
+        lanes = {r["lane"] for r in report.load_trace(p) if r["type"] == "span"}
+        assert lanes == {"drain-3", "xfer-0", "main"}
+
+    def test_bounded_capture_truncates(self, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        tr = trace.TraceRecorder(p, max_events=3)
+        for i in range(10):
+            tr.span("ingest", tr._t0, 0.01, chunk=i)
+        assert tr.n_events == 3 and tr.n_dropped == 7
+        tr.write_summary(seconds={})
+        tr.close()
+        recs = report.load_trace(p)
+        assert report.validate_trace(recs) == []
+        spans = [r for r in recs if r["type"] == "span"]
+        assert len(spans) == 3
+        assert any(
+            r.get("name") == "truncated" and r["max_events"] == 3
+            for r in recs
+        )
+
+    def test_summary_seals_the_capture(self, tmp_path):
+        """Nothing may follow the terminal summary: a straggling
+        heartbeat/worker record after write_summary is dropped, so a
+        healthy run can never flake the check_trace CI gate."""
+        p = str(tmp_path / "t.jsonl")
+        tr = trace.TraceRecorder(p)
+        tr.span("ingest", tr._t0, 0.1, chunk=0)
+        tr.write_summary(seconds={"ingest": 0.1, "total": 0.2})
+        tr.event("heartbeat", chunks_done=1)  # late beat: must drop
+        tr.span("finalise", tr._t0, 0.1)
+        tr.write_summary(seconds={})  # double summary: must drop too
+        tr.close()
+        recs = report.load_trace(p)
+        assert report.validate_trace(recs) == []
+        assert recs[-1]["type"] == "summary"
+        assert report.summary_record(recs) is not None
+
+    def test_existing_capture_rotated_not_truncated(self, tmp_path):
+        """The documented crash flow is 'rerun with --resume': the new
+        run's recorder must rotate the crashed run's capture to .prev,
+        not destroy the post-mortem evidence."""
+        p = str(tmp_path / "t.jsonl")
+        tr1 = trace.TraceRecorder(p)
+        tr1.event("retry", site="ingest.read", attempt=1)
+        tr1.close()
+        tr2 = trace.TraceRecorder(p)
+        tr2.close()
+        prev = report.load_trace(p + ".prev")
+        assert any(r.get("name") == "retry" for r in prev)
+        assert [r["type"] for r in report.load_trace(p)] == ["meta"]
+
+    def test_truncated_capture_sum_check_one_sided(self, tmp_path):
+        """A capture bounded by max_events must NOT fail the sum-check
+        (its totals are a lower bound, not an instrumentation bug);
+        an impossible EXCESS still fails."""
+        p = str(tmp_path / "t.jsonl")
+        tr = trace.TraceRecorder(p, max_events=2)
+        for i in range(6):
+            tr.span("ingest", tr._t0, 1.0, chunk=i)
+        tr.write_summary(seconds={"ingest": 6.0, "total": 6.0})
+        tr.close()
+        recs = report.load_trace(p)
+        assert report.validate_trace(recs) == []
+        rows, ok = report.sum_check(recs)
+        assert ok, rows  # shortfall tolerated under truncation
+        lines, ok2 = report.render_report(recs)
+        assert ok2
+        assert any("one-sided" in ln and "dropped" in ln for ln in lines)
+        # trace > report stays a failure even when truncated
+        _, ok3 = report.sum_check(recs, seconds={"ingest": 0.5, "total": 6.0})
+        assert not ok3
+
+    def test_close_is_idempotent_and_late_writes_drop(self, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        tr = trace.TraceRecorder(p)
+        tr.close()
+        tr.close()
+        tr.span("ingest", tr._t0, 0.1)  # must not raise on closed file
+        tr.event("retry")
+        tr.write_summary(seconds={})
+        assert [r["type"] for r in report.load_trace(p)] == ["meta"]
+
+    def test_global_hook_zero_when_uninstalled(self):
+        trace.uninstall()
+        assert trace.get_active() is None
+        trace.emit_event("retry", site="x")  # no recorder: must be a no-op
+
+
+# -------------------------------------------------- streaming capture
+
+class TestStreamCapture:
+    def test_capture_is_schema_valid(self, traced):
+        records, _, _ = traced
+        assert report.validate_trace(records) == []
+        assert report.summary_record(records) is not None
+
+    def test_every_chunk_covered_by_every_stage(self, traced):
+        records, rep, _ = traced
+        n_chunks = rep["n_chunks"]
+        assert n_chunks >= 3
+        by_stage = {}
+        for r in records:
+            if r["type"] == "span" and "chunk" in r:
+                by_stage.setdefault(r["stage"], set()).add(r["chunk"])
+        for stage in CHUNK_STAGES:
+            assert by_stage.get(stage) == set(range(n_chunks)), stage
+
+    def test_lanes_cover_main_xfer_drain(self, traced):
+        records, _, _ = traced
+        util = report.lane_utilization(records)
+        assert "main" in util
+        assert any(lane.startswith("drain-") for lane in util)
+        assert any(lane.startswith("xfer-") for lane in util)
+        # drain stages really ran on drain lanes, dispatch on xfer
+        for r in records:
+            if r["type"] != "span":
+                continue
+            if r["stage"] in ("scatter", "deflate", "device_wait_fetch"):
+                assert r["lane"].startswith("drain-"), r
+            if r["stage"] in ("ingest", "bucketing", "ckpt", "finalise",
+                              "main_loop_stall"):
+                assert r["lane"] == "main", r
+
+    def test_sum_check_against_report_seconds(self, traced):
+        """THE acceptance contract: per-stage span totals reproduce the
+        RunReport busy totals — checked against both the embedded
+        summary and the separately-written --report JSON."""
+        records, rep, _ = traced
+        rows, ok = report.sum_check(records)
+        assert ok, [r for r in rows if not r["ok"]]
+        rows2, ok2 = report.sum_check(records, seconds=rep["seconds"])
+        assert ok2, [r for r in rows2 if not r["ok"]]
+        # and a corrupted report must FAIL the check (the canary works)
+        bad = dict(rep["seconds"], scatter=rep["seconds"]["scatter"] + 5.0)
+        _, ok3 = report.sum_check(records, seconds=bad)
+        assert not ok3
+
+    def test_critical_path_and_percentiles(self, traced):
+        records, rep, _ = traced
+        paths = report.chunk_critical_paths(records)
+        assert set(paths) == set(range(rep["n_chunks"]))
+        for p in paths.values():
+            assert p["latency_s"] > 0
+            assert p["dominant"] in p["stages"]
+            # the chain is time-ordered and begins with ingest
+            assert p["chain"][0][0] == "ingest"
+        pct = report.chunk_latency_percentiles(records)
+        assert pct["n_chunks"] == rep["n_chunks"]
+        assert 0 < pct["p50_s"] <= pct["p95_s"] <= pct["max_s"]
+        assert sum(pct["dominant_stages"].values()) == rep["n_chunks"]
+
+    def test_heartbeat_samples_in_capture_and_report_fields(self, traced):
+        records, _, _ = traced
+        beats = [
+            r for r in records
+            if r["type"] == "event" and r.get("name") == "heartbeat"
+        ]
+        assert beats  # 0.05s interval over a multi-second run
+        for b in beats:
+            assert {"chunks_done", "chunks_inflight", "stall_frac",
+                    "retries", "drain_util"} <= set(b)
+
+    def test_durable_writes_recorded(self, traced):
+        records, rep, _ = traced
+        dw = [
+            r for r in records
+            if r["type"] == "event" and r.get("name") == "durable_write"
+        ]
+        # at least one per shard (chunks) + checkpoint marks
+        assert len(dw) >= rep["n_chunks"]
+        assert all(r.get("bytes", -1) >= 0 and r.get("dur", -1) >= 0 for r in dw)
+
+    def test_render_report_human_output(self, traced):
+        records, _, _ = traced
+        lines, ok = report.render_report(records)
+        assert ok
+        text = "\n".join(lines)
+        assert "sum-check vs RunReport.seconds: OK" in text
+        assert "chunk critical path" in text
+        assert "drain-0" in text
+
+    def test_chrome_export_opens_lanes_as_tracks(self, traced, tmp_path):
+        records, _, _ = traced
+        out = str(tmp_path / "chrome.json")
+        n = chrome.write_chrome(records, out)
+        with open(out) as f:
+            doc = json.load(f)
+        evs = doc["traceEvents"]
+        assert len(evs) == n
+        n_spans = sum(1 for r in records if r["type"] == "span")
+        assert sum(1 for e in evs if e["ph"] == "X") == n_spans
+        names = {
+            e["args"]["name"] for e in evs
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert "main" in names and "drain-0" in names
+        # spans lose "dur" to the X-event field, but on point events it
+        # is payload (durable_write's fsync cost) and must survive
+        assert not any("dur" in e["args"] for e in evs if e["ph"] == "X")
+        dwr = [e for e in evs if e["ph"] == "i" and e["name"] == "durable_write"]
+        assert dwr and all("dur" in e["args"] for e in dwr)
+        # main is the first track (stable sort order)
+        tids = {e["args"]["name"]: e["tid"] for e in evs
+                if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert tids["main"] == min(tids.values())
+
+    def test_untraced_run_byte_identical_and_no_capture(self, traced, tmp_path):
+        """Tracing must be pure observation: the same input without
+        --trace produces byte-identical output, and no recorder is left
+        installed after a traced run."""
+        records, _, paths = traced
+        assert trace.get_active() is None
+        out2 = str(tmp_path / "plain.bam")
+        stream_call_consensus(paths["in"], out2, GP, CP, **KW)
+        with open(paths["out"], "rb") as a, open(out2, "rb") as b:
+            assert a.read() == b.read()
+
+
+# ------------------------------------------------ chaos + resume events
+
+class TestStructuredEvents:
+    @pytest.fixture(autouse=True)
+    def _fast(self, monkeypatch):
+        monkeypatch.setattr(
+            "duplexumiconsensusreads_tpu.runtime.stream.time.sleep",
+            lambda s: None,
+        )
+        yield
+        faults.uninstall()
+
+    def _sim(self, tmp_path):
+        p = str(tmp_path / "in.bam")
+        cfg = SimConfig(n_molecules=60, n_positions=8, umi_error=0.02, seed=5)
+        simulated_bam(cfg, path=p, sort=True)
+        return p
+
+    def test_chaos_faults_and_retries_are_distinct_events(self, tmp_path):
+        """Acceptance: a chaos run's capture shows the injected fault
+        AND each retry attempt as separate structured records."""
+        in_path = self._sim(tmp_path)
+        tp = str(tmp_path / "chaos.jsonl")
+        faults.install(
+            faults.FaultPlan.parse("shard.write:1:oserror,fetch.result:1:oserror")
+        )
+        stream_call_consensus(
+            in_path, str(tmp_path / "o.bam"), GP, CP, trace_path=tp, **KW
+        )
+        records = report.load_trace(tp)
+        assert report.validate_trace(records) == []
+        inj = [r for r in records if r.get("name") == "fault_injected"]
+        assert {r["site"] for r in inj} == {"shard.write", "fetch.result"}
+        assert all(r["kind"] == "oserror" for r in inj)
+        retries = [r for r in records if r.get("name") == "retry"]
+        # the host-I/O ladder retried shard.write; the device ladder
+        # retried the failed fetch — both visible, with attempt+backoff
+        assert any(r["site"] == "shard.write" for r in retries)
+        assert any(r["site"] == "device.execute" for r in retries)
+        assert all(r["attempt"] >= 1 and r["backoff_s"] >= 0 for r in retries)
+
+    def test_kill_leaves_valid_summaryless_capture(self, tmp_path):
+        """The wrapper owns teardown: after an injected kill the capture
+        file is closed, parseable, schema-valid — just summary-less."""
+        in_path = self._sim(tmp_path)
+        tp = str(tmp_path / "kill.jsonl")
+        faults.install(faults.FaultPlan.parse("ckpt.save:2:kill"))
+        with pytest.raises(faults.InjectedKill):
+            stream_call_consensus(
+                in_path, str(tmp_path / "o.bam"), GP, CP, trace_path=tp, **KW
+            )
+        assert trace.get_active() is None  # uninstalled on the kill path
+        records = report.load_trace(tp)
+        assert report.validate_trace(records) == []
+        assert report.summary_record(records) is None
+        assert any(r.get("name") == "fault_injected" for r in records)
+        # a crashed run's capture is LEGAL: trace_report must exit 0 in
+        # both text and --json modes (sum-check skipped, not failed)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for extra in ([], ["--json"]):
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "trace_report.py"), tp, *extra],
+                capture_output=True, text=True, env=env, cwd=REPO,
+            )
+            assert r.returncode == 0, (extra, r.stderr, r.stdout)
+        assert json.loads(r.stdout)["sum_check"].get("skipped")
+
+    def test_resume_decisions_recorded(self, tmp_path):
+        in_path = self._sim(tmp_path)
+        out = str(tmp_path / "r.bam")
+        ck = str(tmp_path / "ck.json")
+        rep1 = stream_call_consensus(
+            in_path, out, GP, CP, checkpoint_path=ck, **KW
+        )
+        tp = str(tmp_path / "resume.jsonl")
+        rep2 = stream_call_consensus(
+            in_path, out, GP, CP, checkpoint_path=ck, resume=True,
+            trace_path=tp, **KW,
+        )
+        assert rep2.n_chunks_skipped == rep1.n_chunks
+        records = report.load_trace(tp)
+        assert report.validate_trace(records) == []
+        decisions = {
+            r["chunk"]: r["decision"]
+            for r in records
+            if r.get("name") == "resume"
+        }
+        assert decisions == {k: "reused" for k in range(rep1.n_chunks)}
+        # a fully-resumed capture still passes the sum-check (no drain
+        # stages on either side)
+        _, ok = report.sum_check(records)
+        assert ok
+
+
+# ------------------------------------------------------------ CLI + tools
+
+class TestCliAndTools:
+    def test_trace_and_heartbeat_require_streaming(self, tmp_path):
+        from duplexumiconsensusreads_tpu.cli import main
+
+        p = str(tmp_path / "in.bam")
+        simulated_bam(SimConfig(n_molecules=10, seed=1), path=p, sort=True)
+        with pytest.raises(SystemExit, match="--trace requires"):
+            main(["call", p, "-o", str(tmp_path / "o.bam"),
+                  "--trace", str(tmp_path / "t.jsonl")])
+        with pytest.raises(SystemExit, match="--heartbeat requires"):
+            main(["call", p, "-o", str(tmp_path / "o.bam"),
+                  "--heartbeat", "5"])
+        with pytest.raises(SystemExit, match="--heartbeat must be > 0"):
+            main(["call", p, "-o", str(tmp_path / "o.bam"),
+                  "--chunk-reads", "50", "--heartbeat", "-1"])
+
+    def test_cli_trace_report_stdout_and_tools(self, tmp_path, capsys):
+        """End-to-end through the CLI: --trace writes a capture the
+        check_trace/trace_report tools accept, and --report - writes
+        the (stable-key, ms-rounded) RunReport JSON to stdout."""
+        from duplexumiconsensusreads_tpu.cli import main
+
+        p = str(tmp_path / "in.bam")
+        simulated_bam(
+            SimConfig(n_molecules=60, n_positions=8, seed=3), path=p, sort=True
+        )
+        tp = str(tmp_path / "t.jsonl")
+        rc = main([
+            "call", p, "-o", str(tmp_path / "o.bam"), "--config", "config3",
+            "--capacity", "128", "--chunk-reads", "90",
+            "--trace", tp, "--report", "-",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        rep = json.loads(out)
+        assert rep["backend"] == "tpu-stream"
+        # --report -: ms-rounded values, stable (sorted) key order
+        assert list(rep["seconds"]) == sorted(rep["seconds"])
+        for v in rep["seconds"].values():
+            assert round(v, 3) == v
+        assert list(rep) == sorted(rep)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        chk = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+             tp, "--require-summary"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert chk.returncode == 0, chk.stderr
+        trp = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+             tp, "--chrome", str(tmp_path / "chrome.json")],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert trp.returncode == 0, trp.stderr + trp.stdout
+        assert "sum-check vs RunReport.seconds: OK" in trp.stdout
+        assert "chunk critical path" in trp.stdout
+        with open(str(tmp_path / "chrome.json")) as f:
+            assert json.load(f)["traceEvents"]
+
+    def test_check_trace_rejects_garbage(self, tmp_path):
+        bad = str(tmp_path / "bad.jsonl")
+        with open(bad, "w") as f:
+            f.write(json.dumps({"type": "meta", "version": 99}) + "\n")
+            f.write(json.dumps({"type": "span", "stage": "bogus",
+                                "t": -1, "dur": "x", "lane": ""}) + "\n")
+            f.write(json.dumps({"type": "wat"}) + "\n")
+        recs = report.load_trace(bad)
+        problems = report.validate_trace(recs)
+        assert any("version" in p for p in problems)
+        assert any("unknown span stage" in p for p in problems)
+        assert any("unknown record type" in p for p in problems)
+        # non-numeric summary seconds: named problem, and sum_check on
+        # such seconds degrades to a row mismatch instead of crashing
+        corrupt = [
+            {"type": "meta", "version": trace.TRACE_VERSION},
+            {"type": "summary", "t": 1.0, "n_events": 0,
+             "seconds": {"ingest": None}},
+        ]
+        assert any("non-numeric" in p for p in report.validate_trace(corrupt))
+        rows, ok = report.sum_check(corrupt, seconds={"ingest": None})
+        assert rows[0]["report_s"] == 0.0 and ok  # trace total 0 == 0
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        chk = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "check_trace.py"), bad],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert chk.returncode == 1
+        assert "unknown span stage" in chk.stderr
+
+    def test_heartbeat_unit(self):
+        lines = []
+        stats = {"chunks_done": 3, "stall_frac": 0.25}
+        hb = trace.Heartbeat(60.0, lambda: stats, sink=lines.append)
+        hb.beat()
+        hb.stop()  # never started: stop must be safe
+        assert lines == ["[duplexumi] heartbeat chunks_done=3 stall_frac=0.25"]
+
+
+# --------------------------------------------------- report-shape tests
+
+class TestReportShape:
+    def test_profile_phases_tolerates_pre_pipelined_reports(self, tmp_path):
+        """Satellite: old report JSONs (whole-file shape, or streaming
+        reports from before main_loop_stall / drain_utilization /
+        n_drain_workers existed) must render, not KeyError."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from profile_phases import report_busy_wall
+        finally:
+            sys.path.pop(0)
+        old_shapes = [
+            # pre-streaming whole-file report
+            {"seconds": {"read_input": 1.2, "bucketing": 0.3,
+                         "device_dispatch": 0.8, "write_output": 0.5}},
+            # pre-PR-2 streaming report: no stall/util/total/worker count
+            {"seconds": {"ingest": 1.0, "dispatch": 2.0, "finalise": 0.2}},
+            # degenerate but parseable
+            {"seconds": {}},
+            {"seconds": {"total": 5.0, "weird": "text"}},
+            # non-numeric values in the NON-stage keys too
+            {"seconds": {"ingest": 1.0, "total": "n/a",
+                         "drain_utilization": "n/a",
+                         "main_loop_stall": None}},
+            {"seconds": {"main_loop_stall": "x", "total": 2.0}},
+        ]
+        for i, shape in enumerate(old_shapes):
+            p = str(tmp_path / f"old{i}.json")
+            with open(p, "w") as f:
+                json.dump(shape, f)
+            assert report_busy_wall(p) == 0, shape
+
+    def test_profile_phases_busy_wall_canary_exits_1(self, tmp_path, capsys):
+        """Satellite: the busy > wall x pool accounting canary must
+        return exit status 1 (the CI contract)."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from profile_phases import report_busy_wall
+        finally:
+            sys.path.pop(0)
+        p = str(tmp_path / "bug.json")
+        with open(p, "w") as f:
+            json.dump({"seconds": {"ingest": 12.0, "total": 10.0},
+                       "n_drain_workers": 2}, f)
+        assert report_busy_wall(p) == 1
+        err = capsys.readouterr().err
+        assert "ACCOUNTING BUG" in err and "ingest" in err
+        # non-report JSON: clean failure, not a traceback
+        p2 = str(tmp_path / "notrep.json")
+        with open(p2, "w") as f:
+            json.dump(["not", "a", "report"], f)
+        assert report_busy_wall(p2) == 1
+
+    def test_runreport_schema_golden(self):
+        """New RunReport fields must be added DELIBERATELY: extend this
+        frozen list in the same change that adds the field (report JSON
+        is a driver-facing contract)."""
+        from duplexumiconsensusreads_tpu.runtime.executor import RunReport
+
+        golden = {
+            "n_records", "n_valid_reads", "n_dropped", "n_buckets",
+            "n_families", "n_molecules", "n_consensus", "n_devices",
+            "n_chunks", "n_chunks_skipped", "n_size_classes",
+            "n_pipeline_compiles", "n_retries", "n_drain_workers",
+            "n_mixed_mate_families", "n_consensus_pairs",
+            "n_precluster_fallback_groups", "n_precluster_fallback_reads",
+            "n_jumbo_hardcut_families", "n_jumbo_hardcut_splits",
+            "n_downsampled_reads", "n_rescued_cigar", "n_dropped_cigar_ab",
+            "n_dropped_cigar_ba", "n_projected_reads",
+            "n_projection_fallback_reads", "n_projection_fallback_groups",
+            "n_projection_unanchored_reads", "n_umi_corrected",
+            "n_dropped_whitelist", "mate_aware", "backend",
+            "bytes_h2d", "bytes_d2h", "seconds",
+        }
+        assert {f.name for f in dataclasses.fields(RunReport)} == golden
+
+    def test_streaming_seconds_keys_golden(self, traced):
+        """The streaming executor's stage-key set is part of the same
+        contract (trace stages, busy_wall_table pools, and the BENCH
+        phases dict all key on it)."""
+        _, rep, _ = traced
+        assert set(rep["seconds"]) == {
+            "ingest", "bucketing", "dispatch", "device_wait_fetch",
+            "scatter", "deflate", "shard_write", "ckpt", "finalise",
+            "main_loop_stall", "drain_utilization", "total",
+        }
+
+    def test_to_json_stable_and_ms_rounded(self):
+        from duplexumiconsensusreads_tpu.runtime.executor import RunReport
+
+        rep = RunReport(backend="x")
+        rep.seconds = {"zeta": 1.23456789, "alpha": 0.0004}
+        d = json.loads(rep.to_json())
+        assert list(d["seconds"]) == ["alpha", "zeta"]
+        assert d["seconds"]["zeta"] == 1.235
+        assert list(d) == sorted(d)
+
+    def test_write_report_stdout(self, capsys):
+        from duplexumiconsensusreads_tpu.runtime.executor import (
+            RunReport,
+            write_report,
+        )
+
+        write_report(RunReport(backend="t"), "-")
+        out = capsys.readouterr().out
+        assert json.loads(out)["backend"] == "t"

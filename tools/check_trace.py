@@ -1,0 +1,66 @@
+"""Schema validator for streaming-executor trace captures (CI gate).
+
+Run: python tools/check_trace.py trace.jsonl [--require-summary]
+
+Exit 0 when the capture conforms to the telemetry contract
+(telemetry/trace.py: meta header first, known span stages and event
+names, numeric non-negative timestamps, one terminal summary whose
+n_events matches the record count); exit 1 listing every violation
+otherwise. ``--require-summary`` additionally fails a capture that
+lacks the terminal summary record — i.e. one from a run that did not
+shut down cleanly — which is what the tier-1 test uses: a synthetic
+run's capture must always be COMPLETE, not merely well-formed.
+
+The rules live in telemetry/report.py (validate_trace) so the CLI, the
+tier-1 test, and trace_report.py all enforce the same contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check_trace.py",
+        description="validate a `call --trace` capture against the "
+        "telemetry schema",
+    )
+    ap.add_argument("trace", help="JSONL capture from call --trace")
+    ap.add_argument(
+        "--require-summary", action="store_true",
+        help="also fail captures without the terminal summary record "
+        "(runs that did not shut down cleanly)",
+    )
+    args = ap.parse_args(argv)
+
+    from duplexumiconsensusreads_tpu.telemetry import report
+
+    try:
+        records = report.load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"check_trace: {e}", file=sys.stderr)
+        return 1
+    problems = report.validate_trace(records)
+    if args.require_summary and report.summary_record(records) is None:
+        problems.append("no terminal summary record (unclean shutdown?)")
+    if problems:
+        for p in problems:
+            print(f"check_trace: {args.trace}: {p}", file=sys.stderr)
+        return 1
+    n_spans = sum(1 for r in records if r.get("type") == "span")
+    n_events = sum(1 for r in records if r.get("type") == "event")
+    print(
+        f"[check_trace] {args.trace}: OK "
+        f"({n_spans} spans, {n_events} events)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import os as _os
+
+    sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    raise SystemExit(main())

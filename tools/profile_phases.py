@@ -46,13 +46,25 @@ import numpy as np
 def report_busy_wall(path: str) -> int:
     """Print the overlapped busy-vs-wall table for a RunReport JSON
     (from `call --report`). Exit status 1 when any stage's busy time
-    exceeds wall x pool — the accounting-bug canary for CI."""
+    exceeds wall x pool — the accounting-bug canary for CI.
+
+    Tolerant of OLDER report shapes by design: pre-pipelined-drain
+    reports lack main_loop_stall / drain_utilization / n_drain_workers
+    (and whole-file reports lack "total"); every absent field renders
+    as its neutral default instead of a KeyError — this tool is how
+    historical captures get re-read, so it must accept them all."""
     from duplexumiconsensusreads_tpu.runtime.executor import busy_wall_table
 
     with open(path) as f:
         rep = json.load(f)
+    if not isinstance(rep, dict) or not isinstance(rep.get("seconds", {}), dict):
+        print(f"{path}: not a RunReport JSON (no seconds dict)", file=sys.stderr)
+        return 1
+    dw = rep.get("n_drain_workers", 1)
+    if not isinstance(dw, int) or isinstance(dw, bool):
+        dw = 1
     lines, bugs = busy_wall_table(
-        rep.get("seconds", {}), drain_workers=max(rep.get("n_drain_workers", 1), 1)
+        rep.get("seconds", {}) or {}, drain_workers=max(dw, 1)
     )
     for ln in lines:
         print(ln)
