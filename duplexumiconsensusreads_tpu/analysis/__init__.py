@@ -1,0 +1,34 @@
+"""dutlint: AST-based invariant linter for this codebase.
+
+The paper's core promise — byte-identical duplex consensus output that
+survives crashes, faults, and resume — rests on cross-module invariants
+that no general-purpose linter knows about: every durable write goes
+through ``io.durable``, every phase clock is ``time.monotonic()``,
+every ``fault_point`` site is registered and chaos-covered, trace stage
+names equal RunReport phase keys, telemetry hooks stay zero-cost when
+off. As the streaming executor grew (PR 1-3), these conventions came to
+span too many files to police by review alone; this package encodes
+them as executable rules.
+
+Layout:
+  engine.py  corpus loading (path -> ast), the rule registry, the
+             allowlist, and ``run_lint`` — the one entry point
+  rules.py   the project's invariant rules (registered on import)
+  allowlist.py  intentional, reasoned exceptions (path + rule + reason)
+  cli.py     ``tools/dutlint.py`` / the ``dutlint`` console script
+
+Run ``python tools/dutlint.py`` (exit 1 on any non-allowlisted
+finding); ``tests/test_lint.py`` runs the same engine in-process as a
+tier-1 gate, plus per-rule firing/passing fixtures.
+"""
+
+from duplexumiconsensusreads_tpu.analysis.engine import (  # noqa: F401
+    Corpus,
+    Finding,
+    RULES,
+    load_corpus,
+    run_lint,
+)
+from duplexumiconsensusreads_tpu.analysis import rules  # noqa: F401  (registers)
+
+__all__ = ["Corpus", "Finding", "RULES", "load_corpus", "run_lint"]

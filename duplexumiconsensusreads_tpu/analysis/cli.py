@@ -1,0 +1,150 @@
+"""dutlint CLI: run the invariant rules over the repo's linted set.
+
+Default file set: the whole ``duplexumiconsensusreads_tpu`` package,
+every ``tools/*.py`` script, and the two test-side registry anchors
+(``tests/test_chaos.py`` for fault-site coverage,
+``tests/test_telemetry.py`` for the seconds-keys golden) — which are
+also linted themselves.
+
+Exit status: 0 when clean (allowlisted findings don't count, but are
+listed with their reasons under -v), 1 on any non-allowlisted finding,
+2 on usage errors. ``--json`` emits a machine-readable report for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from duplexumiconsensusreads_tpu.analysis.allowlist import ALLOWLIST
+from duplexumiconsensusreads_tpu.analysis.engine import (
+    RULES,
+    load_corpus,
+    run_lint,
+)
+
+PACKAGE = "duplexumiconsensusreads_tpu"
+# test files the cross-file rules anchor on; linted like everything else
+TEST_ANCHORS = ("tests/test_chaos.py", "tests/test_telemetry.py")
+
+
+def repo_root() -> str:
+    """The directory containing the package (works from a checkout;
+    the console-script entry resolves through the installed package)."""
+    import duplexumiconsensusreads_tpu as pkg
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(pkg.__file__)))
+
+
+def default_targets(root: str) -> list[str]:
+    rels: list[str] = []
+    pkg_dir = os.path.join(root, PACKAGE)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                rels.append(
+                    os.path.relpath(os.path.join(dirpath, fn), root)
+                )
+    tools_dir = os.path.join(root, "tools")
+    if os.path.isdir(tools_dir):
+        for fn in sorted(os.listdir(tools_dir)):
+            if fn.endswith(".py"):
+                rels.append(os.path.join("tools", fn))
+    for anchor in TEST_ANCHORS:
+        if os.path.exists(os.path.join(root, anchor)):
+            rels.append(anchor)
+    return rels
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dutlint",
+        description="AST-based invariant linter (clocks, durability, "
+        "fault sites, phase registries, lock discipline, hook guards)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="repo-relative files to lint (default: package + tools/ + "
+        "test anchors)",
+    )
+    ap.add_argument("--root", default=None, help="repo root (default: "
+                    "the checkout containing the package)")
+    ap.add_argument("--rule", action="append", dest="rules", metavar="ID",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list allowlist-suppressed findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid:<22} {RULES[rid].title}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    rels = args.paths or default_targets(root)
+    if args.rules:
+        bad = [r for r in args.rules if r not in RULES]
+        if bad:
+            print(f"dutlint: unknown rule(s): {', '.join(bad)}",
+                  file=sys.stderr)
+            return 2
+    try:
+        corpus = load_corpus(root, rels)
+    except OSError as e:
+        print(f"dutlint: {e}", file=sys.stderr)
+        return 2
+    result = run_lint(corpus, ALLOWLIST, only_rules=args.rules)
+
+    if args.json:
+        print(json.dumps({
+            "root": root,
+            "n_files": len(corpus.trees) + len(corpus.parse_failures),
+            "findings": [vars(f) for f in result.findings],
+            "suppressed": [
+                {**vars(f), "reason": a.reason}
+                for f, a in result.suppressed
+            ],
+            "unused_allowlist": [vars(a) for a in result.unused_allowlist],
+            "ok": result.ok,
+        }, indent=2))
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.format())
+    if args.verbose:
+        for f, a in result.suppressed:
+            print(f"allowed: {f.format()}\n         reason: {a.reason}")
+    if not args.paths:
+        # staleness is only meaningful against the full default set: an
+        # explicit file subset legitimately misses most entries. Stale
+        # suppressions are warnings, not failures, here — the tier-1
+        # gate (tests/test_lint.py) is what forces pruning.
+        for a in result.unused_allowlist:
+            print(
+                f"dutlint: warning: unused allowlist entry "
+                f"({a.rule}, {a.path}) — prune it",
+                file=sys.stderr,
+            )
+    n_files = len(corpus.trees) + len(corpus.parse_failures)
+    if result.ok:
+        print(
+            f"dutlint: OK — {n_files} files, "
+            f"{len(RULES) if not args.rules else len(args.rules)} rules, "
+            f"{len(result.suppressed)} allowlisted"
+        )
+        return 0
+    print(
+        f"dutlint: {len(result.findings)} finding(s) in {n_files} files "
+        f"({len(result.suppressed)} allowlisted)",
+        file=sys.stderr,
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,588 @@
+"""The project's invariant rules.
+
+Each rule encodes one convention the correctness story depends on, as
+documented in ARCHITECTURE.md "Invariants & static analysis". Rules are
+cross-file on purpose: most of these invariants live BETWEEN modules
+(a registry here, its call sites there, the test that pins them third),
+which is exactly the drift a per-file linter cannot see.
+
+Anchor files are located by path suffix (``Corpus.find``) so the same
+rules run over the real tree and over the miniature fixture corpora in
+``tests/test_lint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from duplexumiconsensusreads_tpu.analysis.engine import (
+    Corpus,
+    Finding,
+    ancestors,
+    call_name,
+    enclosing_function,
+    guarded_not_none,
+    inside_lock_body,
+    register,
+    str_const,
+    str_tuple_assign,
+)
+
+# ------------------------------------------------------------- rule: clock
+
+@register(
+    "clock-discipline",
+    "phase/duration accounting must use time.monotonic(), never time.time()",
+)
+def check_clock(corpus: Corpus) -> Iterator[Finding]:
+    """``RunReport.seconds`` and every duration in the codebase are
+    monotonic-clock deltas: an NTP step mid-run must not be able to
+    produce negative or inflated phases (see runtime/stream.py's
+    accounting and the telemetry epoch). Any ``time.time()`` call is
+    therefore suspect — genuine wall-clock needs are allowlisted."""
+    for path, tree in corpus.trees.items():
+        # names `from time import time [as x]` binds in this module
+        aliased = {
+            a.asname or a.name
+            for node in ast.walk(tree)
+            if isinstance(node, ast.ImportFrom) and node.module == "time"
+            for a in node.names
+            if a.name == "time"
+        }
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            hit = (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "time"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+            ) or (isinstance(fn, ast.Name) and fn.id in aliased)
+            if hit:
+                yield Finding(
+                    rule="clock-discipline",
+                    path=path,
+                    line=node.lineno,
+                    message="time.time() used for timing",
+                    hint="use time.monotonic() — wall-clock steps (NTP) "
+                    "corrupt duration deltas and RunReport.seconds",
+                )
+
+
+# -------------------------------------------------------- rule: durability
+
+_DURABLE_PROTOCOL_CALLS = {"write_durable", "replace_durable", "rewrite_from"}
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    if call_name(node) != "open" or not isinstance(node.func, ast.Name):
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = str_const(node.args[1])
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = str_const(kw.value)
+    # '+' catches update modes ("r+b"): an in-place patch of a trusted
+    # file is the same torn-write hazard as a fresh write
+    return mode is not None and any(c in mode for c in "wax+")
+
+
+@register(
+    "durability-protocol",
+    "persistent writes in io//runtime/ must use the tmp+fsync+rename protocol",
+)
+def check_durability(corpus: Corpus) -> Iterator[Finding]:
+    """A file a later run trusts by existence (shards, manifests, the
+    finalised BAM, indexes) written with a bare ``open(.., "w")`` can
+    survive a crash looking complete while holding torn bytes — the
+    exact failure mode io/durable.py exists for. In ``io/`` and
+    ``runtime/``, every write-mode open must sit in a function that
+    routes through the protocol (write_durable / replace_durable /
+    rewrite_from); anything else is a finding (intentional diagnostics
+    writers are allowlisted, with reasons)."""
+    for path, tree in corpus.trees.items():
+        parts = path.split("/")
+        if not any(seg in ("io", "runtime") for seg in parts[:-1]):
+            continue
+        if path.endswith("io/durable.py"):
+            continue  # the protocol implementation itself
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _open_write_mode(node)):
+                continue
+            fn = enclosing_function(node)
+            scope = fn if fn is not None else tree
+            uses_protocol = any(
+                isinstance(n, ast.Call)
+                and call_name(n) in _DURABLE_PROTOCOL_CALLS
+                for n in ast.walk(scope)
+            )
+            if not uses_protocol:
+                yield Finding(
+                    rule="durability-protocol",
+                    path=path,
+                    line=node.lineno,
+                    message="bare write-mode open() outside the durable "
+                    "write protocol",
+                    hint="route through io.durable.write_durable (tmp + "
+                    "fsync + atomic rename + dir fsync), or stage into a "
+                    ".tmp via rewrite_from/replace_durable",
+                )
+
+
+# ----------------------------------------------------- rule: fault registry
+
+@register(
+    "fault-registry",
+    "fault_point sites, faults.KNOWN_SITES, and the chaos suite must agree",
+)
+def check_fault_registry(corpus: Corpus) -> Iterator[Finding]:
+    """Three-way consistency: (a) every site literal at a
+    ``fault_point``/``_io_retry`` call is registered in
+    runtime/faults.py KNOWN_SITES (an unregistered site raises at
+    runtime — but only when a plan is installed, i.e. exactly when you
+    need it); (b) every registered site is actually threaded through
+    the code (a dead site gives false chaos-coverage confidence); (c)
+    every registered site is exercised by tests/test_chaos.py, either
+    as a literal or via a parametrize over KNOWN_SITES."""
+    faults_path = corpus.find("runtime/faults.py")
+    if faults_path is None:
+        return
+    known, known_line = str_tuple_assign(
+        corpus.trees[faults_path], "KNOWN_SITES"
+    )
+    if not known:
+        yield Finding(
+            rule="fault-registry",
+            path=faults_path,
+            line=1,
+            message="KNOWN_SITES literal tuple not found",
+            hint="keep KNOWN_SITES a module-level tuple of string literals "
+            "so the registry stays statically checkable",
+        )
+        return
+    known_set = set(known)
+
+    # (a) + usage collection: literals at fault_point()/_io_retry() sites
+    used: dict[str, tuple[str, int]] = {}
+    for path in corpus.package_paths():
+        if path == faults_path:
+            continue
+        for node in ast.walk(corpus.trees[path]):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in ("fault_point", "_io_retry"):
+                continue
+            if not node.args:
+                continue
+            site = str_const(node.args[0])
+            if site is None:
+                continue  # variable site (the fault_point(site) relay)
+            used.setdefault(site, (path, node.lineno))
+            if site not in known_set:
+                yield Finding(
+                    rule="fault-registry",
+                    path=path,
+                    line=node.lineno,
+                    message=f"fault site {site!r} is not registered in "
+                    f"faults.KNOWN_SITES",
+                    hint="add it to KNOWN_SITES (and cover it in "
+                    "tests/test_chaos.py) or fix the typo",
+                )
+
+    # (b) registered but never threaded through the code
+    for site in known:
+        if site not in used:
+            yield Finding(
+                rule="fault-registry",
+                path=faults_path,
+                line=known_line,
+                message=f"KNOWN_SITES entry {site!r} has no "
+                f"fault_point/_io_retry call site",
+                hint="thread the site through the step it names, or drop "
+                "the dead registry entry",
+            )
+
+    # (c) chaos coverage. Skipped when the chaos suite isn't in the
+    # corpus (explicit-path runs, installed-package runs): the tier-1
+    # gate lints the default set, which always anchors it in a checkout
+    # (tests/test_lint.py pins that).
+    chaos_path = corpus.find("tests/test_chaos.py")
+    if chaos_path is None:
+        return
+    chaos_tree = corpus.trees[chaos_path]
+    blanket = any(
+        isinstance(node, ast.Call)
+        and call_name(node) == "parametrize"
+        and any(
+            (isinstance(a, ast.Attribute) and a.attr == "KNOWN_SITES")
+            or (isinstance(a, ast.Name) and a.id == "KNOWN_SITES")
+            for a in node.args
+        )
+        for node in ast.walk(chaos_tree)
+    )
+    if blanket:
+        return  # a parametrize over the registry covers every site
+    # only literals that reach CODE count as coverage — strings inside
+    # call arguments (schedule specs, parametrize lists) or assigned
+    # data (a BOUNDARY_KILLS-style table later fed to parametrize). A
+    # docstring or comment-string mentioning a site must not read as
+    # exercising it (docstrings are bare Expr statements: excluded).
+    roots: list[ast.AST] = []
+    for node in ast.walk(chaos_tree):
+        if isinstance(node, ast.Call):
+            roots.extend(node.args)
+            roots.extend(kw.value for kw in node.keywords)
+        elif isinstance(node, ast.Assign):
+            roots.append(node.value)
+    literals = [
+        lit
+        for root in roots
+        for sub in ast.walk(root)
+        if (lit := str_const(sub)) is not None
+    ]
+    for site in known:
+        if not any(site in lit for lit in literals):
+            yield Finding(
+                rule="fault-registry",
+                path=chaos_path,
+                line=1,
+                message=f"fault site {site!r} is never exercised by the "
+                f"chaos suite",
+                hint="add a schedule hitting it, or parametrize a test "
+                "over faults.KNOWN_SITES",
+            )
+
+
+# ----------------------------------------------------- rule: phase registry
+
+# rep.seconds carries these beside the per-stage busy keys; the golden
+# test's key set is the stage set plus exactly these
+_DERIVED_SECONDS_KEYS = {"drain_utilization", "total"}
+
+
+@register(
+    "phase-registry",
+    "trace stages, DRAIN_PHASES, the phase dict, and the seconds golden "
+    "must be one set",
+)
+def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
+    """The PR-3-era drift class: a stage added to the executor's phase
+    dict but not KNOWN_STAGES (the capture validator rejects healthy
+    traces), or to both but not the report golden (the driver-facing
+    schema silently changes), or a span recorded under a name the sum
+    check can't match. One set, four mirrors; this rule diffs them."""
+    trace_path = corpus.find("telemetry/trace.py")
+    if trace_path is None:
+        return
+    stages, stages_line = str_tuple_assign(
+        corpus.trees[trace_path], "KNOWN_STAGES"
+    )
+    events, _ = str_tuple_assign(corpus.trees[trace_path], "KNOWN_EVENTS")
+    if not stages:
+        yield Finding(
+            rule="phase-registry",
+            path=trace_path,
+            line=1,
+            message="KNOWN_STAGES literal tuple not found",
+            hint="keep KNOWN_STAGES a module-level tuple of string literals",
+        )
+        return
+    stage_set = set(stages)
+
+    # DRAIN_PHASES ⊆ KNOWN_STAGES
+    exec_path = corpus.find("runtime/executor.py")
+    if exec_path is not None:
+        drain, drain_line = str_tuple_assign(
+            corpus.trees[exec_path], "DRAIN_PHASES"
+        )
+        for ph in drain:
+            if ph not in stage_set:
+                yield Finding(
+                    rule="phase-registry",
+                    path=exec_path,
+                    line=drain_line,
+                    message=f"DRAIN_PHASES entry {ph!r} is not a known "
+                    f"trace stage",
+                    hint="DRAIN_PHASES must be a subset of "
+                    "telemetry.trace.KNOWN_STAGES",
+                )
+
+    # the streaming executor's phase-accounting dict == KNOWN_STAGES
+    stream_path = corpus.find("runtime/stream.py")
+    if stream_path is not None:
+        phase_keys, phase_line = _phase_dict_keys(corpus.trees[stream_path])
+        if phase_keys is not None:
+            for k in phase_keys - stage_set:
+                yield Finding(
+                    rule="phase-registry",
+                    path=stream_path,
+                    line=phase_line,
+                    message=f"phase dict key {k!r} is not a known trace "
+                    f"stage",
+                    hint="add it to telemetry.trace.KNOWN_STAGES in the "
+                    "same change (stage set == phase-key set)",
+                )
+            for k in stage_set - phase_keys:
+                yield Finding(
+                    rule="phase-registry",
+                    path=stream_path,
+                    line=phase_line,
+                    message=f"known trace stage {k!r} missing from the "
+                    f"phase accounting dict",
+                    hint="every stage must accrue busy seconds (the "
+                    "trace_report sum-check is phrased over all stages)",
+                )
+
+    # every literal span stage / event name recorded in the package is
+    # registered (a typo'd stage fails the capture schema check only at
+    # runtime, with a trace flag set — exactly too late)
+    for path in corpus.package_paths():
+        if path == trace_path:
+            continue
+        for node in ast.walk(corpus.trees[path]):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = call_name(node)
+            lit = str_const(node.args[0])
+            if lit is None:
+                continue
+            if name == "span" and lit not in stage_set:
+                yield Finding(
+                    rule="phase-registry",
+                    path=path,
+                    line=node.lineno,
+                    message=f"span recorded under unknown stage {lit!r}",
+                    hint="register the stage in telemetry.trace."
+                    "KNOWN_STAGES (and the phase dict + golden)",
+                )
+            if (
+                name in ("event", "emit_event")
+                and events
+                and lit not in events
+            ):
+                yield Finding(
+                    rule="phase-registry",
+                    path=path,
+                    line=node.lineno,
+                    message=f"event recorded under unknown name {lit!r}",
+                    hint="register the event in telemetry.trace.KNOWN_EVENTS",
+                )
+
+    # the RunReport streaming-seconds golden in tests == stages + derived
+    golden_path = corpus.find("tests/test_telemetry.py")
+    if golden_path is not None:
+        golden, golden_line = _golden_seconds_set(corpus.trees[golden_path])
+        if golden is not None:
+            want = stage_set | _DERIVED_SECONDS_KEYS
+            for k in sorted(golden - want):
+                yield Finding(
+                    rule="phase-registry",
+                    path=golden_path,
+                    line=golden_line,
+                    message=f"seconds-keys golden has {k!r}, which is "
+                    f"neither a known stage nor a derived key",
+                    hint="stage keys come from telemetry.trace."
+                    "KNOWN_STAGES; derived keys are "
+                    f"{sorted(_DERIVED_SECONDS_KEYS)}",
+                )
+            for k in sorted(want - golden):
+                yield Finding(
+                    rule="phase-registry",
+                    path=golden_path,
+                    line=golden_line,
+                    message=f"seconds-keys golden is missing {k!r}",
+                    hint="extend test_streaming_seconds_keys_golden in the "
+                    "same change that adds the stage",
+                )
+
+
+def _phase_dict_keys(tree: ast.Module) -> tuple[set[str] | None, int]:
+    """Keys of the ``phase = {...}`` accounting dict (all-str-key dict
+    literal assigned to a name ``phase``, at any scope)."""
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "phase"
+            and isinstance(node.value, ast.Dict)
+        ):
+            continue
+        keys = [str_const(k) for k in node.value.keys if k is not None]
+        if keys and all(k is not None for k in keys):
+            return {k for k in keys if k is not None}, node.lineno
+    return None, 0
+
+
+def _golden_seconds_set(tree: ast.Module) -> tuple[set[str] | None, int]:
+    """The literal set compared in test_streaming_seconds_keys_golden."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "test_streaming_seconds_keys_golden"
+        ):
+            best: tuple[set[str], int] | None = None
+            for n in ast.walk(node):
+                if isinstance(n, ast.Set):
+                    vals = [str_const(e) for e in n.elts]
+                    if vals and all(v is not None for v in vals):
+                        s = {v for v in vals if v is not None}
+                        if best is None or len(s) > len(best[0]):
+                            best = (s, n.lineno)
+            if best:
+                return best
+    return None, 0
+
+
+# ----------------------------------------------------- rule: lock discipline
+
+_BLOCKING_NAMES = {"open", "fsync", "fsync_file", "result", "sleep"}
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "clear", "update", "pop",
+    "popitem", "setdefault", "add", "discard", "appendleft", "popleft",
+}
+
+
+@register(
+    "lock-discipline",
+    "no blocking I/O inside lock bodies; module-level mutable state "
+    "mutated only under a lock",
+)
+def check_lock_discipline(corpus: Corpus) -> Iterator[Finding]:
+    """The executor's locks serialize ACCOUNTING (dict += under
+    phase_lock, one buffered line under the recorder lock) — cheap by
+    contract. Blocking work inside a lock body (file open, fsync,
+    compression, a future's ``result()``, sleep) turns every other
+    worker's bookkeeping into convoyed wall time; the pipelined drain's
+    whole point evaporates. Scope: runtime/stream.py and
+    telemetry/trace.py, the two files whose locks sit on the per-chunk
+    hot path. The second check is the inverse: module-level mutable
+    containers written OUTSIDE any lock are cross-thread races waiting
+    for load (the executor's pools share module state)."""
+    for suffix in ("runtime/stream.py", "telemetry/trace.py"):
+        path = corpus.find(suffix)
+        if path is None:
+            continue
+        tree = corpus.trees[path]
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            blocking = name in _BLOCKING_NAMES or "compress" in name.lower()
+            if blocking and inside_lock_body(node):
+                yield Finding(
+                    rule="lock-discipline",
+                    path=path,
+                    line=node.lineno,
+                    message=f"blocking call {name}() inside a lock body",
+                    hint="do the I/O/compute outside the lock; hold the "
+                    "lock only for the shared-state update",
+                )
+
+        module_mutables = {
+            t.id
+            for stmt in tree.body
+            if isinstance(stmt, ast.Assign)
+            for t in stmt.targets
+            if isinstance(t, ast.Name) and _is_mutable_ctor(stmt.value)
+        }
+        if not module_mutables:
+            continue
+        for node in ast.walk(tree):
+            target_name = _mutated_module_name(node, module_mutables)
+            if target_name is None:
+                continue
+            if enclosing_function(node) is None:
+                continue  # module-level init writes are single-threaded
+            if not inside_lock_body(node):
+                yield Finding(
+                    rule="lock-discipline",
+                    path=path,
+                    line=node.lineno,
+                    message=f"module-level mutable {target_name!r} mutated "
+                    f"outside any lock",
+                    hint="take the module's lock around the mutation (the "
+                    "executor's worker pools share this state)",
+                )
+
+
+def _is_mutable_ctor(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    return isinstance(value, ast.Call) and call_name(value) in (
+        "dict", "list", "set", "deque", "defaultdict", "Counter",
+    )
+
+
+def _mutated_module_name(node: ast.AST, names: set[str]) -> str | None:
+    """Name from ``names`` this node mutates, if any: subscript/aug
+    assignment or a mutating method call on the bare module-level name."""
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in names
+            ):
+                return t.value.id
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _MUTATORS
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id in names
+    ):
+        return node.func.value.id
+    return None
+
+
+# --------------------------------------------------------- rule: hook guard
+
+@register(
+    "hook-guard",
+    "recorder span/event hooks on hot paths must be behind a single "
+    "None check",
+)
+def check_hook_guard(corpus: Corpus) -> Iterator[Finding]:
+    """The zero-cost-when-off contract (same discipline as
+    ``faults.fault_point``): with tracing off, every telemetry hook in
+    the per-chunk path must cost one None check — so a direct
+    ``tr.span(...)`` / ``tr.event(...)`` on a local recorder variable
+    must sit inside ``if tr is not None:`` (or the ``else`` of ``if tr
+    is None:``) — dotted receivers (``ctx.tr.span``,
+    ``self._recorder.event``) included, guarded on the same dotted
+    path. Module-hook helpers (``emit_event``, ``fault_point``) carry
+    the check internally and are exempt; a bare ``self.span(...)`` is
+    the recorder's own internals and is exempt too."""
+    from duplexumiconsensusreads_tpu.analysis.engine import expr_path
+
+    for path in corpus.package_paths():
+        tree = corpus.trees.get(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute) and fn.attr in ("span", "event")
+            ):
+                continue
+            var = expr_path(fn.value)
+            if var is None or var == "self":
+                continue  # no stable identity / recorder internals
+            if not guarded_not_none(node, var):
+                yield Finding(
+                    rule="hook-guard",
+                    path=path,
+                    line=node.lineno,
+                    message=f"unguarded telemetry hook {var}.{fn.attr}(...)",
+                    hint=f"wrap in `if {var} is not None:` — hooks must be "
+                    "a single None check when tracing is off",
+                )

@@ -117,6 +117,19 @@ def compress_fast(data: bytes, level: int = 6, eof: bool = True) -> bytes:
     """BGZF-compress via the native multithreaded library when present
     (io/native), falling back to the pure-Python codec. DUT_NO_NATIVE=1
     forces the fallback (same knob as the native reader)."""
+    return compress_fast_tagged(data, level=level, eof=eof)[0]
+
+
+def compress_fast_tagged(
+    data: bytes, level: int = 6, eof: bool = True
+) -> tuple[bytes, str]:
+    """``compress_fast`` plus the codec ACTUALLY used: (bytes,
+    "native"|"python"). Native and pure-Python deflate produce
+    different — both valid — bytes for the same records, and the
+    native call can fail at RUNTIME after a successful capability
+    probe; callers persisting compressed artifacts that a later run
+    may splice verbatim (the streaming executor's checkpoint shards)
+    must record this tag, not an up-front probe."""
     import os
 
     out = None
@@ -128,8 +141,41 @@ def compress_fast(data: bytes, level: int = 6, eof: bool = True) -> bytes:
         except Exception:
             out = None
     if out is None:
-        return compress(data, level=level, eof=eof)
-    return out + (BGZF_EOF if eof else b"")
+        return compress(data, level=level, eof=eof), "python"
+    return out + (BGZF_EOF if eof else b""), "native"
+
+
+# capability probe cache: native availability is stable within a
+# process (get_lib binds once), so one tiny real compression settles it
+_compress_capable: bool | None = None
+
+
+def native_compress_capable() -> bool:
+    """True iff the native BGZF deflate path actually WORKS, probed by
+    compressing a tiny payload — not by ``get_lib()`` presence. A
+    library that loads but whose compress entry point fails must read
+    as incapable, or fingerprints tag shards with a codec the runtime
+    then silently falls back from (mixed-codec splices on resume)."""
+    global _compress_capable
+    if _compress_capable is None:
+        try:
+            from duplexumiconsensusreads_tpu.native import bgzf_compress_native
+
+            _compress_capable = bgzf_compress_native(b"dut-probe") is not None
+        except Exception:
+            _compress_capable = False
+    return _compress_capable
+
+
+def deflate_flavor() -> str:
+    """The deflate codec a compress_fast call is EXPECTED to use right
+    now: "native" or "python". Joins the streaming checkpoint
+    fingerprint; per-shard truth is compress_fast_tagged's return."""
+    import os
+
+    if os.environ.get("DUT_NO_NATIVE"):
+        return "python"
+    return "native" if native_compress_capable() else "python"
 
 
 def is_bgzf(data: bytes) -> bool:

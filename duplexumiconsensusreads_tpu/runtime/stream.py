@@ -655,7 +655,7 @@ def _concat_records(a: BamRecords, b: BamRecords) -> BamRecords:
 
 # ------------------------------------------------------------ checkpoint
 
-def _verify_shard(entry) -> bool:
+def _verify_shard(entry, expect_codec: str | None = None) -> bool:
     """Trust a manifest entry only when the shard's bytes still match
     the size + CRC32 recorded at write time. Existence alone would let
     a torn shard (crash mid-write before the durable rename, or later
@@ -669,6 +669,16 @@ def _verify_shard(entry) -> bool:
         # pre-pipelined-drain manifest: record counts were derived from
         # the raw shard bytes at finalise, which BGZF-compressed shards
         # no longer expose — recompute rather than guess
+        return False
+    if expect_codec is not None and entry.get("codec") != expect_codec:
+        # the shard was deflated by a DIFFERENT codec than this run
+        # will use (e.g. the native library failed at runtime mid-run
+        # and compress_fast fell back to pure Python, under a
+        # fingerprint whose capability probe said native): reusing it
+        # would splice mixed-codec bytes — different, both-valid
+        # deflate streams — breaking resume-converges-to-identical-
+        # bytes. Recompute. Entries without a codec field (pre-codec
+        # manifests) recompute for the same reason.
         return False
     path = entry.get("path")
     try:
@@ -693,14 +703,18 @@ class Checkpoint:
     path: str
     fingerprint: str
     # chunk index (str) -> {"path", "size", "crc32", "n_records",
-    # "n_pairs"} — counts ride in the manifest because shards are
-    # stored BGZF-compressed and resumed chunks must still contribute
-    # to the report totals without a decompress pass
+    # "n_pairs", "codec"} — counts ride in the manifest because shards
+    # are stored BGZF-compressed and resumed chunks must still
+    # contribute to the report totals without a decompress pass; codec
+    # is the deflate flavor ACTUALLY used for the shard's bytes, so a
+    # runtime native->python fallback can never be spliced under a
+    # healthy-native resume
     done: dict
 
     @staticmethod
     def load_or_create(
-        path: str, fingerprint: str, verify: bool = True
+        path: str, fingerprint: str, verify: bool = True,
+        expect_codec: str | None = None,
     ) -> "Checkpoint":
         """Load the manifest, pruning entries that no longer apply.
 
@@ -741,7 +755,7 @@ class Checkpoint:
                     done = {
                         k: v
                         for k, v in on_disk.get("done", {}).items()
-                        if not verify or _verify_shard(v)
+                        if not verify or _verify_shard(v, expect_codec)
                     }
         ckpt = Checkpoint(path, fingerprint, done)
         if torn or (
@@ -763,11 +777,11 @@ class Checkpoint:
 
     def mark(
         self, chunk: int, shard_path: str, size: int, crc: int,
-        n_records: int, n_pairs: int,
+        n_records: int, n_pairs: int, codec: str,
     ) -> None:
         self.done[str(chunk)] = {
             "path": shard_path, "size": size, "crc32": crc,
-            "n_records": n_records, "n_pairs": n_pairs,
+            "n_records": n_records, "n_pairs": n_pairs, "codec": codec,
         }
         self.save()
 
@@ -813,8 +827,14 @@ def _fingerprint(
             # pure-Python BGZF deflate produce different (both valid)
             # bytes for the same records, and resumed shards are
             # appended verbatim — splicing across codecs would break
-            # the resume-converges-to-identical-bytes guarantee
-            "deflate:" + _iterator_flavor(),
+            # the resume-converges-to-identical-bytes guarantee.
+            # deflate_flavor PROBES the native compress entry point
+            # (not get_lib(): a library that loads but cannot compress
+            # must fingerprint as python); the residual risk — native
+            # failing at runtime AFTER a successful probe — is covered
+            # by the per-shard "codec" manifest field, which resume
+            # verification checks against this same flavor
+            "deflate:" + bgzf.deflate_flavor(),
         ],
         sort_keys=True,
     )
@@ -1024,8 +1044,14 @@ def _stream_call(
             per_base_tags=per_base_tags, read_group=read_group,
         )
         # resume=False discards `done` just below — skip the per-shard
-        # CRC re-read (it would read ~ the whole prior output for nothing)
-        ckpt = Checkpoint.load_or_create(checkpoint_path, fp, verify=resume)
+        # CRC re-read (it would read ~ the whole prior output for
+        # nothing). expect_codec prunes shards whose recorded deflate
+        # codec differs from this run's — a runtime native->python
+        # fallback shard must be recomputed, never spliced.
+        ckpt = Checkpoint.load_or_create(
+            checkpoint_path, fp, verify=resume,
+            expect_codec=bgzf.deflate_flavor(),
+        )
         if not resume:
             # persist a fresh manifest NOW, unconditionally: a stale
             # on-disk manifest (same OR different fingerprint) must not
@@ -1332,11 +1358,11 @@ def _stream_call(
         its own phase ("ckpt") since PR 3: on shared pod storage the
         per-chunk manifest fsync is a real cost that used to hide
         inside "finalise"."""
-        shard, size, crc, n_rec, n_pairs, data, marked = payload
+        shard, size, crc, n_rec, n_pairs, codec, data, marked = payload
         shards[k] = shard
         if ckpt and not marked:
             t0 = time.monotonic()
-            ckpt.mark(k, shard, size, crc, n_rec, n_pairs)
+            ckpt.mark(k, shard, size, crc, n_rec, n_pairs, codec)
             dt = time.monotonic() - t0
             phase["ckpt"] += dt
             if tr is not None:
@@ -1456,7 +1482,7 @@ def _stream_call(
                     tr.event("resume", chunk=k, decision="reused")
                 done_q[k] = (
                     e["path"], e["size"], e["crc32"],
-                    e["n_records"], e["n_pairs"], None, True,
+                    e["n_records"], e["n_pairs"], e["codec"], None, True,
                 )
                 n_skipped += 1
                 _advance_frontier()
@@ -1506,8 +1532,14 @@ def _stream_call(
                 setattr(rep, fk, getattr(rep, fk) + fv)
             rep.n_buckets += len(buckets)
             if not buckets:
+                # empty shard: zero bytes deflate identically under
+                # either codec; record the run's flavor so resume
+                # verification accepts it
                 spath, ssize, scrc = _write_shard(shard_dir, k, b"")
-                done_q[k] = (spath, ssize, scrc, 0, 0, b"", False)
+                done_q[k] = (
+                    spath, ssize, scrc, 0, 0, bgzf.deflate_flavor(),
+                    b"", False,
+                )
                 _advance_frontier()
                 continue
             entries = []
@@ -1730,7 +1762,10 @@ def _finish_chunk(
     built): the deflate cost lands on the drain worker instead of the
     finalise path, and the incremental finalise append becomes a plain
     byte copy (BGZF members concatenate). Returns (path, size, crc32,
-    n_records, n_pairs, shard_bytes) — the commit payload.
+    n_records, n_pairs, codec, shard_bytes) — the commit payload;
+    codec is the deflate flavor ACTUALLY used (compress_fast can fall
+    back to pure Python at runtime), persisted per shard in the
+    manifest so resume can refuse to splice across codecs.
 
     ``on_stage(stage, t0, dt)`` is the caller's accounting hook: the
     serialize+write segments report as "shard_write" and the BGZF
@@ -1767,11 +1802,11 @@ def _finish_chunk(
     if on_stage:
         on_stage("shard_write", t0, time.monotonic() - t0)
     t0 = time.monotonic()
-    comp = bgzf.compress_fast(raw, eof=False)
+    comp, codec = bgzf.compress_fast_tagged(raw, eof=False)
     if on_stage:
         on_stage("deflate", t0, time.monotonic() - t0)
     t0 = time.monotonic()
     path, size, crc = _write_shard(shard_dir, k, comp)
     if on_stage:
         on_stage("shard_write", t0, time.monotonic() - t0)
-    return path, size, crc, n_rec, n_pairs, comp
+    return path, size, crc, n_rec, n_pairs, codec, comp

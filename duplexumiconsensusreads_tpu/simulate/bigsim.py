@@ -40,7 +40,9 @@ def simulate_bam_file(
     chunk. Returns {"n_reads", "n_molecules", "seconds"}.
     """
     cfg = cfg or SimConfig()
-    t0 = time.time()
+    # monotonic, like every duration in the codebase: the "seconds"
+    # field is a delta, and an NTP step mid-simulation must not skew it
+    t0 = time.monotonic()
     stride = (cfg.n_positions + 1) * 1000  # chunk i owns one position range
     n_chunks = (n_molecules + chunk_molecules - 1) // chunk_molecules
     if stride * n_chunks >= 1 << 31:
@@ -77,7 +79,7 @@ def simulate_bam_file(
     return {
         "n_reads": n_reads,
         "n_molecules": n_molecules,
-        "seconds": round(time.time() - t0, 2),
+        "seconds": round(time.monotonic() - t0, 2),
         "bytes": os.path.getsize(path),
     }
 

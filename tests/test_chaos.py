@@ -176,6 +176,49 @@ def test_kill_at_phase_boundary_then_resume_converges(site, nth, sim, tmp_path):
     assert not os.path.exists(out + ".ckpt")  # auto-ckpt cleaned on success
 
 
+def test_resume_refuses_runtime_codec_fallback_shards(sim, tmp_path, monkeypatch):
+    """ROADMAP item (PR 3 review): native and pure-Python BGZF deflate
+    emit different (both valid) bytes, and ``compress_fast`` falls back
+    to Python SILENTLY when the native compress fails at runtime — so a
+    python-deflate shard could ride under a ``deflate:native``
+    fingerprint, and a later resume on a healthy-native host would
+    splice mixed-codec shards. The manifest now records the codec
+    actually used per shard; resume must prune and recompute those
+    shards, converging to the reference bytes."""
+    from duplexumiconsensusreads_tpu import native
+    from duplexumiconsensusreads_tpu.io import bgzf
+
+    path, ref_bytes = sim
+    out = str(tmp_path / "codec.bam")
+    # both runs fingerprint deflate:native (capability probe says yes),
+    # whatever this container actually has built
+    monkeypatch.setattr(bgzf, "native_compress_capable", lambda: True)
+    monkeypatch.delenv("DUT_NO_NATIVE", raising=False)
+
+    # run 1: the native compress entry point fails AT RUNTIME (after
+    # the successful probe) -> every shard silently falls back to the
+    # pure-Python codec; a kill at the chunk-1 mark leaves chunk 0
+    # durably marked with its real codec
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(native, "bgzf_compress_native", lambda *a, **k: None)
+        faults.install(faults.FaultPlan.parse("ckpt.save:3:kill"))
+        with pytest.raises(faults.InjectedKill):
+            stream_call_consensus(path, out, GP, CP, **KW)
+    faults.uninstall()
+    with open(out + ".ckpt") as f:
+        manifest = json.load(f)
+    assert manifest["done"], "kill must land after at least one mark"
+    assert {e["codec"] for e in manifest["done"].values()} == {"python"}
+
+    # run 2: healthy-native resume — the python-deflate shards fail the
+    # manifest codec check, are recomputed (never spliced), and the
+    # output is byte-identical to the fault-free reference
+    rep = stream_call_consensus(path, out, GP, CP, resume=True, **KW)
+    assert rep.n_chunks_skipped == 0
+    with open(out, "rb") as f:
+        assert f.read() == ref_bytes
+
+
 @pytest.mark.parametrize("damage", ["flip", "truncate"])
 def test_corrupted_shard_detected_and_recomputed(damage, sim, tmp_path):
     """Resume against a deliberately corrupted shard: the manifest

@@ -1,0 +1,598 @@
+"""dutlint: per-rule firing/passing fixtures, allowlist semantics, the
+CLI contract, and the tier-1 whole-tree gate.
+
+Each rule gets at least one snippet that FIRES and one that PASSES, so
+a rule can neither silently die (stops firing on its bad fixture) nor
+silently over-reach (starts firing on its good fixture). The whole-tree
+test is the actual CI gate: the shipped tree must lint clean modulo the
+reasoned allowlist, and the allowlist itself must carry no stale
+entries.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from duplexumiconsensusreads_tpu.analysis import Corpus, run_lint
+from duplexumiconsensusreads_tpu.analysis.allowlist import ALLOWLIST
+from duplexumiconsensusreads_tpu.analysis.cli import default_targets, repo_root
+from duplexumiconsensusreads_tpu.analysis.engine import RULES, AllowEntry
+
+REPO = repo_root()
+
+
+def lint(files: dict, rules=None, allow=()):
+    """Run the engine over in-memory snippet files."""
+    corpus = Corpus(root="<snippets>")
+    for path, src in files.items():
+        corpus.add(path, textwrap.dedent(src))
+    return run_lint(corpus, allow, only_rules=rules)
+
+
+def rules_of(result):
+    return [(f.rule, f.path) for f in result.findings]
+
+
+# ---------------------------------------------------------------- engine
+
+class TestEngine:
+    def test_registry_has_the_six_invariant_rules(self):
+        assert {
+            "clock-discipline", "durability-protocol", "fault-registry",
+            "phase-registry", "lock-discipline", "hook-guard",
+        } <= set(RULES)
+        for rule in RULES.values():
+            assert rule.title
+
+    def test_unparseable_file_is_itself_a_finding(self):
+        res = lint({"pkg/x.py": "def broken(:\n"}, rules=[])
+        assert [f.rule for f in res.findings] == ["parse"]
+        assert res.findings[0].line >= 1
+
+    def test_allowlist_suppresses_and_reports_usage(self):
+        files = {"pkg/runtime/t.py": "import time\nT = time.time()\n"}
+        entry = AllowEntry(
+            rule="clock-discipline", path="pkg/runtime/t.py",
+            reason="fixture: wall-clock wanted here",
+        )
+        res = lint(files, rules=["clock-discipline"], allow=[entry])
+        assert res.ok and len(res.suppressed) == 1
+        assert res.suppressed[0][1] is entry
+        assert res.unused_allowlist == []
+
+    def test_allowlist_entry_is_per_rule_not_blanket(self):
+        files = {"pkg/runtime/t.py": "import time\nT = time.time()\n"}
+        other = AllowEntry(
+            rule="durability-protocol", path="pkg/runtime/t.py",
+            reason="fixture: wrong rule",
+        )
+        res = lint(files, rules=["clock-discipline"], allow=[other])
+        assert not res.ok  # the entry's rule doesn't match: no suppression
+
+    def test_unused_allowlist_entries_are_reported(self):
+        entry = AllowEntry(
+            rule="clock-discipline", path="pkg/clean.py",
+            reason="fixture: nothing to suppress",
+        )
+        res = lint(
+            {"pkg/clean.py": "x = 1\n"}, rules=["clock-discipline"],
+            allow=[entry],
+        )
+        assert res.ok and res.unused_allowlist == [entry]
+
+    def test_allowlist_reason_is_mandatory(self):
+        with pytest.raises(ValueError, match="reason"):
+            AllowEntry(rule="clock-discipline", path="x.py", reason="  ")
+
+    def test_unknown_rule_id_raises_a_named_error(self):
+        with pytest.raises(ValueError, match="clock-discipline"):
+            lint({"pkg/a.py": "x = 1\n"}, rules=["clock"])
+
+
+# ----------------------------------------------------------------- rules
+
+class TestClockDiscipline:
+    def test_fires_on_time_time(self):
+        res = lint(
+            {"pkg/a.py": "import time\ndef f():\n    return time.time()\n"},
+            rules=["clock-discipline"],
+        )
+        assert rules_of(res) == [("clock-discipline", "pkg/a.py")]
+        assert res.findings[0].line == 3
+        assert "monotonic" in res.findings[0].hint
+
+    def test_fires_on_from_import_alias(self):
+        res = lint(
+            {"pkg/a.py": "from time import time as now\nT = now()\n"},
+            rules=["clock-discipline"],
+        )
+        assert len(res.findings) == 1
+
+    def test_passes_on_monotonic(self):
+        res = lint(
+            {"pkg/a.py": "import time\ndef f():\n"
+             "    return time.monotonic()\n"},
+            rules=["clock-discipline"],
+        )
+        assert res.ok
+
+
+class TestDurabilityProtocol:
+    BAD = {
+        "pkg/io/w.py": """
+            def save(path, payload):
+                with open(path, "wb") as f:
+                    f.write(payload)
+            """,
+    }
+
+    def test_fires_on_bare_write_open_in_io(self):
+        res = lint(self.BAD, rules=["durability-protocol"])
+        assert rules_of(res) == [("durability-protocol", "pkg/io/w.py")]
+        assert "write_durable" in res.findings[0].hint
+
+    def test_passes_when_protocol_is_used_in_scope(self):
+        res = lint(
+            {"pkg/io/w.py": """
+                from pkg.io.durable import fsync_file, replace_durable
+                def save(path, payload):
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(payload)
+                        fsync_file(f)
+                    replace_durable(tmp, path)
+                """},
+            rules=["durability-protocol"],
+        )
+        assert res.ok
+
+    def test_passes_outside_io_runtime_and_on_reads(self):
+        res = lint(
+            {
+                "pkg/telemetry/t.py": 'f = open("cap.jsonl", "w")\n',
+                "pkg/io/r.py": 'def load(p):\n    return open(p, "rb").read()\n',
+            },
+            rules=["durability-protocol"],
+        )
+        assert res.ok
+
+    def test_mode_keyword_is_seen(self):
+        res = lint(
+            {"pkg/runtime/w.py":
+             'def f(p):\n    open(p, mode="w").write("x")\n'},
+            rules=["durability-protocol"],
+        )
+        assert len(res.findings) == 1
+
+    def test_update_mode_counts_as_a_write(self):
+        res = lint(
+            {"pkg/runtime/w.py":
+             'def f(p):\n    open(p, "r+b").write(b"patch")\n'},
+            rules=["durability-protocol"],
+        )
+        assert len(res.findings) == 1
+
+
+FAULTS_OK = """
+    KNOWN_SITES = ("ingest.read", "shard.write")
+    """
+STREAM_USES_BOTH = """
+    def go(f):
+        _io_retry("ingest.read", f, "read")
+        fault_point("shard.write")
+    """
+CHAOS_COVERS_BOTH = """
+    def test_a():
+        run("ingest.read:1:oserror")
+    def test_b():
+        run("shard.write:1:kill")
+    """
+
+
+class TestFaultRegistry:
+    def test_passes_when_all_three_agree(self):
+        res = lint(
+            {
+                "pkg/runtime/faults.py": FAULTS_OK,
+                "pkg/runtime/stream.py": STREAM_USES_BOTH,
+                "tests/test_chaos.py": CHAOS_COVERS_BOTH,
+            },
+            rules=["fault-registry"],
+        )
+        assert res.ok
+
+    def test_fires_on_unregistered_site(self):
+        res = lint(
+            {
+                "pkg/runtime/faults.py": FAULTS_OK,
+                "pkg/runtime/stream.py": STREAM_USES_BOTH
+                + '    fault_point("typo.site")\n',
+                "tests/test_chaos.py": CHAOS_COVERS_BOTH,
+            },
+            rules=["fault-registry"],
+        )
+        assert [f.message for f in res.findings] == [
+            "fault site 'typo.site' is not registered in faults.KNOWN_SITES"
+        ]
+        assert res.findings[0].path == "pkg/runtime/stream.py"
+
+    def test_fires_on_dead_registry_entry(self):
+        res = lint(
+            {
+                "pkg/runtime/faults.py":
+                    'KNOWN_SITES = ("ingest.read", "shard.write", "dead.site")\n',
+                "pkg/runtime/stream.py": STREAM_USES_BOTH,
+                "tests/test_chaos.py": CHAOS_COVERS_BOTH,
+            },
+            rules=["fault-registry"],
+        )
+        msgs = [f.message for f in res.findings]
+        assert any("dead.site" in m and "no fault_point" in m for m in msgs)
+        # and the uncovered site also surfaces on the chaos side
+        assert any("dead.site" in m and "chaos" in m for m in msgs)
+
+    def test_docstring_mentions_do_not_count_as_chaos_coverage(self):
+        res = lint(
+            {
+                "pkg/runtime/faults.py": FAULTS_OK,
+                "pkg/runtime/stream.py": STREAM_USES_BOTH,
+                "tests/test_chaos.py": '''
+                    def test_a():
+                        """This docstring talks about shard.write:1:kill
+                        but exercises nothing."""
+                        run("ingest.read:1:oserror")
+                    ''',
+            },
+            rules=["fault-registry"],
+        )
+        assert len(res.findings) == 1
+        assert "shard.write" in res.findings[0].message
+
+    def test_assigned_schedule_tables_count_as_coverage(self):
+        res = lint(
+            {
+                "pkg/runtime/faults.py": FAULTS_OK,
+                "pkg/runtime/stream.py": STREAM_USES_BOTH,
+                "tests/test_chaos.py": """
+                    KILLS = [("ingest.read", 1), ("shard.write", 2)]
+                    def test_each():
+                        for site, nth in KILLS:
+                            run(site, nth)
+                    """,
+            },
+            rules=["fault-registry"],
+        )
+        assert res.ok
+
+    def test_missing_chaos_anchor_skips_coverage_check(self):
+        res = lint(
+            {
+                "pkg/runtime/faults.py": FAULTS_OK,
+                "pkg/runtime/stream.py": STREAM_USES_BOTH,
+            },
+            rules=["fault-registry"],
+        )
+        assert res.ok  # registration checks ran; coverage skipped
+
+    def test_fires_on_chaos_gap_and_respects_blanket_parametrize(self):
+        gap = lint(
+            {
+                "pkg/runtime/faults.py": FAULTS_OK,
+                "pkg/runtime/stream.py": STREAM_USES_BOTH,
+                "tests/test_chaos.py": """
+                    def test_a():
+                        run("ingest.read:1:oserror")
+                    """,
+            },
+            rules=["fault-registry"],
+        )
+        assert [f.rule for f in gap.findings] == ["fault-registry"]
+        assert "shard.write" in gap.findings[0].message
+        blanket = lint(
+            {
+                "pkg/runtime/faults.py": FAULTS_OK,
+                "pkg/runtime/stream.py": STREAM_USES_BOTH,
+                "tests/test_chaos.py": """
+                    import pytest
+                    from pkg.runtime import faults
+                    @pytest.mark.parametrize("site", faults.KNOWN_SITES)
+                    def test_each(site):
+                        run(site)
+                    """,
+            },
+            rules=["fault-registry"],
+        )
+        assert blanket.ok
+
+
+TRACE_OK = """
+    KNOWN_STAGES = ("ingest", "finalise")
+    KNOWN_EVENTS = ("retry",)
+    """
+EXEC_OK = 'DRAIN_PHASES = ("finalise",)\n'
+STREAM_OK = """
+    def run(tr):
+        phase = {"ingest": 0.0, "finalise": 0.0}
+        if tr is not None:
+            tr.span("ingest", 0.0, 1.0)
+    """
+GOLDEN_OK = """
+    def test_streaming_seconds_keys_golden():
+        assert set(rep) == {"ingest", "finalise", "drain_utilization",
+                            "total"}
+    """
+
+
+class TestPhaseRegistry:
+    def base(self, **over):
+        files = {
+            "pkg/telemetry/trace.py": TRACE_OK,
+            "pkg/runtime/executor.py": EXEC_OK,
+            "pkg/runtime/stream.py": STREAM_OK,
+            "tests/test_telemetry.py": GOLDEN_OK,
+        }
+        files.update(over)
+        return lint(files, rules=["phase-registry"])
+
+    def test_passes_when_consistent(self):
+        assert self.base().ok
+
+    def test_fires_on_phase_key_not_in_stages(self):
+        res = self.base(**{"pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0, "mystery": 0.0}
+            """})
+        assert any("mystery" in f.message for f in res.findings)
+
+    def test_fires_on_stage_missing_from_phase_dict(self):
+        res = self.base(**{"pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0}
+            """})
+        assert any(
+            "'finalise' missing from the phase" in f.message
+            for f in res.findings
+        )
+
+    def test_fires_on_unknown_span_stage_and_event(self):
+        res = self.base(**{"pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.span("warp", 0.0, 1.0)
+                    tr.event("uncatalogued")
+            """})
+        msgs = " | ".join(f.message for f in res.findings)
+        assert "warp" in msgs and "uncatalogued" in msgs
+
+    def test_fires_on_drain_phase_outside_stages(self):
+        res = self.base(**{
+            "pkg/runtime/executor.py": 'DRAIN_PHASES = ("deflate",)\n'
+        })
+        assert any("deflate" in f.message for f in res.findings)
+
+    def test_fires_on_golden_drift_both_ways(self):
+        res = self.base(**{"tests/test_telemetry.py": """
+            def test_streaming_seconds_keys_golden():
+                assert set(rep) == {"ingest", "drain_utilization", "total",
+                                    "bonus"}
+            """})
+        msgs = " | ".join(f.message for f in res.findings)
+        assert "bonus" in msgs  # extra key
+        assert "finalise" in msgs  # missing stage
+
+
+class TestLockDiscipline:
+    def test_fires_on_blocking_io_under_lock(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                import threading
+                def commit(phase_lock, fut, path):
+                    with phase_lock:
+                        data = fut.result()
+                        f = open(path, "wb")
+                """},
+            rules=["lock-discipline"],
+        )
+        names = sorted(f.message for f in res.findings)
+        assert len(names) == 2
+        assert "open()" in names[0] and "result()" in names[1]
+
+    def test_fires_on_compress_under_self_lock(self):
+        res = lint(
+            {"pkg/telemetry/trace.py": """
+                class R:
+                    def flush(self, z, data):
+                        with self._lock:
+                            return z.compress(data)
+                """},
+            rules=["lock-discipline"],
+        )
+        assert len(res.findings) == 1
+
+    def test_passes_when_io_is_outside_the_lock(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def commit(phase_lock, fut, phase):
+                    data = fut.result()
+                    with phase_lock:
+                        phase["finalise"] = 1.0
+                """},
+            rules=["lock-discipline"],
+        )
+        assert res.ok
+
+    def test_fires_on_module_mutable_mutated_without_lock(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                _pending = []
+                def add(x):
+                    _pending.append(x)
+                """},
+            rules=["lock-discipline"],
+        )
+        assert rules_of(res) == [("lock-discipline", "pkg/runtime/stream.py")]
+        assert "_pending" in res.findings[0].message
+
+    def test_passes_on_module_mutable_under_lock_or_at_import(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                import threading
+                _pending = []
+                _pending.append("init-time is single-threaded")
+                _lock = threading.Lock()
+                def add(x):
+                    with _lock:
+                        _pending.append(x)
+                """},
+            rules=["lock-discipline"],
+        )
+        assert res.ok
+
+    def test_out_of_scope_files_are_ignored(self):
+        res = lint(
+            {"pkg/io/convert.py": """
+                def f(lock, p):
+                    with lock:
+                        open(p, "wb")
+                """},
+            rules=["lock-discipline"],
+        )
+        assert res.ok  # rule scope is stream.py + trace.py only
+
+
+class TestHookGuard:
+    def test_fires_on_unguarded_hook(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def run(tr):
+                    tr.span("ingest", 0.0, 1.0)
+                """},
+            rules=["hook-guard"],
+        )
+        assert rules_of(res) == [("hook-guard", "pkg/runtime/stream.py")]
+        assert "tr is not None" in res.findings[0].hint
+
+    def test_passes_on_guarded_hooks(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def run(tr, resume):
+                    if tr is not None:
+                        tr.span("ingest", 0.0, 1.0)
+                    if tr is not None and resume:
+                        tr.event("resume")
+                    if tr is None:
+                        pass
+                    else:
+                        tr.event("retry")
+                """},
+            rules=["hook-guard"],
+        )
+        assert res.ok
+
+    def test_bare_self_receivers_are_exempt(self):
+        res = lint(
+            {"pkg/telemetry/trace.py": """
+                class Heartbeat:
+                    def beat(self):
+                        self.event("heartbeat")
+                """},
+            rules=["hook-guard"],
+        )
+        assert res.ok
+
+    def test_dotted_receivers_are_checked_not_exempt(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def run(ctx):
+                    ctx.tr.span("ingest", 0.0, 1.0)
+                """},
+            rules=["hook-guard"],
+        )
+        assert rules_of(res) == [("hook-guard", "pkg/runtime/stream.py")]
+        assert "ctx.tr.span" in res.findings[0].message
+
+    def test_dotted_receiver_guard_matches_the_same_path(self):
+        res = lint(
+            {"pkg/telemetry/trace.py": """
+                class Heartbeat:
+                    def beat(self):
+                        if self._recorder is not None:
+                            self._recorder.event("heartbeat")
+                """},
+            rules=["hook-guard"],
+        )
+        assert res.ok
+
+
+# ------------------------------------------------------------------- CLI
+
+class TestCli:
+    def test_shipped_tree_is_clean_via_cli(self):
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+             "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0, p.stdout + p.stderr
+        rep = json.loads(p.stdout)
+        assert rep["ok"] and rep["findings"] == []
+        assert rep["n_files"] > 50
+
+    def test_cli_exit_1_names_rule_and_location(self, tmp_path):
+        bad = tmp_path / "pkg" / "runtime" / "hot.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("import time\ndef f():\n    return time.time()\n")
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+             "--root", str(tmp_path), "pkg/runtime/hot.py"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 1
+        assert "pkg/runtime/hot.py:3: [clock-discipline]" in p.stdout
+
+    def test_list_rules(self):
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+             "--list-rules"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0
+        for rid in RULES:
+            assert rid in p.stdout
+
+
+# ------------------------------------------------------------ tier-1 gate
+
+class TestShippedTree:
+    """The actual CI gate: the engine in-process over the default file
+    set (package + tools/ + test anchors)."""
+
+    def test_tree_lints_clean_modulo_allowlist(self):
+        from duplexumiconsensusreads_tpu.analysis.engine import load_corpus
+
+        corpus = load_corpus(REPO, default_targets(REPO))
+        res = run_lint(corpus, ALLOWLIST)
+        assert res.ok, "\n".join(f.format() for f in res.findings)
+        # the allowlist cannot rot: every entry must still suppress
+        # something, or this gate forces it to be pruned
+        assert res.unused_allowlist == [], [
+            (a.rule, a.path) for a in res.unused_allowlist
+        ]
+
+    def test_linted_set_covers_the_contract_files(self):
+        targets = set(default_targets(REPO))
+        for must in (
+            "tools/dutlint.py", "tools/check_trace.py",
+            "tools/trace_report.py", "tests/test_chaos.py",
+            "tests/test_telemetry.py",
+            os.path.join("duplexumiconsensusreads_tpu", "runtime",
+                         "stream.py"),
+        ):
+            assert must.replace("/", os.sep) in {
+                t.replace("/", os.sep) for t in targets
+            }, must

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Invariant linter runner — see duplexumiconsensusreads_tpu/analysis/.
+
+    python tools/dutlint.py              # lint package + tools + anchors
+    python tools/dutlint.py --list-rules
+    python tools/dutlint.py --rule fault-registry -v
+    python tools/dutlint.py --json       # machine-readable (CI)
+
+Exit 1 on any non-allowlisted finding. Sibling of tools/check_trace.py
+(runtime capture validation) — this one validates the SOURCE against
+the same contracts, at PR time instead of run time.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from duplexumiconsensusreads_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
