@@ -92,20 +92,23 @@ def _open_write_mode(node: ast.Call) -> bool:
 
 @register(
     "durability-protocol",
-    "persistent writes in io//runtime/ must use the tmp+fsync+rename protocol",
+    "persistent writes in io//runtime//serve/ must use the "
+    "tmp+fsync+rename protocol",
 )
 def check_durability(corpus: Corpus) -> Iterator[Finding]:
     """A file a later run trusts by existence (shards, manifests, the
-    finalised BAM, indexes) written with a bare ``open(.., "w")`` can
-    survive a crash looking complete while holding torn bytes — the
-    exact failure mode io/durable.py exists for. In ``io/`` and
-    ``runtime/``, every write-mode open must sit in a function that
-    routes through the protocol (write_durable / replace_durable /
+    finalised BAM, indexes, the service's queue journal and spooled
+    jobs) written with a bare ``open(.., "w")`` can survive a crash
+    looking complete while holding torn bytes — the exact failure mode
+    io/durable.py exists for. In ``io/``, ``runtime/`` and ``serve/``
+    (whose entire crash-recovery story rests on the journal being
+    durable), every write-mode open must sit in a function that routes
+    through the protocol (write_durable / replace_durable /
     rewrite_from); anything else is a finding (intentional diagnostics
     writers are allowlisted, with reasons)."""
     for path, tree in corpus.trees.items():
         parts = path.split("/")
-        if not any(seg in ("io", "runtime") for seg in parts[:-1]):
+        if not any(seg in ("io", "runtime", "serve") for seg in parts[:-1]):
             continue
         if path.endswith("io/durable.py"):
             continue  # the protocol implementation itself

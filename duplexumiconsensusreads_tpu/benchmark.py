@@ -27,6 +27,9 @@ DUT_BENCH_E2E_AB (A/B leg size, default 2000000; 0 disables),
 DUT_BENCH_AB_BUDGET_S (A/B wall budget the legs shrink to fit, 480),
 DUT_BENCH_WIRE_MB (wire probe payload, 32), DUT_BENCH_CPU_E2E_REPS (2),
 DUT_BENCH_VEC_REPS (3), DUT_BENCH_CACHE (default .bench_cache),
+DUT_BENCH_SERVE_JOBS (serve_n_jobs leg: jobs through the in-process
+daemon vs a cold one-shot subprocess, default 3; 0 disables),
+DUT_BENCH_SERVE_READS (reads per serve job, default 120000),
 DUT_BENCH_TRACE (1: every e2e leg records a span capture next to the
 cache and the JSON carries per-chunk latency percentiles; 0 disables).
 """
@@ -346,6 +349,110 @@ def run_per_config(mesh) -> dict:
             "capacity": capacity,
             "step_s": round(dt, 4),
         }
+    return out
+
+
+def run_serve_bench(n_jobs: int) -> dict:
+    """The ``serve_n_jobs`` leg: N identical small jobs through an
+    in-process daemon vs the same job ONE-SHOT in a fresh process.
+
+    The one-shot subprocess deliberately gets a throwaway compile-cache
+    dir: it pays the full per-process XLA compile + device warm-up toll
+    (~11.6s on the r05 capture) that every ``call`` invocation pays
+    without the service. The daemon jobs run on the warm process, so
+    per-job wall vs one-shot wall IS the compile amortisation, measured
+    — emitted into the BENCH JSON as serve_* keys. Per-job walls come
+    from the service capture's job_completed events (completion order).
+    """
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    from duplexumiconsensusreads_tpu.serve import ConsensusService, client
+    from duplexumiconsensusreads_tpu.telemetry import report as trace_report
+
+    cache = os.environ.get("DUT_BENCH_CACHE", ".bench_cache")
+    n_reads = int(os.environ.get("DUT_BENCH_SERVE_READS", 120_000))
+    in_path, _ = _e2e_input(n_reads)
+    config = dict(
+        grouping="adjacency", mode="duplex", error_model="cycle",
+        capacity=int(os.environ.get("DUT_BENCH_CAPACITY", 2048)),
+        chunk_reads=max(n_reads // 4, 10_000),
+    )
+    out_cold = os.path.join(cache, "serve_cold.bam")
+    spec_json = json.dumps({
+        "job_id": "job-bench-cold", "input": os.path.abspath(in_path),
+        "output": os.path.abspath(out_cold), "config": config,
+    })
+    child = f"""
+import json, tempfile, time
+from duplexumiconsensusreads_tpu.utils.compile_cache import enable_compile_cache
+enable_compile_cache(tempfile.mkdtemp(prefix="serve_cold_xla"), per_host_cpu=True)
+from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+from duplexumiconsensusreads_tpu.serve.job import (
+    job_params, serve_provenance, validate_spec,
+)
+spec = validate_spec(json.loads({spec_json!r}))
+gp, cp, kw = job_params(spec)
+t0 = time.monotonic()
+rep = stream_call_consensus(
+    spec.input, spec.output, gp, cp,
+    provenance_cl=serve_provenance(spec.config), **kw,
+)
+print(json.dumps({{"wall": time.monotonic() - t0, "reads": rep.n_records}}))
+"""
+    env = dict(os.environ)
+    env.pop("DUT_COMPILE_CACHE", None)  # the cold leg must really be cold
+    out: dict = {"serve_n_jobs": n_jobs, "serve_reads_per_job": n_reads}
+    proc = subprocess.run(
+        [_sys.executable, "-c", child], capture_output=True, text=True, env=env,
+    )
+    try:
+        os.remove(out_cold)
+    except OSError:
+        pass
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-2000:])
+        return {**out, "serve_error": f"cold one-shot exit {proc.returncode}"}
+    cold = json.loads(proc.stdout.strip().splitlines()[-1])
+    out["serve_oneshot_cold_wall_s"] = round(cold["wall"], 2)
+
+    spool = os.path.join(cache, "serve_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    trace_path = os.path.join(cache, "serve_trace.jsonl")
+    outs = [os.path.join(cache, f"serve_out{i}.bam") for i in range(n_jobs)]
+    for o in outs:
+        client.submit(spool, in_path, o, config=config)
+    t0 = time.monotonic()
+    snap = ConsensusService(
+        spool, chunk_budget=0, trace_path=trace_path
+    ).run_until_idle()
+    serve_wall = time.monotonic() - t0
+    for o in outs:
+        try:
+            os.remove(o)
+        except OSError:
+            pass
+    if snap["jobs_done"] != n_jobs:
+        return {**out, "serve_error": f"daemon finished {snap['jobs_done']}/"
+                f"{n_jobs} jobs"}
+    records = trace_report.load_trace(trace_path)
+    walls = [
+        float(r["wall_s"]) for r in records
+        if r.get("type") == "event" and r.get("name") == "job_completed"
+    ]
+    out.update({
+        "serve_wall_s": round(serve_wall, 2),
+        "serve_job_walls_s": [round(w, 2) for w in walls],
+        "serve_compile_hit_rate": snap["compile_hit_rate"],
+        # the headline: what one job costs a cold process vs the warm
+        # daemon — the measured value of keeping the device/compiles hot
+        "serve_amortised_speedup": round(
+            cold["wall"] / max(min(walls), 1e-9), 2
+        ) if walls else 0.0,
+        "serve_trace": trace_path,
+    })
     return out
 
 
@@ -720,6 +827,13 @@ def main() -> None:
                 / unpacked["e2e_unpacked_reads_per_sec"],
                 3,
             )
+        # serve_n_jobs: small jobs through the in-process daemon vs a
+        # cold one-shot subprocess — the serving layer's compile
+        # amortisation, measured (DUT_BENCH_SERVE_JOBS=0 disables).
+        # Runs before the CPU denominator: it uses the device.
+        n_serve = int(os.environ.get("DUT_BENCH_SERVE_JOBS", 3))
+        if n_serve > 0:
+            result.update(run_serve_bench(n_serve))
         # same pipeline end-to-end on XLA-CPU: the wall-clock >=50x
         # denominator (DUT_BENCH_CPU_E2E_READS=0 disables); runs after
         # every TPU leg so the 1-core box is never shared

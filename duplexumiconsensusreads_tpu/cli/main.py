@@ -40,8 +40,49 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     c = sub.add_parser("call", help="group UMIs and call consensus reads")
-    c.add_argument("input", help="input BAM (or ReadBatch .npz)")
-    c.add_argument("-o", "--output", required=True, help="output consensus BAM")
+    c.add_argument(
+        "input", nargs="?", default=None,
+        help="input BAM (or ReadBatch .npz); optional only with "
+        "--status/--wait",
+    )
+    c.add_argument(
+        "-o", "--output", default=None,
+        help="output consensus BAM (required except with --status/--wait)",
+    )
+    # ---- serving-layer client verbs (serve/client.py): one spool
+    # directory is the whole protocol — no daemon handshake to lose
+    c.add_argument(
+        "--submit", action="store_true",
+        help="do not run: durably spool this call as a job for a "
+        "dut-serve daemon on --spool (prints the job id on stdout). "
+        "Streaming params only — the service preempts and resumes jobs "
+        "at chunk boundaries",
+    )
+    c.add_argument(
+        "--spool", default=None, metavar="DIR",
+        help="service spool directory for --submit/--status/--wait "
+        "(default: $DUT_SPOOL)",
+    )
+    c.add_argument(
+        "--priority", type=int, default=1,
+        help="--submit priority class (lower = more urgent; FIFO "
+        "within a class; default 1)",
+    )
+    c.add_argument(
+        "--status", default=None, metavar="JOB_ID",
+        help="print a submitted job's state as JSON and exit "
+        "(exit 1 for failed/rejected/unknown)",
+    )
+    c.add_argument(
+        "--wait", default=None, metavar="JOB_ID",
+        help="poll until the job reaches a terminal state, then print "
+        "its status JSON (see --wait-timeout)",
+    )
+    c.add_argument(
+        "--wait-timeout", type=float, default=0.0, metavar="SECONDS",
+        help="--wait gives up after this long (0 = wait forever); the "
+        "last status is printed with timed_out=true",
+    )
     c.add_argument("--config", choices=sorted(CONFIG_PRESETS), help="benchmark preset")
     c.add_argument(
         "--config-file",
@@ -480,15 +521,52 @@ def _load_whitelist_or_exit(path: str):
         raise SystemExit(f"--umi-whitelist: {e}")
 
 
+def _spool_or_exit(args) -> str:
+    import os as _os
+
+    spool = args.spool or _os.environ.get("DUT_SPOOL")
+    if not spool:
+        raise SystemExit(
+            "--submit/--status/--wait need a service spool directory: "
+            "pass --spool DIR or set DUT_SPOOL"
+        )
+    return spool
+
+
 def _cmd_call(args) -> int:
+    # ---- client verbs against a dut-serve spool: no input is read and
+    # no device is touched, so these resolve before anything else
+    if args.status is not None or args.wait is not None:
+        if args.status is not None and args.wait is not None:
+            raise SystemExit("--status and --wait are mutually exclusive")
+        from duplexumiconsensusreads_tpu.serve import client
+
+        spool = _spool_or_exit(args)
+        if args.status is not None:
+            st = client.status(spool, args.status)
+        else:
+            st = client.wait(
+                spool, args.wait, timeout_s=args.wait_timeout
+            )
+        print(json.dumps(st, sort_keys=True))
+        bad = st.get("state") in ("failed", "rejected", "unknown")
+        return 1 if bad or st.get("timed_out") else 0
+    if args.input is None or args.output is None:
+        raise SystemExit("call needs INPUT and -o OUTPUT (unless --status/--wait)")
+
     from duplexumiconsensusreads_tpu.runtime.executor import call_consensus_file
     from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
     from duplexumiconsensusreads_tpu.utils.compile_cache import enable_compile_cache
 
     # per_host_cpu: stale XLA:CPU AOT artifacts from another host can
     # SIGILL (see utils/compile_cache.py) - JAX_PLATFORMS=cpu runs are
-    # first-class here, so the cache keys on the host CPU
-    enable_compile_cache(per_host_cpu=True)
+    # first-class here, so the cache keys on the host CPU. A --submit
+    # never touches the device (the daemon runs the job), so it skips
+    # the compile-cache setup and the executor-stack import (the
+    # serve client path stays off runtime/stream + ops; the jax module
+    # itself still loads with the package root).
+    if not args.submit:
+        enable_compile_cache(per_host_cpu=True)
 
     fileconf = _load_config_file(args.config_file) if args.config_file else {}
     preset = dict(
@@ -581,6 +659,92 @@ def _cmd_call(args) -> int:
         )
     if capacity < 1:
         raise SystemExit(f"--capacity must be >= 1 (got {capacity})")
+    if args.submit:
+        # spool the resolved call as a service job instead of running it
+        if args.n_hosts > 0:
+            raise SystemExit(
+                "--submit jobs are single-host (each host runs its own "
+                "daemon over its own partition); drop --n-hosts"
+            )
+        if ref_projected or wl_path:
+            raise SystemExit(
+                "--submit jobs run on the streaming executor; "
+                "--ref-projected/--umi-whitelist are whole-file only"
+            )
+        if backend != "tpu":
+            raise SystemExit("--submit jobs stream on --backend=tpu")
+        if args.chunk_reads is not None and args.chunk_reads <= 0:
+            raise SystemExit(
+                "--submit jobs stream: --chunk-reads must be >= 1"
+            )
+        if args.priority < 0:
+            raise SystemExit(f"--priority must be >= 0 (got {args.priority})")
+        if args.checkpoint or args.resume or args.report or args.profile:
+            # the daemon owns checkpointing/resume (preemption + crash
+            # recovery) and the result report (spool results/): these
+            # flags would be silently dropped — refuse instead
+            raise SystemExit(
+                "--submit: --checkpoint/--resume/--report/--profile are "
+                "owned by the service (results land in the spool's "
+                "results/ dir; jobs always checkpoint and resume)"
+            )
+        if cycle_shards != 1 or devices is not None or args.heartbeat:
+            # same rule for the device/liveness knobs the job spec does
+            # not carry: device topology belongs to `dut-serve
+            # --devices` and liveness to `dut-serve --heartbeat` — a
+            # submitted value would be silently ignored, so refuse
+            raise SystemExit(
+                "--submit: --cycle-shards/--devices/--heartbeat are "
+                "daemon-side settings (see dut-serve --devices/"
+                "--heartbeat); jobs cannot carry them"
+            )
+        from duplexumiconsensusreads_tpu.serve import client
+
+        spool = _spool_or_exit(args)
+        config = {
+            "grouping": grouping,
+            "mode": mode,
+            "error_model": error_model,
+            "max_hamming": opt("max_hamming", 1),
+            "count_ratio": opt("count_ratio", 2),
+            "min_reads": opt("min_reads", 1),
+            "min_duplex_reads": opt("min_duplex_reads", 1),
+            "max_qual": opt("max_qual", 90),
+            "max_input_qual": opt("max_input_qual", 50),
+            "min_input_qual": opt("min_input_qual", 0),
+            "capacity": capacity,
+            # unset/0 chunking takes the service default: a job MUST
+            # stream (preemption + crash recovery are chunk-boundary
+            # contracts)
+            "chunk_reads": chunk_reads if chunk_reads > 0 else 500_000,
+            "max_inflight": max_inflight,
+            "drain_workers": drain_workers,
+            "mate_aware": mate_aware,
+            "max_reads": max_reads,
+            "per_base_tags": per_base_tags,
+            "read_group_id": read_group,
+            "write_index": write_index,
+        }
+        try:
+            job_id = client.submit(
+                spool,
+                args.input,
+                args.output,
+                config=config,
+                priority=args.priority,
+                chaos=args.chaos,
+                trace=args.trace,
+            )
+        except (ValueError, OSError) as e:
+            raise SystemExit(f"--submit: {e}")
+        print(job_id)  # stdout: the parseable handle for --status/--wait
+        print(
+            f"[duplexumi] job {job_id} spooled to {spool} (priority "
+            f"{args.priority}); follow with `duplexumi call --wait "
+            f"{job_id} --spool {spool}`",
+            file=sys.stderr,
+        )
+        return 0
     if args.trace and chunk_reads <= 0:
         # only the streaming executor is span-instrumented; on the
         # whole-file path the flag would silently record nothing

@@ -57,6 +57,11 @@ KNOWN_SITES = (
     "shard.write",  # per-chunk shard serialize+deflate+durable rename
     "ckpt.save",  # checkpoint manifest persist
     "finalise.write",  # incremental finalise appends + terminal EOF/rename
+    # serving layer (serve/): the admission/journal/preempt spine of the
+    # multi-job service — same bounded-retry ladder, same chaos coverage
+    "serve.accept",  # reading + validating a spooled job submission
+    "serve.journal",  # durable admission-queue journal persist
+    "serve.preempt",  # journaling a chunk-boundary preemption/requeue
 )
 
 _EXC_ERRNO = {

@@ -889,6 +889,11 @@ def stream_call_consensus(
     heartbeat_s: float = 0.0,  # >0: periodic liveness line to stderr
     # (chunks done/inflight, stall fraction, retries, drain util)
     trace_max_events: int = 1_000_000,  # bounded-capture cap
+    provenance_cl: str | None = None,  # @PG CL override for the output
+    # header. None = this process's argv (the one-shot convention); the
+    # serving layer passes a canonical config-derived line so a job's
+    # bytes are a pure function of (input, config), not of which daemon
+    # process happened to finish it
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -935,6 +940,7 @@ def stream_call_consensus(
             per_base_tags=per_base_tags, read_group=read_group,
             write_index=write_index, packed=packed,
             tr=tr, heartbeat_s=heartbeat_s, hb_box=hb_box,
+            provenance_cl=provenance_cl,
         )
     finally:
         for hb in hb_box:
@@ -973,6 +979,7 @@ def _stream_call(
     tr: TraceRecorder | None = None,
     heartbeat_s: float = 0.0,
     hb_box: list | None = None,
+    provenance_cl: str | None = None,
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -1330,7 +1337,8 @@ def _stream_call(
         # concatenation is coordinate-sorted end to end — say so,
         # chain @PG, add the @RG
         hdr = derive_output_header(
-            header_out, sort_order="coordinate", rg_id=read_group
+            header_out, sort_order="coordinate", rg_id=read_group,
+            cl=provenance_cl,
         )
         shell_c = bgzf.compress_fast(
             serialize_bam(hdr, _empty_records()), eof=False

@@ -1,0 +1,47 @@
+"""serve/ — the multi-job consensus service.
+
+One warm process multiplexes many consensus jobs onto one device:
+
+  client (``call --submit`` / serve.client) durably spools a job file
+  into ``<spool>/inbox/`` → the daemon (``dut-serve`` / serve.service)
+  ADMITS it into a bounded, durably-journaled queue (serve.queue;
+  io.durable tmp+fsync+rename, so a killed daemon loses no accepted
+  job) → a FAIR SCHEDULER (serve.scheduler: FIFO within priority
+  class, per-job chunk budget) hands it to a WARM WORKER (serve.worker)
+  that runs it as a ``stream_call_consensus`` slice, reusing the
+  process's already-compiled kernels — the ~once-per-bucket-spec XLA
+  compile is paid once for the daemon's lifetime instead of once per
+  job.
+
+Preemption is free by construction: a job yields the device only at a
+chunk boundary, where the streaming executor's checkpoint/resume
+contract (PR 1) already guarantees a later slice converges to the
+byte-identical output. SIGTERM triggers graceful drain: finish the
+in-flight chunk, checkpoint, journal the queue, exit 0; a restarted
+daemon resumes both the queue and the interrupted job.
+
+Attribute access is lazy (PEP 562): the CLIENT side
+(``serve.client``/``serve.queue``, behind ``call --submit/--status/
+--wait``) must stay importable without dragging in the executor stack
+— and through it jax — on every submit or status poll; only the
+daemon-side classes (``ConsensusService``) pay that import.
+"""
+
+_LAZY = {
+    "ConsensusService": "duplexumiconsensusreads_tpu.serve.service",
+    "FairScheduler": "duplexumiconsensusreads_tpu.serve.scheduler",
+    "JobSpec": "duplexumiconsensusreads_tpu.serve.job",
+    "SpoolQueue": "duplexumiconsensusreads_tpu.serve.queue",
+    "job_params": "duplexumiconsensusreads_tpu.serve.job",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

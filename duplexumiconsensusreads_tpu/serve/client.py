@@ -1,0 +1,83 @@
+"""Client side of the service: submit / status / wait.
+
+Submission is one durable file write into the spool inbox — no RPC, no
+daemon handshake: the spool directory IS the protocol, which is what
+lets a killed daemon lose nothing (the submission either is or is not
+durably in the inbox/journal; there is no in-flight third state).
+``call --submit/--status/--wait`` (cli/main.py) are thin wrappers over
+these functions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+from duplexumiconsensusreads_tpu.serve.job import validate_spec
+from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
+
+# states with nothing left to wait for
+TERMINAL_STATES = ("done", "failed", "rejected", "unknown")
+
+
+def make_job_id(spec_fields: dict) -> str:
+    """Content hash + random suffix: collision-free without any
+    coordination between clients (two submissions of the same job spec
+    are two jobs, as two `call` invocations would be two runs)."""
+    base = hashlib.sha256(
+        json.dumps(spec_fields, sort_keys=True).encode() + os.urandom(8)
+    ).hexdigest()[:12]
+    return f"job-{base}"
+
+
+def submit(
+    spool_dir: str,
+    input_path: str,
+    output_path: str,
+    config: dict | None = None,
+    priority: int = 1,
+    chaos: str | None = None,
+    trace: str | None = None,
+) -> str:
+    """Validate + durably spool one job; returns its id. Raises
+    ValueError on a bad spec and FileNotFoundError on a missing input —
+    submission-time failures belong to the submitter, not the daemon."""
+    if not os.path.exists(input_path):
+        raise FileNotFoundError(f"job input does not exist: {input_path}")
+    fields = {
+        "input": os.path.abspath(input_path),
+        "output": os.path.abspath(output_path),
+        "priority": priority,
+        "config": dict(config or {}),
+    }
+    if chaos:
+        fields["chaos"] = chaos
+    if trace:
+        fields["trace"] = os.path.abspath(trace)
+    spec = validate_spec({"job_id": make_job_id(fields), **fields})
+    return SpoolQueue(spool_dir).submit(spec)
+
+
+def status(spool_dir: str, job_id: str) -> dict:
+    return SpoolQueue(spool_dir).status(job_id)
+
+
+def wait(
+    spool_dir: str, job_id: str, timeout_s: float = 0.0, poll_s: float = 0.5
+) -> dict:
+    """Poll until the job reaches a terminal state ("unknown" counts:
+    waiting on a job nobody submitted must not hang). ``timeout_s`` 0 =
+    wait forever; on expiry the last status is returned with
+    ``timed_out: true`` rather than raising — the job is still running,
+    which is an answer, not an error."""
+    q = SpoolQueue(spool_dir)
+    t0 = time.monotonic()
+    while True:
+        st = q.status(job_id)
+        if st.get("state") in TERMINAL_STATES:
+            return st
+        if timeout_s > 0 and time.monotonic() - t0 >= timeout_s:
+            return {**st, "timed_out": True}
+        time.sleep(poll_s)

@@ -1,0 +1,127 @@
+"""``dut-serve`` — the long-running consensus daemon.
+
+    dut-serve SPOOL_DIR [--chunk-budget N] [--max-queue N] [--workers N]
+                        [--heartbeat S] [--no-trace] [--once] ...
+
+Runs a :class:`~duplexumiconsensusreads_tpu.serve.service.ConsensusService`
+over SPOOL_DIR until SIGTERM/SIGINT, which trigger graceful drain:
+every running job yields at its next chunk boundary and is re-journaled
+as queued, the admission queue is already durable, and the process
+exits 0. Restarting the daemon on the same spool resumes the queue and
+every interrupted job (checkpoint resume skips their committed chunks).
+
+Submit work with ``duplexumi call IN -o OUT --submit --spool SPOOL_DIR``
+and follow it with ``call --status/--wait`` (or read
+``SPOOL_DIR/metrics.json`` for the live service snapshot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dut-serve",
+        description="multi-job consensus service over a spool directory",
+    )
+    p.add_argument("spool", help="spool directory (created if missing)")
+    p.add_argument(
+        "--chunk-budget", type=int, default=8,
+        help="fresh chunks a job may commit before yielding the device "
+        "to a waiting job (0 = run each job to completion; default 8). "
+        "Preemption happens at chunk boundaries, where checkpoint/resume "
+        "makes the yield free",
+    )
+    p.add_argument(
+        "--max-queue", type=int, default=64,
+        help="bounded admission: open (queued+running) jobs beyond this "
+        "are rejected with a journaled reason (default 64)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=1,
+        help="warm worker threads draining the queue (default 1: one "
+        "device, one job at a time — the scheduler owns arbitration)",
+    )
+    p.add_argument(
+        "--devices", type=int, default=None,
+        help="devices per job slice (default: all local)",
+    )
+    p.add_argument(
+        "--poll", type=float, default=0.25, metavar="SECONDS",
+        help="inbox poll interval when idle (default 0.25)",
+    )
+    p.add_argument(
+        "--heartbeat", type=float, default=10.0, metavar="SECONDS",
+        help="service heartbeat period: stderr line + capture event + "
+        "metrics.json snapshot (0 disables; default 10)",
+    )
+    p.add_argument(
+        "--trace", default=None, metavar="TRACE_JSONL",
+        help="service capture path (default: SPOOL/service.trace.jsonl)",
+    )
+    p.add_argument(
+        "--no-trace", action="store_true",
+        help="disable the service capture entirely",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="drain until the queue, inbox and workers are idle, then "
+        "exit (batch mode; the default is to serve until SIGTERM)",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.chunk_budget < 0:
+        raise SystemExit(f"--chunk-budget must be >= 0 (got {args.chunk_budget})")
+    if args.workers < 1:
+        raise SystemExit(f"--workers must be >= 1 (got {args.workers})")
+    from duplexumiconsensusreads_tpu.serve.service import ConsensusService
+
+    os.makedirs(args.spool, exist_ok=True)
+    trace_path = None
+    if not args.no_trace:
+        trace_path = args.trace or os.path.join(
+            args.spool, "service.trace.jsonl"
+        )
+    service = ConsensusService(
+        args.spool,
+        chunk_budget=args.chunk_budget,
+        max_queue=args.max_queue,
+        workers=args.workers,
+        poll_s=args.poll,
+        heartbeat_s=args.heartbeat,
+        trace_path=trace_path,
+        n_devices=args.devices,
+    )
+
+    def _drain(signum, _frame):
+        print(
+            f"[dut-serve] signal {signum}: graceful drain — finishing "
+            f"in-flight chunks, journaling the queue",
+            file=sys.stderr,
+            flush=True,
+        )
+        service.request_drain()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    print(
+        f"[dut-serve] serving {os.path.abspath(args.spool)} "
+        f"(workers={args.workers}, chunk_budget={args.chunk_budget}, "
+        f"max_queue={args.max_queue}, pid={os.getpid()})",
+        file=sys.stderr,
+        flush=True,
+    )
+    snap = service.run(once=args.once)
+    print(f"[dut-serve] drained: {snap}", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

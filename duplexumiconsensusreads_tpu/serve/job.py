@@ -1,0 +1,203 @@
+"""Job specs: what a client submits and what a worker slice runs.
+
+A job is (input BAM → output path) plus a CONFIG dict holding the same
+keys as the streaming ``call`` flags (underscored). The spec is
+validated twice — at submission (a typo fails in the client, not hours
+later in the daemon) and again at admission (the daemon never trusts
+spooled bytes) — with the same function, so the two ends cannot drift.
+
+Only STREAMING params are accepted: the service's whole preemption and
+crash-recovery story is phrased over chunk boundaries, so a job must
+run on the streaming executor (``chunk_reads > 0``). Whole-file-only
+features (--ref-projected, --umi-whitelist) are rejected at submission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+# config keys a job may carry, with the SAME defaults as cli/main.py's
+# opt() resolution — a job submitted with an empty config must run the
+# identical workload as a bare `call --chunk-reads` would
+CONFIG_DEFAULTS = {
+    "grouping": "exact",
+    "mode": "ss",
+    "error_model": "none",
+    "max_hamming": 1,
+    "count_ratio": 2,
+    "min_reads": 1,
+    "min_duplex_reads": 1,
+    "max_qual": 90,
+    "max_input_qual": 50,
+    "min_input_qual": 0,
+    "capacity": 2048,
+    "chunk_reads": 500_000,
+    "max_inflight": 4,
+    "drain_workers": 2,
+    "mate_aware": "auto",
+    "max_reads": 0,
+    "per_base_tags": False,
+    "read_group_id": "A",
+    "write_index": False,
+}
+
+_CHOICES = {
+    "grouping": {"exact", "adjacency", "cluster"},
+    "mode": {"ss", "duplex"},
+    "error_model": {"none", "cycle"},
+    "mate_aware": {"auto", "on", "off"},
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One validated consensus job."""
+
+    job_id: str
+    input: str
+    output: str
+    priority: int = 1  # lower = more urgent; FIFO within a class
+    config: dict = dataclasses.field(default_factory=dict)
+    chaos: str | None = None  # per-job fault schedule (faults.FaultPlan)
+    trace: str | None = None  # per-job run-capture path
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v not in (None, {})}
+
+
+def validate_spec(d: dict) -> JobSpec:
+    """Dict (from a client call or a spooled JSON file) → JobSpec.
+    Raises ValueError naming the offending field; never half-accepts."""
+    if not isinstance(d, dict):
+        raise ValueError("job spec must be a JSON object")
+    allowed_top = {"job_id", "input", "output", "priority", "config",
+                   "chaos", "trace"}
+    unknown = set(d) - allowed_top
+    if unknown:
+        raise ValueError(f"unknown job fields: {sorted(unknown)}")
+    for field in ("job_id", "input", "output"):
+        v = d.get(field)
+        if not isinstance(v, str) or not v:
+            raise ValueError(f"job {field!r} must be a non-empty string")
+    priority = d.get("priority", 1)
+    if not isinstance(priority, int) or isinstance(priority, bool) or priority < 0:
+        raise ValueError(f"job priority must be an int >= 0 (got {priority!r})")
+    config = d.get("config", {})
+    if not isinstance(config, dict):
+        raise ValueError("job config must be an object")
+    unknown = set(config) - set(CONFIG_DEFAULTS)
+    if unknown:
+        raise ValueError(
+            f"unknown job config keys: {sorted(unknown)} "
+            f"(allowed: {sorted(CONFIG_DEFAULTS)})"
+        )
+    merged = {**CONFIG_DEFAULTS, **config}
+    for key, allowed in _CHOICES.items():
+        if merged[key] not in allowed:
+            raise ValueError(
+                f"invalid config {key} value {merged[key]!r} "
+                f"(allowed: {sorted(allowed)})"
+            )
+    if not isinstance(merged["chunk_reads"], int) or merged["chunk_reads"] < 1:
+        raise ValueError(
+            "jobs run on the streaming executor: config chunk_reads "
+            f"must be an int >= 1 (got {merged['chunk_reads']!r})"
+        )
+    for key in ("capacity", "drain_workers", "max_inflight"):
+        if not isinstance(merged[key], int) or merged[key] < 1:
+            raise ValueError(f"config {key} must be an int >= 1")
+    chaos = d.get("chaos")
+    if chaos is not None:
+        if not isinstance(chaos, str) or not chaos:
+            raise ValueError("job chaos must be a non-empty schedule string")
+        from duplexumiconsensusreads_tpu.runtime.faults import FaultPlan
+
+        FaultPlan.parse(chaos)  # reject a bad schedule at submission
+    trace = d.get("trace")
+    if trace is not None and (not isinstance(trace, str) or not trace):
+        raise ValueError("job trace must be a non-empty path")
+    return JobSpec(
+        job_id=d["job_id"],
+        input=d["input"],
+        output=d["output"],
+        priority=priority,
+        config=config,
+        chaos=chaos,
+        trace=trace,
+    )
+
+
+def job_params(spec: JobSpec):
+    """(GroupingParams, ConsensusParams, stream kwargs) for one job —
+    the serve-side mirror of cli/main.py's flag resolution."""
+    c = {**CONFIG_DEFAULTS, **spec.config}
+    gp = GroupingParams(
+        strategy=c["grouping"],
+        max_hamming=c["max_hamming"],
+        count_ratio=c["count_ratio"],
+        paired=(c["mode"] == "duplex"),
+    )
+    cp = ConsensusParams(
+        mode="duplex" if c["mode"] == "duplex" else "single_strand",
+        min_reads=c["min_reads"],
+        min_duplex_reads=c["min_duplex_reads"],
+        max_qual=c["max_qual"],
+        max_input_qual=c["max_input_qual"],
+        min_input_qual=c["min_input_qual"],
+        error_model=None if c["error_model"] == "none" else c["error_model"],
+    )
+    kwargs = dict(
+        capacity=c["capacity"],
+        chunk_reads=c["chunk_reads"],
+        max_inflight=c["max_inflight"],
+        drain_workers=c["drain_workers"],
+        mate_aware=c["mate_aware"],
+        max_reads=c["max_reads"],
+        per_base_tags=bool(c["per_base_tags"]),
+        read_group=str(c["read_group_id"]),
+        write_index=bool(c["write_index"]),
+    )
+    return gp, cp, kwargs
+
+
+def serve_provenance(config: dict) -> str:
+    """The deterministic @PG CL line for a service-run output: the
+    equivalent ``duplexumi call`` CONFIG flags in canonical order, with
+    no paths and no daemon argv. A one-shot output's CL records the
+    invoking command line — but a service job's bytes must be a pure
+    function of (input bytes, config): the same job must produce
+    identical bytes whichever daemon (or daemon restart) finishes it,
+    and two equal jobs writing different paths must still compare
+    byte-identical. That is exactly the property the soak and
+    crash-convergence tests are phrased over, so paths and argv are
+    deliberately excluded."""
+    parts = ["duplexumi", "call"]
+    merged = {**CONFIG_DEFAULTS, **config}
+    for key, default in CONFIG_DEFAULTS.items():  # canonical flag order
+        val = merged[key]
+        if val == default:
+            continue
+        flag = "--" + key.replace("_", "-")
+        if isinstance(val, bool):
+            parts.append(flag)
+        else:
+            parts.extend([flag, str(val)])
+    parts.append("[dut-serve]")
+    return " ".join(parts)
+
+
+def spec_signature(spec: JobSpec) -> str:
+    """The job's COMPILE identity: the config subset that determines
+    bucket geometry + pipeline spec (capacity, grouping strategy, mode,
+    error model, per-base tags). Two jobs sharing a signature share XLA
+    programs, so the second is a compile-cache hit in the warm daemon —
+    the amortisation the service exists to provide."""
+    c = {**CONFIG_DEFAULTS, **spec.config}
+    return "|".join(
+        str(c[k])
+        for k in ("capacity", "grouping", "mode", "error_model",
+                  "per_base_tags")
+    )

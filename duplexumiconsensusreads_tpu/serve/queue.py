@@ -1,0 +1,344 @@
+"""Durable spool + admission queue for the consensus service.
+
+Spool layout (one directory, shared by clients and the daemon):
+
+  inbox/<job_id>.json   client submissions — written durably by the
+                        client, removed by the daemon only AFTER the
+                        job is durably journaled (so a kill anywhere in
+                        admission re-admits instead of losing the job;
+                        job_id is the dedupe key, so re-admission can
+                        never double-enter)
+  queue.json            the daemon's admission-queue journal: every
+                        accepted job with its state machine
+                        (queued → running → done | failed), persisted
+                        via the tmp+fsync+rename protocol on EVERY
+                        transition — whatever the journal says survived
+                        the crash is exactly what the restarted daemon
+                        resumes
+  results/<job_id>.json final per-job report (durable), read by
+                        ``call --status/--wait``
+  metrics.json          the live service heartbeat snapshot
+
+Fault sites: ``serve.accept`` guards the read+parse+validate of each
+submission; ``serve.journal`` guards every journal persist. Both ride
+the streaming executor's bounded host-I/O retry ladder, so transient
+faults are absorbed and an injected kill leaves exactly the on-disk
+state a real SIGKILL would.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from duplexumiconsensusreads_tpu.io.durable import write_durable
+from duplexumiconsensusreads_tpu.serve.job import JobSpec, validate_spec
+
+JOURNAL_VERSION = 1
+
+# journal job states; the only legal transitions are
+# queued -> running -> (done | failed | queued on preempt/recovery)
+JOB_STATES = ("queued", "running", "done", "failed", "rejected")
+
+
+class SpoolQueue:
+    """The admission queue over one spool directory.
+
+    All mutating methods persist the journal durably before returning;
+    the in-memory ``jobs`` dict is only ever a cache of queue.json.
+    Thread safety is the caller's job (serve.service serializes all
+    journal mutations under its scheduler lock).
+    """
+
+    def __init__(self, root: str, max_queue: int = 64,
+                 max_terminal_kept: int = 256):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1 (got {max_queue})")
+        if max_terminal_kept < 0:
+            raise ValueError(
+                f"max_terminal_kept must be >= 0 (got {max_terminal_kept})"
+            )
+        self.root = root
+        self.max_queue = max_queue
+        # the journal is rewritten+fsynced on every transition, so it
+        # must stay bounded on a long-lived daemon: terminal entries
+        # (done/failed/rejected) beyond this many are compacted away on
+        # save — their durable per-job report in results/ remains the
+        # record (status() falls back to it)
+        self.max_terminal_kept = max_terminal_kept
+        self.inbox_dir = os.path.join(root, "inbox")
+        self.results_dir = os.path.join(root, "results")
+        os.makedirs(self.inbox_dir, exist_ok=True)
+        os.makedirs(self.results_dir, exist_ok=True)
+        self.journal_path = os.path.join(root, "queue.json")
+        self.jobs: dict[str, dict] = {}
+        self.seq = 0
+        self._load()
+
+    # ------------------------------------------------------- client side
+
+    def submit(self, spec: JobSpec) -> str:
+        """Durably spool one validated job into the inbox (client side;
+        the daemon never calls this). Returns the job id."""
+        payload = json.dumps(spec.to_dict(), sort_keys=True).encode()
+        write_durable(
+            os.path.join(self.inbox_dir, spec.job_id + ".json"), payload
+        )
+        return spec.job_id
+
+    def status(self, job_id: str) -> dict:
+        """One job's observable state, from the journal + inbox +
+        results — readable while the daemon runs (every file involved
+        is only ever atomically replaced).
+
+        Admission-race discipline: the daemon journals BEFORE unlinking
+        the inbox file, but a reader that loads the journal first and
+        checks the inbox second can see neither (journal read pre-save,
+        inbox checked post-unlink). After an inbox miss the journal is
+        therefore RE-read — a live job must never be reported "unknown"
+        (which ``client.wait`` treats as terminal)."""
+        self._load()
+        entry = self.jobs.get(job_id)
+        if entry is None:
+            if os.path.exists(os.path.join(self.inbox_dir, job_id + ".json")):
+                return {"job_id": job_id, "state": "submitted"}
+            self._load()  # close the accept-vs-status window
+            entry = self.jobs.get(job_id)
+        if entry is None:
+            return self._status_from_result(job_id)
+        out = {"job_id": job_id, **{k: v for k, v in entry.items()
+                                    if k != "spec"}}
+        result_path = os.path.join(self.results_dir, job_id + ".json")
+        if entry.get("state") in ("done", "failed") and os.path.exists(
+            result_path
+        ):
+            try:
+                with open(result_path) as f:
+                    out["result"] = json.load(f)
+            except (OSError, ValueError):
+                pass  # result file torn/racing: state alone still answers
+        return out
+
+    def _status_from_result(self, job_id: str) -> dict:
+        """Jobs whose terminal journal entry was compacted away still
+        answer from their durable result file."""
+        result_path = os.path.join(self.results_dir, job_id + ".json")
+        try:
+            with open(result_path) as f:
+                result = json.load(f)
+        except (OSError, ValueError):
+            return {"job_id": job_id, "state": "unknown"}
+        state = "failed" if "error" in result else "done"
+        return {"job_id": job_id, "state": state, "result": result,
+                "compacted": True}
+
+    # ------------------------------------------------------- daemon side
+
+    def _load(self) -> None:
+        """Refresh the in-memory view from queue.json. A torn or
+        garbage journal is discarded (never fatal): the inbox files
+        still exist for every job whose admission didn't complete, and
+        jobs already dispatched wrote their own durable outputs."""
+        try:
+            with open(self.journal_path) as f:
+                on_disk = json.load(f)
+            if not isinstance(on_disk, dict) or not isinstance(
+                on_disk.get("jobs"), dict
+            ):
+                raise ValueError("journal is not a {jobs: {...}} object")
+        except FileNotFoundError:
+            return
+        except (OSError, ValueError):
+            return
+        self.jobs = on_disk["jobs"]
+        self.seq = int(on_disk.get("seq", len(self.jobs)))
+
+    def _compact(self) -> None:
+        """Bound the journal: drop the OLDEST terminal entries beyond
+        ``max_terminal_kept`` (their results/ file stays the durable
+        record). Open jobs (queued/running) are never touched."""
+        terminal = sorted(
+            (
+                (int(e.get("seq", 0)), jid)
+                for jid, e in self.jobs.items()
+                if e.get("state") in ("done", "failed", "rejected")
+            ),
+        )
+        for _, jid in terminal[: max(len(terminal) - self.max_terminal_kept, 0)]:
+            del self.jobs[jid]
+
+    def save(self) -> None:
+        """Durable journal persist (fault site ``serve.journal``)."""
+        # _io_retry imported lazily: the CLIENT side of this module
+        # (submit/status for `call --submit/--status/--wait`) must not
+        # drag in runtime.stream — and through it jax — on every poll
+        from duplexumiconsensusreads_tpu.runtime.stream import _io_retry
+
+        self._compact()
+        payload = json.dumps(
+            {"version": JOURNAL_VERSION, "seq": self.seq, "jobs": self.jobs},
+            sort_keys=True,
+        ).encode()
+        _io_retry(
+            "serve.journal",
+            lambda: write_durable(self.journal_path, payload),
+            "queue journal save",
+        )
+
+    def pending_submissions(self) -> list[str]:
+        """Inbox job ids in ARRIVAL order (mtime of the durable spool
+        file, name as tiebreak): admission seq — and therefore FIFO
+        order within a priority class — follows submission time, not
+        the job-id hash the filenames happen to sort by."""
+        entries = []
+        try:
+            for n in os.listdir(self.inbox_dir):
+                if not n.endswith(".json"):
+                    continue
+                try:
+                    mt = os.stat(os.path.join(self.inbox_dir, n)).st_mtime
+                except OSError:
+                    continue  # raced away mid-listing
+                entries.append((mt, n))
+        except OSError:
+            return []
+        return [n[:-5] for _, n in sorted(entries)]
+
+    def accept_one(self, job_id: str) -> tuple[JobSpec | None, str | None]:
+        """Admit one inbox submission: read + validate (fault site
+        ``serve.accept``), journal it durably, THEN remove the inbox
+        file. Returns (spec, None) on admission, (None, reason) on
+        rejection (bounded queue, invalid spec), (None, None) when the
+        submission was a duplicate of an already-journaled job.
+
+        Kill-anywhere safety: before the journal save the inbox file is
+        untouched (restart re-admits); after it, re-admission dedupes on
+        job_id and merely removes the leftover inbox file."""
+        from duplexumiconsensusreads_tpu.runtime.stream import _io_retry
+
+        path = os.path.join(self.inbox_dir, job_id + ".json")
+
+        def _read():
+            with open(path, "rb") as f:
+                return f.read()
+
+        try:
+            raw = _io_retry("serve.accept", _read, f"job {job_id} accept")
+        except FileNotFoundError:
+            return None, None  # raced away (duplicate listing)
+        if job_id in self.jobs:
+            # already journaled (kill landed between journal + unlink):
+            # admission already happened exactly once — just clean up
+            self._unlink_inbox(path)
+            return None, None
+        try:
+            spec = validate_spec(json.loads(raw.decode()))
+            if spec.job_id != job_id:
+                raise ValueError(
+                    f"spec job_id {spec.job_id!r} does not match the "
+                    f"spool filename"
+                )
+        except (ValueError, UnicodeDecodeError) as e:
+            self.jobs[job_id] = {
+                "state": "rejected", "error": str(e)[:500], "seq": self.seq,
+            }
+            self.seq += 1
+            self.save()
+            self._unlink_inbox(path)
+            return None, str(e)
+        n_open = sum(
+            1 for j in self.jobs.values() if j.get("state") in ("queued", "running")
+        )
+        if n_open >= self.max_queue:
+            # bounded admission: REJECT (journaled, so --status answers)
+            # rather than silently stalling the inbox forever
+            reason = f"queue full ({n_open}/{self.max_queue} jobs open)"
+            self.jobs[job_id] = {
+                "state": "rejected", "error": reason, "seq": self.seq,
+            }
+            self.seq += 1
+            self.save()
+            self._unlink_inbox(path)
+            return None, reason
+        self.jobs[job_id] = {
+            "state": "queued",
+            "seq": self.seq,
+            "priority": spec.priority,
+            "spec": spec.to_dict(),
+            "slices": 0,
+            "chunks_done": 0,
+        }
+        self.seq += 1
+        self.save()
+        self._unlink_inbox(path)
+        return spec, None
+
+    @staticmethod
+    def _unlink_inbox(path: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass  # re-admission dedupes; a leftover file is harmless
+
+    # ----------------------------------------------- state transitions
+
+    def mark_running(self, job_id: str) -> None:
+        entry = self.jobs[job_id]
+        entry["state"] = "running"
+        entry["slices"] = int(entry.get("slices", 0)) + 1
+        self.save()
+
+    def requeue(self, job_id: str, chunks_done: int, back: bool) -> None:
+        """Preempted (or crash-recovered) job back to the queue.
+        ``back=True`` moves it behind its class's waiting jobs (the
+        budget-yield fairness rule); ``back=False`` keeps its original
+        seq (crash recovery must not penalise the interrupted job)."""
+        entry = self.jobs[job_id]
+        entry["state"] = "queued"
+        entry["chunks_done"] = int(chunks_done)
+        if back:
+            entry["seq"] = self.seq
+            self.seq += 1
+        self.save()
+
+    def mark_done(self, job_id: str, result: dict) -> None:
+        """Result file first, journal second: a kill between the two
+        re-runs the job's (idempotent, checkpointed) tail rather than
+        journaling a result that was never durably written."""
+        write_durable(
+            os.path.join(self.results_dir, job_id + ".json"),
+            json.dumps(result, sort_keys=True).encode(),
+        )
+        entry = self.jobs[job_id]
+        entry["state"] = "done"
+        entry.pop("error", None)
+        self.save()
+
+    def mark_failed(self, job_id: str, error: str) -> None:
+        write_durable(
+            os.path.join(self.results_dir, job_id + ".json"),
+            json.dumps({"error": error[:2000]}, sort_keys=True).encode(),
+        )
+        entry = self.jobs[job_id]
+        entry["state"] = "failed"
+        entry["error"] = error[:500]
+        self.save()
+
+    def recover_running(self) -> list[str]:
+        """Daemon start: every job the journal says was RUNNING was
+        interrupted by the previous daemon's death — requeue it at its
+        ORIGINAL seq (it reached the front once already) with resume
+        semantics (its checkpoint, if any survived, skips done chunks)."""
+        recovered = []
+        for job_id, entry in self.jobs.items():
+            if entry.get("state") == "running":
+                entry["state"] = "queued"
+                recovered.append(job_id)
+        if recovered:
+            self.save()
+        return recovered
+
+    def queue_depth(self) -> int:
+        return sum(
+            1 for j in self.jobs.values() if j.get("state") == "queued"
+        )

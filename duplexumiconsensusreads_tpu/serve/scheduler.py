@@ -1,0 +1,58 @@
+"""Fair scheduling over the journaled queue.
+
+The policy is deliberately small enough to state in full:
+
+  * strict priority CLASSES: a queued job of a lower priority value
+    always runs before any higher value (0 is the most urgent);
+  * FIFO WITHIN a class, keyed on the admission sequence number the
+    journal assigned;
+  * per-job CHUNK BUDGET: a running job yields the device after
+    ``chunk_budget`` fresh chunks — but only when another job is
+    actually waiting (yielding to an empty queue is pure overhead) —
+    and re-enters its class at the BACK, so a jumbo job interleaves
+    with small ones instead of starving them. Preemption happens at a
+    chunk boundary, where the streaming executor's checkpoint/resume
+    contract makes the yield free (the next slice recomputes nothing).
+
+Pure functions over the journal's ``jobs`` dict: no state of its own,
+so a restarted daemon schedules exactly as the dead one would have.
+"""
+
+from __future__ import annotations
+
+
+class FairScheduler:
+    def __init__(self, chunk_budget: int = 0):
+        """``chunk_budget`` = fresh chunks a slice may commit before
+        yielding (0 = run to completion; no preemption)."""
+        if chunk_budget < 0:
+            raise ValueError(f"chunk_budget must be >= 0 (got {chunk_budget})")
+        self.chunk_budget = chunk_budget
+
+    @staticmethod
+    def pick(jobs: dict) -> str | None:
+        """The next job to run: min (priority, seq) over queued jobs."""
+        best = None
+        best_key = None
+        for job_id, entry in jobs.items():
+            if entry.get("state") != "queued":
+                continue
+            key = (int(entry.get("priority", 1)), int(entry.get("seq", 0)))
+            if best_key is None or key < best_key:
+                best, best_key = job_id, key
+        return best
+
+    @staticmethod
+    def others_waiting(jobs: dict, job_id: str) -> bool:
+        """Would any queued job actually run if ``job_id`` yielded now?
+        Only a waiter of EQUAL-OR-MORE-URGENT class counts: yielding to
+        a strictly less urgent job would just re-pick the yielder
+        (strict priority), burning a preempt/resume cycle for nothing —
+        and with an empty queue the running job keeps the device."""
+        mine = int(jobs.get(job_id, {}).get("priority", 1))
+        return any(
+            jid != job_id
+            and entry.get("state") == "queued"
+            and int(entry.get("priority", 1)) <= mine
+            for jid, entry in jobs.items()
+        )

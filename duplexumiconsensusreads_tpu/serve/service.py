@@ -1,0 +1,321 @@
+"""The consensus service: admission → fair scheduling → warm slices.
+
+One ``ConsensusService`` owns a spool directory and drains it through a
+small pool of warm workers. All queue/journal mutations are serialized
+under one lock; the slices themselves (the expensive part) run outside
+it. The service is equally usable in-process (tests, the bench's
+``serve_n_jobs`` leg) and as the ``dut-serve`` daemon (serve.daemon).
+
+Graceful drain: :meth:`request_drain` (the daemon's SIGTERM handler)
+makes every running slice yield at its next chunk boundary — the
+executor checkpoints the committed prefix, the job is re-journaled as
+queued, and :meth:`run` returns cleanly. A restarted service resumes
+both the queue and every interrupted job from exactly that state; the
+chaos-kill path (InjectedKill anywhere in admission or a slice) leaves
+the same journal a real SIGKILL would, which the recovery test pins.
+
+Telemetry: with ``trace_path`` set the service records a
+kind="service" capture (telemetry/trace.py): job lifecycle events on
+``job-<id>`` lanes, service heartbeats carrying the queue snapshot, and
+— because the recorder is installed as the process-global hook — every
+fault/retry/durable event the switchboard emits while jobs run.
+``tools/serve_report.py`` summarises it; ``tools/check_trace.py``
+validates it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from duplexumiconsensusreads_tpu.io.durable import write_durable
+from duplexumiconsensusreads_tpu.runtime.stream import _io_retry
+from duplexumiconsensusreads_tpu.serve.job import validate_spec
+from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
+from duplexumiconsensusreads_tpu.serve.scheduler import FairScheduler
+from duplexumiconsensusreads_tpu.serve.worker import WarmWorker
+from duplexumiconsensusreads_tpu.telemetry import trace as telemetry
+from duplexumiconsensusreads_tpu.telemetry.trace import Heartbeat, TraceRecorder
+
+
+class ConsensusService:
+    def __init__(
+        self,
+        spool_dir: str,
+        chunk_budget: int = 8,
+        max_queue: int = 64,
+        workers: int = 1,
+        poll_s: float = 0.25,
+        heartbeat_s: float = 0.0,
+        trace_path: str | None = None,
+        n_devices: int | None = None,
+    ):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1 (got {workers})")
+        if poll_s <= 0:
+            raise ValueError(f"poll_s must be > 0 (got {poll_s})")
+        self.queue = SpoolQueue(spool_dir, max_queue=max_queue)
+        self.sched = FairScheduler(chunk_budget=chunk_budget)
+        self.worker = WarmWorker(n_devices=n_devices)
+        self.workers = workers
+        self.poll_s = poll_s
+        self.heartbeat_s = heartbeat_s
+        self.trace_path = trace_path
+        self._lock = threading.Lock()
+        self._drain = threading.Event()
+        self._fatal: BaseException | None = None
+        self._n_running = 0
+        self._t0 = time.monotonic()
+        self._job_seconds: dict[str, dict] = {}
+        self.counters = {
+            "jobs_accepted": 0, "jobs_rejected": 0, "jobs_done": 0,
+            "jobs_failed": 0, "preemptions": 0, "jobs_recovered": 0,
+        }
+        self._tr: TraceRecorder | None = None
+
+    # ------------------------------------------------------------ control
+
+    def request_drain(self) -> None:
+        """Graceful shutdown: running slices yield at the next chunk
+        boundary and are re-journaled as queued; :meth:`run` returns.
+        Safe from signal handlers and any thread."""
+        self._drain.set()
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            snap = {
+                "elapsed_s": round(time.monotonic() - self._t0, 1),
+                "queue_depth": self.queue.queue_depth(),
+                "jobs_inflight": self._n_running,
+                **self.counters,
+                "slices": self.worker.n_slices,
+                "compile_hit_rate": round(self.worker.compile_hit_rate(), 3),
+            }
+        return snap
+
+    def _write_metrics(self, snap: dict) -> None:
+        """The live snapshot file beside the journal: queue depth, jobs
+        in flight, per-job phase seconds, compile-cache hit rate —
+        readable by ops/`call --status` while the daemon runs."""
+        import json
+
+        with self._lock:
+            payload = json.dumps(
+                {**snap, "job_seconds": self._job_seconds}, sort_keys=True
+            ).encode()
+        try:
+            write_durable(os.path.join(self.queue.root, "metrics.json"), payload)
+        except OSError:
+            pass  # the snapshot is observability, never worth a crash
+
+    def _beat_stats(self) -> dict:
+        snap = self.stats()
+        self._write_metrics(snap)
+        return snap
+
+    # ----------------------------------------------------------- running
+
+    def run(self, once: bool = False) -> dict:
+        """Drain the spool. ``once=True`` returns when the queue, inbox
+        and workers are all idle (tests, the bench leg); ``once=False``
+        runs until :meth:`request_drain`. Returns the final stats
+        snapshot; re-raises a fatal error (injected kill, journal I/O
+        beyond retries) after the surviving workers stop."""
+        from duplexumiconsensusreads_tpu.utils.compile_cache import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache(per_host_cpu=True)
+        tr = None
+        hooked = False
+        if self.trace_path:
+            tr = TraceRecorder(self.trace_path, kind="service")
+            self._tr = tr
+            if telemetry.get_active() is None:
+                # the service capture doubles as the switchboard sink:
+                # fault/retry/durable events from admissions AND from
+                # untraced job slices land here
+                telemetry.install(tr)
+                hooked = True
+        hb = None
+        if self.heartbeat_s and self.heartbeat_s > 0:
+            hb = Heartbeat(self.heartbeat_s, self._beat_stats, recorder=tr)
+            hb.start()
+        recovered = self.queue.recover_running()
+        with self._lock:
+            self.counters["jobs_recovered"] += len(recovered)
+        for job_id in recovered:
+            if tr is not None:
+                tr.event(
+                    "resume", job=job_id, lane=f"job-{job_id}",
+                    decision="requeued_running",
+                )
+        threads = [
+            threading.Thread(
+                target=self._worker_loop, args=(once,),
+                name=f"dut-serve_{i}", daemon=True,
+            )
+            for i in range(self.workers)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            if hb is not None:
+                hb.stop()
+            snap = self._beat_stats()
+            if tr is not None:
+                if self._fatal is None:
+                    # clean shutdown only: a fatal exit must leave a
+                    # summary-less capture, the post-mortem marker
+                    tr.write_summary(counters=snap)
+                if hooked:
+                    telemetry.uninstall()
+                tr.close()
+                self._tr = None
+        if self._fatal is not None:
+            raise self._fatal
+        return snap
+
+    def run_until_idle(self) -> dict:
+        return self.run(once=True)
+
+    # ------------------------------------------------------- worker loop
+
+    def _accept_pending_locked(self) -> None:
+        """Admit every spooled submission (caller holds the lock)."""
+        tr = self._tr
+        for job_id in self.queue.pending_submissions():
+            spec, reason = self.queue.accept_one(job_id)
+            if spec is not None:
+                self.counters["jobs_accepted"] += 1
+                if tr is not None:
+                    tr.event(
+                        "job_accepted", job=spec.job_id,
+                        lane=f"job-{spec.job_id}", priority=spec.priority,
+                        seq=self.queue.jobs[spec.job_id]["seq"],
+                        queue_depth=self.queue.queue_depth(),
+                    )
+            elif reason is not None:
+                self.counters["jobs_rejected"] += 1
+                if tr is not None:
+                    tr.event(
+                        "job_rejected", job=job_id, lane=f"job-{job_id}",
+                        reason=reason[:200],
+                    )
+
+    def _idle_done(self, once: bool) -> bool:
+        if not once:
+            return False
+        with self._lock:
+            return (
+                self.queue.queue_depth() == 0
+                and self._n_running == 0
+                and not self.queue.pending_submissions()
+            )
+
+    def _worker_loop(self, once: bool) -> None:
+        try:
+            while not self._drain.is_set():
+                with self._lock:
+                    self._accept_pending_locked()
+                    job_id = self.sched.pick(self.queue.jobs)
+                    if job_id is not None:
+                        entry = self.queue.jobs[job_id]
+                        # journaled spec, not a cached object: a daemon
+                        # restarted onto an old journal must run exactly
+                        # what admission durably recorded
+                        spec = validate_spec(entry["spec"])
+                        self.queue.mark_running(job_id)
+                        first_slice = entry["slices"] == 1
+                        self._n_running += 1
+                if job_id is None:
+                    if self._idle_done(once):
+                        return
+                    self._drain.wait(self.poll_s)
+                    continue
+                try:
+                    self._run_one(spec, first_slice)
+                finally:
+                    with self._lock:
+                        self._n_running -= 1
+        except BaseException as e:  # noqa: BLE001 — modelled process death
+            # an injected kill or a journal failure beyond the retry
+            # ladder is the daemon dying: stop every worker, surface the
+            # exception from run() with the journal exactly as durable
+            # state left it (the recovery tests restart from there)
+            with self._lock:
+                if self._fatal is None:
+                    self._fatal = e
+            self._drain.set()
+
+    def _run_one(self, spec, first_slice: bool) -> None:
+        tr = self._tr
+        job_id = spec.job_id
+        lane = f"job-{job_id}"
+        warm = self.worker.note_job_start(spec, first_slice)
+        if tr is not None:
+            with self._lock:
+                n_slice = self.queue.jobs[job_id]["slices"]
+            tr.event(
+                "job_started", job=job_id, lane=lane, slice=n_slice,
+                warm=warm, resumed=not first_slice,
+            )
+
+        def should_yield() -> bool:
+            with self._lock:
+                return self.sched.others_waiting(self.queue.jobs, job_id)
+
+        t0 = time.monotonic()
+        try:
+            out = self.worker.run_slice(
+                spec, self.sched.chunk_budget, should_yield, self._drain
+            )
+        except Exception as e:  # noqa: BLE001 — job-scoped failure
+            with self._lock:
+                self.queue.mark_failed(job_id, repr(e))
+                self.counters["jobs_failed"] += 1
+            if tr is not None:
+                tr.event("job_failed", job=job_id, lane=lane,
+                         error=repr(e)[:200])
+            return
+        wall = round(time.monotonic() - t0, 3)
+        if out[0] == "done":
+            _, result = out
+            with self._lock:
+                self.queue.mark_done(job_id, result)
+                self.counters["jobs_done"] += 1
+                self._job_seconds[job_id] = result.get("seconds", {})
+            if tr is not None:
+                tr.event(
+                    "job_completed", job=job_id, lane=lane, wall_s=wall,
+                    n_chunks=result.get("n_chunks", 0),
+                    n_consensus=result.get("n_consensus", 0),
+                    warm=warm, seconds=result.get("seconds", {}),
+                )
+        else:
+            _, chunks_done, reason = out
+
+            def _requeue():
+                with self._lock:
+                    self.queue.requeue(
+                        job_id, chunks_done, back=(reason == "budget")
+                    )
+
+            # serve.preempt guards the preemption commit: a transient
+            # fault re-runs the idempotent requeue; an injected kill
+            # leaves the job journaled "running", which restart recovery
+            # requeues — the same convergence a real crash gets
+            _io_retry("serve.preempt", _requeue, f"job {job_id} requeue")
+            with self._lock:
+                self.counters["preemptions"] += 1
+            if tr is not None:
+                tr.event(
+                    "job_preempted", job=job_id, lane=lane,
+                    chunks_done=chunks_done, reason=reason, wall_s=wall,
+                )

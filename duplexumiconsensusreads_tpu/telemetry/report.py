@@ -146,6 +146,59 @@ def validate_trace(records: list[dict]) -> list[str]:
     return problems
 
 
+def capture_kind(records: list[dict]) -> str:
+    """The capture's kind from its meta header: "run" (streaming
+    executor, the default — pre-kind captures read as this) or
+    "service" (a serve/ daemon capture)."""
+    meta = records[0] if records else None
+    if isinstance(meta, dict) and meta.get("type") == "meta":
+        k = meta.get("kind")
+        if isinstance(k, str) and k:
+            return k
+    return "run"
+
+
+_JOB_EVENTS = (
+    "job_accepted", "job_rejected", "job_started", "job_preempted",
+    "job_completed", "job_failed",
+)
+
+
+def validate_service_trace(records: list[dict]) -> list[str]:
+    """The service-capture contract on top of :func:`validate_trace`:
+    every job-lifecycle event must name its job (``job`` attr) and be
+    recorded on that job's lane (``job-<id>``), and every service
+    heartbeat must carry the queue-depth/in-flight sample — a capture
+    where job events are anonymous cannot be decomposed per job, which
+    is the whole point of the service capture."""
+    problems = validate_trace(records)
+    if capture_kind(records) != "service":
+        problems.append('meta header is not kind="service"')
+    for i, rec in enumerate(records, 1):
+        if not isinstance(rec, dict) or rec.get("type") != "event":
+            continue
+        name = rec.get("name")
+        if name in _JOB_EVENTS:
+            job = rec.get("job")
+            if not isinstance(job, str) or not job:
+                problems.append(
+                    f"record {i}: {name} event without a job id attr"
+                )
+            elif rec.get("lane") != f"job-{job}":
+                problems.append(
+                    f"record {i}: {name} event for job {job!r} not on "
+                    f"lane 'job-{job}' (got {rec.get('lane')!r})"
+                )
+        elif name == "heartbeat":
+            for attr in ("queue_depth", "jobs_inflight"):
+                if not _is_num(rec.get(attr)):
+                    problems.append(
+                        f"record {i}: service heartbeat lacks numeric "
+                        f"{attr!r}"
+                    )
+    return problems
+
+
 # -------------------------------------------------------------- analysis
 
 def summary_record(records: list[dict]) -> dict | None:

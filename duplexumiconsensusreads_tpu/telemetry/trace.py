@@ -75,6 +75,16 @@ KNOWN_EVENTS = (
     "durable_write",  # io/durable.py: a tmp+fsync+rename completed
     "heartbeat",  # periodic liveness sample (also printed to stderr)
     "truncated",  # the bounded recorder hit max_events; tail dropped
+    # serving layer (serve/service.py): the job lifecycle in a
+    # kind="service" capture. Every job_* event carries a "job" attr and
+    # a "job-<id>" lane, so one capture decomposes per job the way a run
+    # capture decomposes per chunk (validate_service_trace enforces it).
+    "job_accepted",  # admission: inbox submission -> journaled queue
+    "job_rejected",  # admission refused (bounded queue / invalid spec)
+    "job_started",  # a scheduler slice began (attrs: slice, resumed)
+    "job_preempted",  # chunk-boundary yield (budget or drain)
+    "job_completed",  # finalise done (attrs: wall_s, per-phase seconds)
+    "job_failed",  # slice raised; job journaled failed, service lives on
 )
 
 
@@ -101,10 +111,20 @@ class TraceRecorder:
     the heartbeat thread all write to one recorder.
     """
 
-    def __init__(self, path: str, max_events: int = 1_000_000):
+    def __init__(
+        self, path: str, max_events: int = 1_000_000, kind: str = "run"
+    ):
+        """``kind`` tags the capture's meta header: "run" (a streaming
+        executor capture, the default) or "service" (a serve/ daemon
+        capture — job-lifecycle events instead of per-chunk spans).
+        Consumers (tools/check_trace.py) key their extra checks on it;
+        pre-kind captures read as "run"."""
         if max_events < 1:
             raise ValueError(f"max_events must be >= 1 (got {max_events})")
+        if kind not in ("run", "service"):
+            raise ValueError(f"unknown capture kind {kind!r}")
         self.path = path
+        self.kind = kind
         self.max_events = max_events
         self.n_events = 0  # admitted spans + events (meta/summary free)
         self.n_dropped = 0
@@ -123,7 +143,7 @@ class TraceRecorder:
             pass
         self._f = open(path, "w")
         self._line({"type": "meta", "version": TRACE_VERSION,
-                    "clock": "monotonic-relative"})
+                    "kind": kind, "clock": "monotonic-relative"})
 
     # ------------------------------------------------------- internals
 

@@ -53,6 +53,27 @@ def sim(tmp_path_factory):
         return path, f.read()
 
 
+@pytest.fixture(scope="module")
+def serve_ref(sim, tmp_path_factory):
+    """Fault-free reference bytes for SERVICE-run jobs: same input and
+    params as ``sim``, but carrying the canonical serve provenance line
+    (service outputs embed the config-derived @PG CL, not argv)."""
+    from duplexumiconsensusreads_tpu.serve.job import serve_provenance
+
+    path, _ = sim
+    d = tmp_path_factory.mktemp("chaos_serve")
+    ref = str(d / "serve_ref.bam")
+    config = dict(
+        grouping="adjacency", mode="duplex",
+        capacity=KW["capacity"], chunk_reads=KW["chunk_reads"],
+    )
+    stream_call_consensus(
+        path, ref, GP, CP, provenance_cl=serve_provenance(config), **KW
+    )
+    with open(ref, "rb") as f:
+        return f.read()
+
+
 @pytest.fixture(autouse=True)
 def _no_sleep_and_clean_plan(monkeypatch):
     # retries back off via stream.time.sleep; don't spend wall time on it
@@ -104,15 +125,38 @@ class TestPlanParsing:
 
 
 @pytest.mark.parametrize("site", faults.KNOWN_SITES)
-def test_transient_fault_at_each_site_byte_identical(site, sim, tmp_path):
+def test_transient_fault_at_each_site_byte_identical(
+    site, sim, serve_ref, tmp_path
+):
     """One seeded transient fault at each named site: the run must
     absorb it through its retry/isolation ladders and produce a final
-    BAM byte-identical to the fault-free run."""
+    BAM byte-identical to the fault-free run. The serve.* sites live in
+    the serving layer, so they are driven through a two-job service
+    pass over the same input (equal priorities + chunk_budget=1 forces
+    the preempt path every slice); the stream sites keep the direct
+    streaming run."""
     path, ref_bytes = sim
     plan = faults.FaultPlan.seeded(
         zlib.crc32(site.encode()), sites=(site,), n_faults=1, max_nth=1
     )
     faults.install(plan)
+    if site.startswith("serve."):
+        from duplexumiconsensusreads_tpu.serve import ConsensusService, client
+
+        spool = str(tmp_path / "spool")
+        config = dict(
+            grouping="adjacency", mode="duplex",
+            capacity=KW["capacity"], chunk_reads=KW["chunk_reads"],
+        )
+        outs = [str(tmp_path / f"out{i}.bam") for i in (1, 2)]
+        for o in outs:
+            client.submit(spool, path, o, config=config)
+        ConsensusService(spool, chunk_budget=1).run_until_idle()
+        assert plan.n_fired >= 1  # the schedule really injected
+        for o in outs:
+            with open(o, "rb") as f:
+                assert f.read() == serve_ref
+        return
     out = str(tmp_path / "out.bam")
     stream_call_consensus(path, out, GP, CP, **KW)
     assert plan.n_fired >= 1  # the schedule really injected

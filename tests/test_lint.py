@@ -160,6 +160,28 @@ class TestDurabilityProtocol:
         )
         assert res.ok
 
+    def test_serve_layer_is_in_scope(self):
+        # the serving layer's crash-recovery story rests on the queue
+        # journal being durable: serve/ writes are held to the protocol
+        res = lint(
+            {"pkg/serve/q.py": """
+                def journal(path, payload):
+                    with open(path, "w") as f:
+                        f.write(payload)
+                """},
+            rules=["durability-protocol"],
+        )
+        assert rules_of(res) == [("durability-protocol", "pkg/serve/q.py")]
+        ok = lint(
+            {"pkg/serve/q.py": """
+                from pkg.io.durable import write_durable
+                def journal(path, payload):
+                    write_durable(path, payload)
+                """},
+            rules=["durability-protocol"],
+        )
+        assert ok.ok
+
     def test_mode_keyword_is_seen(self):
         res = lint(
             {"pkg/runtime/w.py":
@@ -588,10 +610,20 @@ class TestShippedTree:
         targets = set(default_targets(REPO))
         for must in (
             "tools/dutlint.py", "tools/check_trace.py",
-            "tools/trace_report.py", "tests/test_chaos.py",
-            "tests/test_telemetry.py",
+            "tools/trace_report.py", "tools/serve_report.py",
+            # the profiling/tuning tools carry the same clock +
+            # durability obligations as the report tools; anchoring
+            # them here means clock/durability drift in any tool is
+            # gate-visible, not just in check_trace/trace_report
+            "tools/profile_components.py", "tools/profile_phases.py",
+            "tools/tune_ssc.py",
+            "tests/test_chaos.py", "tests/test_telemetry.py",
             os.path.join("duplexumiconsensusreads_tpu", "runtime",
                          "stream.py"),
+            os.path.join("duplexumiconsensusreads_tpu", "serve",
+                         "queue.py"),
+            os.path.join("duplexumiconsensusreads_tpu", "serve",
+                         "service.py"),
         ):
             assert must.replace("/", os.sep) in {
                 t.replace("/", os.sep) for t in targets

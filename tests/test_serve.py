@@ -1,0 +1,675 @@
+"""serve/: the multi-job consensus service.
+
+Every claim the serving layer makes is pinned to an observable
+contract on tiny inputs:
+
+  * outputs through the service are BYTE-IDENTICAL to one-shot
+    ``stream_call_consensus`` runs of the same jobs (the soak
+    acceptance), under preemption, priorities and concurrency;
+  * a killed daemon loses no accepted job and double-runs none —
+    whether the kill lands before admission, between accept and
+    dispatch (the queue-journal crash-recovery satellite), or mid-job;
+  * SIGTERM drains gracefully: in-flight work checkpoints, the queue
+    journals, the process exits 0, and a restarted daemon finishes
+    exactly the remaining work;
+  * the service telemetry capture validates against the service schema
+    and decomposes per job (check_trace / serve_report).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from duplexumiconsensusreads_tpu.io import simulated_bam
+from duplexumiconsensusreads_tpu.runtime import faults
+from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+from duplexumiconsensusreads_tpu.serve import (
+    ConsensusService,
+    FairScheduler,
+    SpoolQueue,
+    client,
+)
+from duplexumiconsensusreads_tpu.serve.job import (
+    job_params,
+    spec_signature,
+    validate_spec,
+)
+from duplexumiconsensusreads_tpu.simulate import SimConfig
+from duplexumiconsensusreads_tpu.telemetry import report as trace_report
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the same tiny streaming workload the chaos suite uses: ~7 chunks, so
+# budgets/preemptions/kills all have room to land
+CONFIG = dict(grouping="adjacency", mode="duplex", capacity=128, chunk_reads=90)
+GP = GroupingParams(strategy="adjacency", paired=True)
+CP = ConsensusParams(mode="duplex")
+
+
+@pytest.fixture(scope="module")
+def sim(tmp_path_factory):
+    """(input path, reference output bytes): what every service-run
+    output must reproduce exactly. The one-shot reference carries the
+    job's canonical provenance line — a service output's bytes are a
+    pure function of (input, config), independent of which process
+    (this one, a daemon, a restarted daemon) finished it."""
+    from duplexumiconsensusreads_tpu.serve.job import serve_provenance
+
+    d = tmp_path_factory.mktemp("serve")
+    path = str(d / "in.bam")
+    cfg = SimConfig(n_molecules=70, n_positions=9, umi_error=0.02, seed=31)
+    simulated_bam(cfg, path=path, sort=True)
+    ref = str(d / "ref.bam")
+    rep = stream_call_consensus(
+        path, ref, GP, CP, capacity=128, chunk_reads=90,
+        provenance_cl=serve_provenance(CONFIG),
+    )
+    assert rep.n_chunks >= 3
+    with open(ref, "rb") as f:
+        return path, f.read()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.uninstall()
+
+
+def _spec(job_id="job-x", **over):
+    d = {"job_id": job_id, "input": "/i.bam", "output": "/o.bam",
+         "config": dict(CONFIG)}
+    d.update(over)
+    return d
+
+
+# ------------------------------------------------------------- job specs
+
+class TestJobSpec:
+    def test_roundtrip_and_defaults_mirror_cli(self):
+        spec = validate_spec(_spec(config={}))
+        gp, cp, kw = job_params(spec)
+        # the empty-config job runs exactly what a bare `call` would
+        assert gp == GroupingParams(
+            strategy="exact", max_hamming=1, count_ratio=2, paired=False
+        )
+        assert cp == ConsensusParams()
+        assert kw["capacity"] == 2048 and kw["chunk_reads"] == 500_000
+        assert kw["read_group"] == "A" and kw["mate_aware"] == "auto"
+
+    def test_duplex_config_maps_to_params(self):
+        gp, cp, kw = job_params(validate_spec(_spec()))
+        assert gp.paired and cp.mode == "duplex"
+        assert kw["capacity"] == 128 and kw["chunk_reads"] == 90
+
+    @pytest.mark.parametrize("bad", [
+        {"config": {"chunk_reads": 0}},          # whole-file: not servable
+        {"config": {"grouping": "fuzzy"}},       # invalid choice
+        {"config": {"frobnicate": 1}},           # unknown key
+        {"priority": -1},
+        {"priority": True},                      # bool is not a priority
+        {"chaos": "bogus.site:1:oserror"},       # bad schedule
+        {"job_id": ""},
+        {"extra_field": 1},
+    ])
+    def test_rejects_bad_specs(self, bad):
+        with pytest.raises(ValueError):
+            validate_spec(_spec(**bad))
+
+    def test_spec_signature_is_the_compile_identity(self):
+        a = validate_spec(_spec())
+        b = validate_spec(_spec(job_id="job-y", output="/other.bam"))
+        c = validate_spec(_spec(job_id="job-z",
+                                config={**CONFIG, "capacity": 256}))
+        # same bucket spec -> same signature, capacity change -> new one
+        assert spec_signature(a) == spec_signature(b)
+        assert spec_signature(a) != spec_signature(c)
+
+
+# ------------------------------------------------------------- scheduler
+
+class TestFairScheduler:
+    def test_priority_then_fifo_within_class(self):
+        jobs = {
+            "a": {"state": "queued", "priority": 1, "seq": 0},
+            "b": {"state": "queued", "priority": 0, "seq": 5},
+            "c": {"state": "queued", "priority": 1, "seq": 1},
+        }
+        assert FairScheduler.pick(jobs) == "b"  # urgent class first
+        jobs["b"]["state"] = "done"
+        assert FairScheduler.pick(jobs) == "a"  # FIFO inside class 1
+        jobs["a"]["state"] = "running"
+        assert FairScheduler.pick(jobs) == "c"
+        jobs["c"]["state"] = "done"
+        assert FairScheduler.pick(jobs) is None
+
+    def test_budget_yield_only_to_equal_or_more_urgent(self):
+        jobs = {
+            "running0": {"state": "running", "priority": 0, "seq": 0},
+            "waiting1": {"state": "queued", "priority": 1, "seq": 1},
+        }
+        # yielding to a strictly less urgent waiter would just re-pick
+        # the yielder: no preemption
+        assert not FairScheduler.others_waiting(jobs, "running0")
+        assert FairScheduler.others_waiting(jobs, "waiting1") is False
+        jobs["waiting1"]["priority"] = 0
+        assert FairScheduler.others_waiting(jobs, "running0")
+
+    def test_budget_validation(self):
+        with pytest.raises(ValueError):
+            FairScheduler(chunk_budget=-1)
+
+
+# ----------------------------------------------------------- spool queue
+
+class TestSpoolQueue:
+    def test_accept_journals_then_unlinks_and_dedupes(self, tmp_path):
+        q = SpoolQueue(str(tmp_path))
+        jid = client.submit(str(tmp_path), __file__, str(tmp_path / "o.bam"),
+                            config=dict(CONFIG))
+        inbox = tmp_path / "inbox" / f"{jid}.json"
+        assert inbox.exists()
+        spec, reason = q.accept_one(jid)
+        assert spec is not None and reason is None
+        assert not inbox.exists()
+        assert q.jobs[jid]["state"] == "queued"
+        # a fresh queue instance sees the durable journal
+        q2 = SpoolQueue(str(tmp_path))
+        assert q2.jobs[jid]["state"] == "queued"
+        # duplicate submission file for an already-journaled id: cleaned
+        # up, never double-entered (the kill-between-journal-and-unlink
+        # window)
+        inbox.write_text(json.dumps(q.jobs[jid]["spec"]))
+        spec2, reason2 = q2.accept_one(jid)
+        assert spec2 is None and reason2 is None
+        assert not inbox.exists() and q2.jobs[jid]["seq"] == q.jobs[jid]["seq"]
+
+    def test_bounded_admission_rejects_with_reason(self, tmp_path):
+        q = SpoolQueue(str(tmp_path), max_queue=1)
+        j1 = client.submit(str(tmp_path), __file__, str(tmp_path / "a.bam"),
+                           config=dict(CONFIG))
+        j2 = client.submit(str(tmp_path), __file__, str(tmp_path / "b.bam"),
+                           config=dict(CONFIG))
+        assert q.accept_one(j1)[0] is not None
+        spec, reason = q.accept_one(j2)
+        assert spec is None and "queue full" in reason
+        assert q.status(j2)["state"] == "rejected"
+
+    def test_invalid_submission_is_rejected_not_fatal(self, tmp_path):
+        q = SpoolQueue(str(tmp_path))
+        bad = tmp_path / "inbox" / "job-bad.json"
+        bad.write_text('{"job_id": "job-bad"}')  # no input/output
+        spec, reason = q.accept_one("job-bad")
+        assert spec is None and "input" in reason
+        assert q.status("job-bad")["state"] == "rejected"
+
+    def test_torn_journal_is_discarded_never_fatal(self, tmp_path):
+        (tmp_path / "queue.json").write_text('{"jobs": [garbage')
+        q = SpoolQueue(str(tmp_path))
+        assert q.jobs == {}
+
+    def test_status_states(self, tmp_path):
+        q = SpoolQueue(str(tmp_path))
+        assert q.status("job-nope")["state"] == "unknown"
+        jid = client.submit(str(tmp_path), __file__, str(tmp_path / "o.bam"),
+                            config=dict(CONFIG))
+        assert q.status(jid)["state"] == "submitted"
+
+    def test_journal_compaction_bounds_terminal_entries(self, tmp_path):
+        """A long-lived daemon's journal is rewritten+fsynced on every
+        transition, so it must stay bounded: terminal entries beyond
+        the cap compact away, and status() still answers for them from
+        the durable results/ file."""
+        q = SpoolQueue(str(tmp_path), max_terminal_kept=2)
+        jids = []
+        for i in range(4):
+            jid = client.submit(
+                str(tmp_path), __file__, str(tmp_path / f"o{i}.bam"),
+                config=dict(CONFIG),
+            )
+            assert q.accept_one(jid)[0] is not None
+            q.mark_failed(jid, f"boom {i}")
+            jids.append(jid)
+        on_disk = json.load(open(tmp_path / "queue.json"))
+        assert set(on_disk["jobs"]) == set(jids[-2:])  # oldest 2 compacted
+        st = q.status(jids[0])
+        assert st["state"] == "failed" and st["compacted"]
+        assert "boom 0" in st["result"]["error"]
+        # open jobs are never compacted, whatever the cap
+        live = client.submit(str(tmp_path), __file__,
+                             str(tmp_path / "live.bam"), config=dict(CONFIG))
+        q.accept_one(live)
+        q.save()
+        assert q.status(live)["state"] == "queued"
+
+
+# --------------------------------------------------------------- service
+
+def _submit_n(spool, in_path, tmp_path, n, priority=None, prefix="out"):
+    jobs = []
+    for i in range(n):
+        out = str(tmp_path / f"{prefix}{i}.bam")
+        jobs.append((
+            client.submit(
+                spool, in_path, out, config=dict(CONFIG),
+                priority=(priority[i] if priority else 1),
+            ),
+            out,
+        ))
+    return jobs
+
+
+def _events(trace_path):
+    recs = trace_report.load_trace(trace_path)
+    return recs, [r for r in recs if r.get("type") == "event"]
+
+
+class TestServiceSoak:
+    def test_three_jobs_byte_identical_and_observable(self, sim, tmp_path):
+        """The acceptance soak: N>=3 jobs through the service match the
+        one-shot reference byte for byte, the capture validates, and
+        the client verbs answer."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        trace = str(tmp_path / "service.jsonl")
+        jobs = _submit_n(spool, in_path, tmp_path, 3, priority=[1, 0, 1])
+        svc = ConsensusService(
+            spool, chunk_budget=2, trace_path=trace, heartbeat_s=0.05
+        )
+        snap = svc.run_until_idle()
+        assert snap["jobs_done"] == 3 and snap["jobs_failed"] == 0
+        for jid, out in jobs:
+            with open(out, "rb") as f:
+                assert f.read() == ref_bytes
+            st = client.status(spool, jid)
+            assert st["state"] == "done"
+            assert st["result"]["n_consensus"] > 0
+            assert client.wait(spool, jid, timeout_s=1)["state"] == "done"
+        # the second+ jobs share the first job's bucket spec: warm
+        assert svc.worker.n_spec_hits == 2 and svc.worker.n_spec_misses == 1
+        # live metrics snapshot was maintained
+        with open(os.path.join(spool, "metrics.json")) as f:
+            metrics = json.load(f)
+        assert metrics["jobs_done"] == 3
+        assert set(metrics["job_seconds"]) == {j for j, _ in jobs}
+        # the capture validates as a service capture, with a summary
+        recs, events = _events(trace)
+        assert trace_report.validate_service_trace(recs) == []
+        assert trace_report.capture_kind(recs) == "service"
+        assert trace_report.summary_record(recs) is not None
+        names = {e["name"] for e in events}
+        assert {"job_accepted", "job_started", "job_completed"} <= names
+        hb = [e for e in events if e["name"] == "heartbeat"]
+        assert all("queue_depth" in e and "jobs_inflight" in e for e in hb)
+
+    def test_check_trace_and_serve_report_cli(self, sim, tmp_path):
+        in_path, _ = sim
+        spool = str(tmp_path / "spool")
+        trace = str(tmp_path / "svc.jsonl")
+        _submit_n(spool, in_path, tmp_path, 2)
+        ConsensusService(spool, chunk_budget=1, trace_path=trace).run_until_idle()
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+             trace, "--require-summary"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0, p.stderr
+        assert "service capture" in p.stderr
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_report.py"),
+             trace, "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0, p.stderr
+        rep = json.loads(p.stdout)
+        assert rep["n_jobs"] == 2 and rep["n_done"] == 2
+        assert rep["clean_shutdown"] is True
+        assert rep["n_preemptions"] >= 1  # budget=1 with a waiter
+        # human rendering exercises the same capture
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_report.py"),
+             trace],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0 and "2 jobs" in p.stdout
+
+    def test_service_schema_rejects_anonymous_job_events(self, tmp_path):
+        from duplexumiconsensusreads_tpu.telemetry.trace import TraceRecorder
+
+        path = str(tmp_path / "bad.jsonl")
+        tr = TraceRecorder(path, kind="service")
+        tr.event("job_started", job="j1", lane="main")  # wrong lane
+        tr.event("job_completed")  # no job at all
+        tr.close()
+        probs = trace_report.validate_service_trace(
+            trace_report.load_trace(path)
+        )
+        assert any("lane 'job-j1'" in p for p in probs)
+        assert any("without a job id" in p for p in probs)
+        # and a RUN capture must not be accepted by the service schema
+        run_tr = TraceRecorder(str(tmp_path / "run.jsonl"))
+        run_tr.close()
+        probs = trace_report.validate_service_trace(
+            trace_report.load_trace(str(tmp_path / "run.jsonl"))
+        )
+        assert any('kind="service"' in p for p in probs)
+
+    def test_preemption_interleaves_equal_priority_jobs(self, sim, tmp_path):
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        trace = str(tmp_path / "svc.jsonl")
+        jobs = _submit_n(spool, in_path, tmp_path, 2)
+        ConsensusService(spool, chunk_budget=1, trace_path=trace).run_until_idle()
+        for _, out in jobs:
+            with open(out, "rb") as f:
+                assert f.read() == ref_bytes
+        _, events = _events(trace)
+        starts = [e["job"] for e in events if e["name"] == "job_started"]
+        preempts = [e for e in events if e["name"] == "job_preempted"]
+        assert len(preempts) >= 2
+        assert all(p["reason"] == "budget" for p in preempts)
+        # budget=1 with both jobs waiting: consecutive slices alternate
+        # between the two jobs until one finishes
+        flips = sum(1 for a, b in zip(starts, starts[1:]) if a != b)
+        assert flips >= 2
+
+    def test_failed_job_does_not_take_down_the_service(self, sim, tmp_path):
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        bad = client.submit(
+            spool, __file__, str(tmp_path / "bad.bam"), config=dict(CONFIG)
+        )  # a Python file is not a BAM: the slice must fail cleanly
+        good, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        svc = ConsensusService(spool, chunk_budget=0)
+        snap = svc.run_until_idle()
+        assert snap["jobs_failed"] == 1 and snap["jobs_done"] == 1
+        assert client.status(spool, bad)["state"] == "failed"
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        # the failed slice compiled nothing, so it must NOT have warmed
+        # its spec signature: the good job (same signature, ran second)
+        # still counts as a cold start
+        assert svc.worker.n_spec_hits == 0 and svc.worker.n_spec_misses == 2
+
+
+class TestCrashRecovery:
+    def test_kill_between_accept_and_dispatch_runs_exactly_once(
+        self, sim, tmp_path
+    ):
+        """The queue-journal crash-recovery satellite: journal save #1
+        is the admission write, #2 is mark_running — a kill there lands
+        AFTER the job is durably accepted and BEFORE any work was
+        dispatched. The restarted daemon must run it exactly once and
+        produce the one-shot bytes."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        faults.install(faults.FaultPlan.parse("serve.journal:2:kill"))
+        t1 = str(tmp_path / "svc1.jsonl")
+        with pytest.raises(faults.InjectedKill):
+            ConsensusService(spool, trace_path=t1).run_until_idle()
+        # the job was durably accepted (journal #1) and never started
+        assert SpoolQueue(spool).jobs[jid]["state"] == "queued"
+        assert not os.path.exists(out)
+        _, ev1 = _events(t1)
+        assert [e for e in ev1 if e["name"] == "job_started"] == []
+        # restart on the same spool: the job runs exactly once
+        t2 = str(tmp_path / "svc2.jsonl")
+        snap = ConsensusService(spool, trace_path=t2).run_until_idle()
+        assert snap["jobs_done"] == 1
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        _, ev2 = _events(t2)
+        assert len([e for e in ev2 if e["name"] == "job_started"]) == 1
+        assert len([e for e in ev2 if e["name"] == "job_completed"]) == 1
+
+    def test_kill_before_admission_loses_no_submission(self, sim, tmp_path):
+        """Kill during the admission read itself: the inbox file is
+        untouched, so restart re-admits and runs the job."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        faults.install(faults.FaultPlan.parse("serve.accept:1:kill"))
+        with pytest.raises(faults.InjectedKill):
+            ConsensusService(spool).run_until_idle()
+        assert os.path.exists(
+            os.path.join(spool, "inbox", jid + ".json")
+        )
+        snap = ConsensusService(spool).run_until_idle()
+        assert snap["jobs_done"] == 1 and snap["jobs_accepted"] == 1
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+
+    def test_kill_mid_job_resumes_from_checkpoint(self, sim, tmp_path):
+        """A kill inside a running slice (stream site) leaves the job
+        journaled RUNNING; the restarted daemon requeues it and the
+        resumed slice converges to the one-shot bytes."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        faults.install(faults.FaultPlan.parse("shard.write:3:kill"))
+        with pytest.raises(faults.InjectedKill):
+            ConsensusService(spool).run_until_idle()
+        assert SpoolQueue(spool).jobs[jid]["state"] == "running"
+        t2 = str(tmp_path / "svc2.jsonl")
+        snap = ConsensusService(spool, trace_path=t2).run_until_idle()
+        assert snap["jobs_done"] == 1 and snap["jobs_recovered"] == 1
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        recs, ev2 = _events(t2)
+        # the restart recorded the recovery decision
+        assert any(
+            e["name"] == "resume" and e.get("decision") == "requeued_running"
+            for e in ev2
+        )
+
+
+class TestGracefulDrain:
+    def test_drain_mid_queue_then_restart_completes_everything(
+        self, sim, tmp_path
+    ):
+        """The SIGTERM contract, in-process: drain after the first
+        completion, restart, and every job ends done exactly once with
+        one-shot bytes."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jobs = _submit_n(spool, in_path, tmp_path, 3)
+        t1 = str(tmp_path / "svc1.jsonl")
+        svc = ConsensusService(spool, chunk_budget=0, trace_path=t1,
+                               poll_s=0.05)
+        done = {}
+        th = threading.Thread(target=lambda: done.setdefault("snap", svc.run()))
+        th.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if svc.stats()["jobs_done"] >= 1:
+                break
+            time.sleep(0.02)
+        svc.request_drain()
+        th.join(timeout=60)
+        assert not th.is_alive() and "snap" in done
+        q = SpoolQueue(spool)
+        states = {jid: q.jobs[jid]["state"] for jid, _ in jobs if jid in q.jobs}
+        # nothing lost, nothing stuck running
+        assert all(s in ("done", "queued") for s in states.values())
+        n_done_1 = sum(1 for s in states.values() if s == "done")
+        assert n_done_1 >= 1
+        t2 = str(tmp_path / "svc2.jsonl")
+        snap2 = ConsensusService(spool, trace_path=t2).run_until_idle()
+        assert snap2["jobs_done"] == 3 - n_done_1
+        for jid, out in jobs:
+            assert client.status(spool, jid)["state"] == "done"
+            with open(out, "rb") as f:
+                assert f.read() == ref_bytes
+        # no double-run: each job completed exactly once across both
+        # daemon lifetimes
+        _, ev1 = _events(t1)
+        _, ev2 = _events(t2)
+        completed = [
+            e["job"] for e in ev1 + ev2 if e["name"] == "job_completed"
+        ]
+        assert sorted(completed) == sorted(j for j, _ in jobs)
+
+    def test_drain_preempts_running_job_at_chunk_boundary(
+        self, sim, tmp_path
+    ):
+        """Drain during a long job: the slice yields with reason=drain,
+        the job re-journals as queued, and the restart resumes it from
+        its checkpoint (skipping the committed prefix) to identical
+        bytes."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        t1 = str(tmp_path / "svc1.jsonl")
+        svc = ConsensusService(spool, chunk_budget=1, trace_path=t1,
+                               poll_s=0.05)
+        # request the drain from the executor's own chunk-commit path
+        # (the budget check consults should_yield after the first fresh
+        # chunk) — deterministic mid-job drain, no sleeps
+        orig = svc.worker.run_slice
+
+        def run_slice_then_drain(spec, budget, should_yield, drain_event):
+            def drain_not_yield():
+                svc.request_drain()
+                return False
+            return orig(spec, budget, drain_not_yield, drain_event)
+
+        svc.worker.run_slice = run_slice_then_drain
+        snap = svc.run()
+        assert snap["preemptions"] == 1 and snap["jobs_done"] == 0
+        _, ev1 = _events(t1)
+        pre = [e for e in ev1 if e["name"] == "job_preempted"]
+        assert len(pre) == 1 and pre[0]["reason"] == "drain"
+        assert pre[0]["chunks_done"] >= 1
+        assert SpoolQueue(spool).jobs[jid]["state"] == "queued"
+        t2 = str(tmp_path / "svc2.jsonl")
+        snap2 = ConsensusService(spool, trace_path=t2).run_until_idle()
+        assert snap2["jobs_done"] == 1
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        # the second daemon finished the job in its SECOND slice — the
+        # committed prefix came from the first daemon's checkpoint
+        assert SpoolQueue(spool).jobs[jid]["slices"] == 2
+
+    def test_sigterm_daemon_subprocess_exits_zero_and_resumes(
+        self, sim, tmp_path
+    ):
+        """The real daemon under a real SIGTERM: exit code 0, queue
+        journaled, and a --once restart finishes the work."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jobs = _submit_n(spool, in_path, tmp_path, 2)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "duplexumiconsensusreads_tpu.serve.daemon",
+             spool, "--poll", "0.05", "--heartbeat", "0.2",
+             "--chunk-budget", "2"],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if any(
+                    client.status(spool, jid)["state"] == "done"
+                    for jid, _ in jobs
+                ):
+                    break
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.1)
+            assert proc.poll() is None, proc.communicate()[1]
+            proc.send_signal(signal.SIGTERM)
+            out_s, err_s = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        assert proc.returncode == 0, err_s
+        assert "graceful drain" in err_s
+        # restart in batch mode finishes whatever remained
+        p2 = subprocess.run(
+            [sys.executable, "-m", "duplexumiconsensusreads_tpu.serve.daemon",
+             spool, "--once", "--poll", "0.05", "--heartbeat", "0"],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+        assert p2.returncode == 0, p2.stderr
+        for jid, out in jobs:
+            assert client.status(spool, jid)["state"] == "done"
+            with open(out, "rb") as f:
+                assert f.read() == ref_bytes
+
+
+# ------------------------------------------------------------ CLI verbs
+
+class TestCliVerbs:
+    def test_submit_status_wait_roundtrip(self, sim, tmp_path, capsys):
+        from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "cli_out.bam")
+        rc = cli_main([
+            "call", in_path, "-o", out, "--submit", "--spool", spool,
+            "--grouping", "adjacency", "--mode", "duplex",
+            "--capacity", "128", "--chunk-reads", "90",
+        ])
+        assert rc == 0
+        jid = capsys.readouterr().out.strip()
+        assert jid.startswith("job-")
+        rc = cli_main(["call", "--status", jid, "--spool", spool])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["state"] == "submitted"
+        # a daemon drains it; --wait then reports done
+        ConsensusService(spool).run_until_idle()
+        rc = cli_main(["call", "--wait", jid, "--spool", spool,
+                       "--wait-timeout", "5"])
+        assert rc == 0
+        st = json.loads(capsys.readouterr().out)
+        assert st["state"] == "done"
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+
+    def test_unknown_job_and_usage_errors(self, tmp_path, capsys):
+        from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+
+        spool = str(tmp_path / "spool")
+        rc = cli_main(["call", "--status", "job-nope", "--spool", spool])
+        assert rc == 1
+        assert json.loads(capsys.readouterr().out)["state"] == "unknown"
+        with pytest.raises(SystemExit, match="spool"):
+            cli_main(["call", "--status", "job-x"])
+        with pytest.raises(SystemExit, match="INPUT"):
+            cli_main(["call"])
+        with pytest.raises(SystemExit, match="chunk-reads"):
+            cli_main(["call", __file__, "-o", str(tmp_path / "o.bam"),
+                      "--submit", "--spool", spool, "--chunk-reads", "0"])
+        with pytest.raises(SystemExit, match="whole-file"):
+            cli_main(["call", __file__, "-o", str(tmp_path / "o.bam"),
+                      "--submit", "--spool", spool, "--ref-projected"])
+        # flags the daemon owns are refused loudly, never silently
+        # dropped from the spooled job
+        with pytest.raises(SystemExit, match="service"):
+            cli_main(["call", __file__, "-o", str(tmp_path / "o.bam"),
+                      "--submit", "--spool", spool, "--report", "r.json"])
+        with pytest.raises(SystemExit, match="daemon-side"):
+            cli_main(["call", __file__, "-o", str(tmp_path / "o.bam"),
+                      "--submit", "--spool", spool, "--cycle-shards", "2"])
+        with pytest.raises(SystemExit, match="daemon-side"):
+            cli_main(["call", __file__, "-o", str(tmp_path / "o.bam"),
+                      "--submit", "--spool", spool, "--devices", "2"])
+
+    def test_wait_timeout_reports_not_hangs(self, sim, tmp_path):
+        in_path, _ = sim
+        spool = str(tmp_path / "spool")
+        jid, _ = _submit_n(spool, in_path, tmp_path, 1)[0]
+        st = client.wait(spool, jid, timeout_s=0.2, poll_s=0.05)
+        assert st["timed_out"] is True and st["state"] == "submitted"

@@ -1,4 +1,4 @@
-"""Schema validator for streaming-executor trace captures (CI gate).
+"""Schema validator for telemetry captures (CI gate).
 
 Run: python tools/check_trace.py trace.jsonl [--require-summary]
 
@@ -11,8 +11,16 @@ lacks the terminal summary record — i.e. one from a run that did not
 shut down cleanly — which is what the tier-1 test uses: a synthetic
 run's capture must always be COMPLETE, not merely well-formed.
 
-The rules live in telemetry/report.py (validate_trace) so the CLI, the
-tier-1 test, and trace_report.py all enforce the same contract.
+The capture KIND is read from the meta header: a ``run`` capture (the
+streaming executor's per-chunk spans, the default) gets the core
+checks; a ``service`` capture (a ``dut-serve`` daemon's job-lifecycle
+record) additionally must keep every job event on its job-scoped
+``job-<id>`` lane and every service heartbeat carrying the queue
+snapshot — the contract ``tools/serve_report.py`` decomposes.
+
+The rules live in telemetry/report.py (validate_trace /
+validate_service_trace) so the CLI, the tier-1 tests, and the report
+tools all enforce the same contract.
 """
 
 from __future__ import annotations
@@ -42,7 +50,11 @@ def main(argv: list[str] | None = None) -> int:
     except (OSError, ValueError) as e:
         print(f"check_trace: {e}", file=sys.stderr)
         return 1
-    problems = report.validate_trace(records)
+    kind = report.capture_kind(records)
+    if kind == "service":
+        problems = report.validate_service_trace(records)
+    else:
+        problems = report.validate_trace(records)
     if args.require_summary and report.summary_record(records) is None:
         problems.append("no terminal summary record (unclean shutdown?)")
     if problems:
@@ -53,7 +65,7 @@ def main(argv: list[str] | None = None) -> int:
     n_events = sum(1 for r in records if r.get("type") == "event")
     print(
         f"[check_trace] {args.trace}: OK "
-        f"({n_spans} spans, {n_events} events)",
+        f"({kind} capture, {n_spans} spans, {n_events} events)",
         file=sys.stderr,
     )
     return 0

@@ -1,0 +1,179 @@
+"""Summarise a ``dut-serve`` service capture (kind="service" JSONL).
+
+Run: python tools/serve_report.py SPOOL/service.trace.jsonl [--json]
+
+The service capture records the daemon's whole life: admissions,
+per-job slices/preemptions/completions on ``job-<id>`` lanes, service
+heartbeats carrying the queue snapshot, and every switchboard event
+(fault/retry/durable) that fired while jobs ran. This tool decomposes
+it the way ``trace_report.py`` decomposes a run capture:
+
+  * per job: state, slices, preemptions, total slice wall, final
+    chunk/consensus counts, warm (compile-cache hit) or cold start,
+    and the per-phase busy seconds the completing slice reported;
+  * service: admission/completion/failure counts, preemption total,
+    compile-cache hit rate, queue-depth curve (max/mean over the
+    heartbeats), retry/fault event counts.
+
+Exit 1 on a capture that fails the service schema
+(telemetry/report.validate_service_trace) — a malformed capture must
+fail CI the same way a malformed run capture does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def summarize(records: list[dict]) -> dict:
+    jobs: dict[str, dict] = {}
+    hb_depths: list[float] = []
+    n_faults = n_retries = 0
+    for rec in records:
+        if rec.get("type") != "event":
+            continue
+        name = rec.get("name")
+        if name == "heartbeat":
+            d = rec.get("queue_depth")
+            if isinstance(d, (int, float)):
+                hb_depths.append(float(d))
+            continue
+        if name == "fault_injected":
+            n_faults += 1
+            continue
+        if name == "retry":
+            n_retries += 1
+            continue
+        if not isinstance(name, str) or not name.startswith("job_"):
+            continue
+        job = rec.get("job")
+        if not isinstance(job, str):
+            continue
+        j = jobs.setdefault(
+            job,
+            {"state": "accepted", "slices": 0, "preemptions": 0,
+             "wall_s": 0.0, "warm": None},
+        )
+        if name == "job_accepted":
+            j["priority"] = rec.get("priority")
+        elif name == "job_rejected":
+            j["state"] = "rejected"
+            j["error"] = rec.get("reason")
+        elif name == "job_started":
+            j["slices"] += 1
+            if j["warm"] is None:
+                j["warm"] = bool(rec.get("warm"))
+        elif name == "job_preempted":
+            j["preemptions"] += 1
+            j["wall_s"] = round(j["wall_s"] + float(rec.get("wall_s") or 0), 3)
+            j["chunks_done"] = rec.get("chunks_done")
+        elif name == "job_completed":
+            j["state"] = "done"
+            j["wall_s"] = round(j["wall_s"] + float(rec.get("wall_s") or 0), 3)
+            j["n_chunks"] = rec.get("n_chunks")
+            j["n_consensus"] = rec.get("n_consensus")
+            sec = rec.get("seconds")
+            if isinstance(sec, dict):
+                j["seconds"] = sec
+        elif name == "job_failed":
+            j["state"] = "failed"
+            j["error"] = rec.get("error")
+    last = records[-1] if records else {}
+    summary = last if isinstance(last, dict) and last.get("type") == "summary" else {}
+    counters = summary.get("counters") if isinstance(summary, dict) else None
+    done = sum(1 for j in jobs.values() if j["state"] == "done")
+    failed = sum(1 for j in jobs.values() if j["state"] == "failed")
+    warm_known = [j for j in jobs.values() if j["warm"] is not None]
+    out = {
+        "n_jobs": len(jobs),
+        "n_done": done,
+        "n_failed": failed,
+        "n_rejected": sum(1 for j in jobs.values() if j["state"] == "rejected"),
+        "n_preemptions": sum(j["preemptions"] for j in jobs.values()),
+        "n_warm_starts": sum(1 for j in warm_known if j["warm"]),
+        "n_cold_starts": sum(1 for j in warm_known if not j["warm"]),
+        "n_fault_events": n_faults,
+        "n_retry_events": n_retries,
+        "queue_depth_max": max(hb_depths) if hb_depths else 0,
+        "queue_depth_mean": (
+            round(sum(hb_depths) / len(hb_depths), 2) if hb_depths else 0
+        ),
+        "clean_shutdown": bool(summary),
+        "jobs": jobs,
+    }
+    if isinstance(counters, dict):
+        out["service_counters"] = counters
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve_report.py",
+        description="summarise a dut-serve service telemetry capture",
+    )
+    ap.add_argument("trace", help="kind=\"service\" JSONL capture")
+    ap.add_argument("--json", action="store_true", help="machine-readable")
+    args = ap.parse_args(argv)
+
+    from duplexumiconsensusreads_tpu.telemetry import report
+
+    try:
+        records = report.load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"serve_report: {e}", file=sys.stderr)
+        return 1
+    problems = report.validate_service_trace(records)
+    if problems:
+        for p in problems:
+            print(f"serve_report: {args.trace}: {p}", file=sys.stderr)
+        return 1
+    s = summarize(records)
+    if args.json:
+        print(json.dumps(s, sort_keys=True))
+        return 0
+    print(
+        f"service: {s['n_jobs']} jobs ({s['n_done']} done, "
+        f"{s['n_failed']} failed, {s['n_rejected']} rejected), "
+        f"{s['n_preemptions']} preemptions, "
+        f"{s['n_warm_starts']}/{s['n_warm_starts'] + s['n_cold_starts']} "
+        f"warm starts"
+        + ("" if s["clean_shutdown"] else
+           "  [no summary record: daemon did not shut down cleanly]")
+    )
+    if s["queue_depth_max"]:
+        print(
+            f"queue depth over heartbeats: max {s['queue_depth_max']:.0f} "
+            f"mean {s['queue_depth_mean']}"
+        )
+    if s["n_fault_events"] or s["n_retry_events"]:
+        print(
+            f"switchboard: {s['n_fault_events']} injected faults, "
+            f"{s['n_retry_events']} retries"
+        )
+    print(f"{'job':<18} {'state':<9} {'pri':>3} {'slices':>6} "
+          f"{'preempt':>7} {'wall_s':>8} {'warm':>5}")
+    for job_id in sorted(s["jobs"]):
+        j = s["jobs"][job_id]
+        print(
+            f"{job_id:<18} {j['state']:<9} {str(j.get('priority', '?')):>3} "
+            f"{j['slices']:>6} {j['preemptions']:>7} {j['wall_s']:>8.3f} "
+            f"{str(j['warm']):>5}"
+        )
+        sec = j.get("seconds")
+        if isinstance(sec, dict):
+            busy = {k: v for k, v in sorted(sec.items())
+                    if k not in ("total", "drain_utilization") and v}
+            if busy:
+                print(f"{'':<18}   " + " ".join(
+                    f"{k}={v:.3g}" for k, v in busy.items()
+                ))
+    return 0
+
+
+if __name__ == "__main__":
+    import os as _os
+
+    sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    raise SystemExit(main())
