@@ -1,10 +1,11 @@
 """dutlint CLI: run the invariant rules over the repo's linted set.
 
 Default file set: the whole ``duplexumiconsensusreads_tpu`` package,
-every ``tools/*.py`` script, and the two test-side registry anchors
+every ``tools/*.py`` script, and the test-side registry anchors
 (``tests/test_chaos.py`` for fault-site coverage,
-``tests/test_telemetry.py`` for the seconds-keys golden) — which are
-also linted themselves.
+``tests/test_telemetry.py`` for the seconds-keys golden,
+``tests/test_serve.py`` for serving-site lease/takeover coverage) —
+which are also linted themselves.
 
 Exit status: 0 when clean (allowlisted findings don't count, but are
 listed with their reasons under -v), 1 on any non-allowlisted finding,
@@ -27,7 +28,11 @@ from duplexumiconsensusreads_tpu.analysis.engine import (
 
 PACKAGE = "duplexumiconsensusreads_tpu"
 # test files the cross-file rules anchor on; linted like everything else
-TEST_ANCHORS = ("tests/test_chaos.py", "tests/test_telemetry.py")
+TEST_ANCHORS = (
+    "tests/test_chaos.py",
+    "tests/test_telemetry.py",
+    "tests/test_serve.py",
+)
 
 
 def repo_root() -> str:

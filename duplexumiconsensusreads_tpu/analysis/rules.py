@@ -545,6 +545,144 @@ def _mutated_module_name(node: ast.AST, names: set[str]) -> str | None:
     return None
 
 
+# ----------------------------------------------- rule: lease discipline
+
+@register(
+    "lease-discipline",
+    "serve/ lease/journal state may only move durably: fenced sites "
+    "registered, serving-suite covered, mutations persisted",
+)
+def check_lease_discipline(corpus: Corpus) -> Iterator[Finding]:
+    """The fleet's exactly-once story rests on three conventions that
+    drift independently of the generic rules:
+
+    (a) every ``serve.*`` fault-site literal used in ``serve/`` (at
+        ``fault_point``/``_io_retry``) is registered in
+        ``faults.KNOWN_SITES`` — a typo'd lease site would silently
+        skip chaos coverage of a step the takeover proof depends on;
+    (b) every registered ``serve.*`` site is exercised by the serving
+        suite (``tests/test_serve.py``) AS A LITERAL — the chaos
+        blanket parametrize covers transients generically, but the
+        lease/fence/expire sites also need the serving-layer kill/
+        takeover scenarios, which only live there;
+    (c) in ``serve/queue.py``, any function that mutates lease state
+        (a ``"lease"``/``"token"`` key assignment, or popping the
+        lease) must durably persist in the same function (``save``/
+        ``write_durable``) — an in-memory-only lease transition is a
+        fleet split-brain the moment two daemons read the journal."""
+    faults_path = corpus.find("runtime/faults.py")
+    known: set[str] = set()
+    if faults_path is not None:
+        sites, _ = str_tuple_assign(corpus.trees[faults_path], "KNOWN_SITES")
+        known = set(sites)
+
+    # (a) serve.* literals at fault hooks inside serve/ must be registered
+    for path, tree in corpus.trees.items():
+        parts = path.split("/")
+        if "serve" not in parts[:-1]:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if call_name(node) not in ("fault_point", "_io_retry"):
+                continue
+            site = str_const(node.args[0])
+            if site is None or not site.startswith("serve."):
+                continue
+            if known and site not in known:
+                yield Finding(
+                    rule="lease-discipline",
+                    path=path,
+                    line=node.lineno,
+                    message=f"serving fault site {site!r} is not registered "
+                    f"in faults.KNOWN_SITES",
+                    hint="register it (and cover it in tests/test_serve.py) "
+                    "or fix the typo",
+                )
+
+    # (b) registered serve.* sites must be serving-suite literals
+    serve_anchor = corpus.find("tests/test_serve.py")
+    if serve_anchor is not None and known:
+        roots: list[ast.AST] = []
+        for node in ast.walk(corpus.trees[serve_anchor]):
+            if isinstance(node, ast.Call):
+                roots.extend(node.args)
+                roots.extend(kw.value for kw in node.keywords)
+            elif isinstance(node, ast.Assign):
+                roots.append(node.value)
+        literals = [
+            lit
+            for root in roots
+            for sub in ast.walk(root)
+            if (lit := str_const(sub)) is not None
+        ]
+        for site in sorted(s for s in known if s.startswith("serve.")):
+            if not any(site in lit for lit in literals):
+                yield Finding(
+                    rule="lease-discipline",
+                    path=serve_anchor,
+                    line=1,
+                    message=f"serving fault site {site!r} is never "
+                    f"exercised by the serving suite",
+                    hint="add a kill/takeover (or registry-pin) case "
+                    "naming it in tests/test_serve.py",
+                )
+
+    # (c) lease-state mutations in serve/queue.py persist in-function
+    queue_path = corpus.find("serve/queue.py")
+    if queue_path is not None:
+        for fn in ast.walk(corpus.trees[queue_path]):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            line = _lease_mutation_line(fn)
+            if line is None:
+                continue
+            persists = any(
+                isinstance(n, ast.Call)
+                and (
+                    "save" in call_name(n)
+                    or call_name(n) in ("write_durable", "replace_durable")
+                )
+                for n in ast.walk(fn)
+            )
+            if not persists:
+                yield Finding(
+                    rule="lease-discipline",
+                    path=queue_path,
+                    line=line,
+                    message=f"lease state mutated in {fn.name}() without a "
+                    f"durable persist in the same function",
+                    hint="call save() (the journal's durable write) in the "
+                    "same transaction that moves lease/token state",
+                )
+
+
+def _lease_mutation_line(fn: ast.AST) -> int | None:
+    """First line in ``fn`` that mutates lease state: an assignment
+    whose target touches a ``"lease"``/``"token"`` subscript, or a
+    ``.pop("lease")`` call."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Subscript) and str_const(
+                        sub.slice
+                    ) in ("lease", "token"):
+                        return node.lineno
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "pop"
+            and node.args
+            and str_const(node.args[0]) == "lease"
+        ):
+            return node.lineno
+    return None
+
+
 # --------------------------------------------------------- rule: hook guard
 
 @register(

@@ -30,6 +30,9 @@ DUT_BENCH_VEC_REPS (3), DUT_BENCH_CACHE (default .bench_cache),
 DUT_BENCH_SERVE_JOBS (serve_n_jobs leg: jobs through the in-process
 daemon vs a cold one-shot subprocess, default 3; 0 disables),
 DUT_BENCH_SERVE_READS (reads per serve job, default 120000),
+DUT_BENCH_SERVE_DAEMONS (serve_fleet leg: in-process daemons sharing
+one spool, daemon 0 killed mid-job to measure takeover latency and
+per-class queue-wait; default 2, <2 disables),
 DUT_BENCH_TRACE (1: every e2e leg records a span capture next to the
 cache and the JSON carries per-chunk latency percentiles; 0 disables).
 """
@@ -456,6 +459,153 @@ print(json.dumps({{"wall": time.monotonic() - t0, "reads": rep.n_records}}))
     return out
 
 
+def run_serve_fleet_bench(n_daemons: int) -> dict:
+    """The ``serve_fleet`` leg: jobs submitted through ``n_daemons``
+    in-process daemons sharing ONE spool, exercising the lease/claim
+    protocol end to end under load — then daemon 0 is killed mid-job
+    (InjectedKill from its own slice, the modelled SIGKILL) and the
+    survivors take its lease over.
+
+    Emits into the BENCH JSON:
+      serve_fleet_takeover_latency_s  wall from the victim's death to
+                                      its job running again elsewhere
+                                      (dead-owner detection + claim)
+      serve_fleet_class_queue_wait    per-priority-class queue-wait
+                                      p50/p95 from metrics.json — the
+                                      admission-control SLO surface
+    """
+    import shutil
+    import threading
+
+    from duplexumiconsensusreads_tpu.runtime import faults
+    from duplexumiconsensusreads_tpu.serve import ConsensusService, client
+    from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
+
+    cache = os.environ.get("DUT_BENCH_CACHE", ".bench_cache")
+    n_reads = int(os.environ.get("DUT_BENCH_SERVE_READS", 120_000))
+    in_path, _ = _e2e_input(n_reads)
+    config = dict(
+        grouping="adjacency", mode="duplex", error_model="cycle",
+        capacity=int(os.environ.get("DUT_BENCH_CAPACITY", 2048)),
+        chunk_reads=max(n_reads // 4, 10_000),
+    )
+    spool = os.path.join(cache, "serve_fleet_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    n_jobs = max(3, n_daemons + 1)
+    outs = [os.path.join(cache, f"serve_fleet_out{i}.bam") for i in range(n_jobs)]
+    jids = [
+        # one urgent job in the mix so the per-class latency table has
+        # two rows; the rest ride the default class
+        client.submit(spool, in_path, o, config=config,
+                      priority=(0 if i == n_jobs - 1 else 1))
+        for i, o in enumerate(outs)
+    ]
+    out: dict = {"serve_fleet_daemons": n_daemons, "serve_fleet_jobs": n_jobs}
+
+    victim = ConsensusService(
+        spool, chunk_budget=0, poll_s=0.02, lease_s=5.0,
+        daemon_id="fleet-victim",
+    )
+    orig_run_slice = victim.worker.run_slice
+
+    def dying_run_slice(spec, budget, should_yield, drain_event, lease=None):
+        # one fresh chunk commits, then the budget check consults
+        # should_yield — which kills the daemon exactly as a SIGKILL
+        # mid-slice would, lease still held
+        def die():
+            raise faults.InjectedKill("serve_fleet: victim daemon killed")
+
+        return orig_run_slice(spec, 1, die, drain_event, lease=lease)
+
+    victim.worker.run_slice = dying_run_slice
+    t_dead = [0.0]
+
+    def run_victim():
+        try:
+            victim.run_until_idle()
+        except BaseException:  # noqa: BLE001 — the injected death
+            t_dead[0] = time.monotonic()
+
+    vt = threading.Thread(target=run_victim, daemon=True)
+    vt.start()
+    vt.join(timeout=600)
+    if vt.is_alive() or not t_dead[0]:
+        return {**out, "serve_fleet_error": "victim did not die on schedule"}
+    q = SpoolQueue(spool)
+    q.refresh()
+    victim_jobs = [
+        jid for jid, e in q.jobs.items() if e.get("state") == "running"
+    ]
+    if not victim_jobs:
+        return {**out, "serve_fleet_error": "victim died holding no lease"}
+
+    t0 = time.monotonic()
+    survivors = [
+        ConsensusService(spool, chunk_budget=0, poll_s=0.02, lease_s=5.0,
+                         daemon_id=f"fleet-survivor-{i}")
+        for i in range(1, n_daemons)
+    ]
+    sthreads = [
+        threading.Thread(target=s.run_until_idle, daemon=True)
+        for s in survivors
+    ]
+    for t in sthreads:
+        t.start()
+    # takeover latency: victim death -> its job running under a new
+    # lease (dead-owner detection through the in-process registry, then
+    # a fresh claim)
+    takeover = None
+    deadline = time.monotonic() + 300
+    jid0 = victim_jobs[0]
+    while time.monotonic() < deadline:
+        q.refresh()
+        e = q.jobs.get(jid0, {})
+        if e.get("state") == "done" or (
+            e.get("state") == "running"
+            and (e.get("lease") or {}).get("owner") != "fleet-victim"
+        ):
+            takeover = time.monotonic() - t_dead[0]
+            break
+        time.sleep(0.005)
+    for t in sthreads:
+        t.join(timeout=600)
+    fleet_wall = time.monotonic() - t0
+    q.refresh()
+    n_done = sum(1 for e in q.jobs.values() if e.get("state") == "done")
+    for o in outs:
+        try:
+            os.remove(o)
+        except OSError:
+            pass
+    if n_done != n_jobs:
+        return {**out, "serve_fleet_error":
+                f"fleet finished {n_done}/{n_jobs} jobs"}
+    out.update({
+        "serve_fleet_wall_s": round(fleet_wall, 2),
+        "serve_fleet_takeover_latency_s": (
+            round(takeover, 3) if takeover is not None else None
+        ),
+        "serve_fleet_recovered": sum(
+            s.counters["jobs_recovered"] for s in survivors
+        ),
+    })
+    try:
+        with open(os.path.join(spool, "metrics.json")) as f:
+            metrics = json.load(f)
+        lat = metrics.get("class_latency", {})
+        out["serve_fleet_class_queue_wait"] = {
+            pri: {
+                "p50_s": row.get("queue_wait_p50_s"),
+                "p95_s": row.get("queue_wait_p95_s"),
+                "n": row.get("n_queue_wait"),
+            }
+            for pri, row in lat.items()
+        }
+    except (OSError, ValueError):
+        pass  # metrics snapshot is best-effort observability
+    return out
+
+
 def run_cpu_e2e(n_target: int) -> dict:
     """The SAME streamed end-to-end pipeline forced onto the XLA-CPU
     backend (VERDICT r2 item 2: the >=50x north-star claim is about
@@ -834,6 +984,13 @@ def main() -> None:
         n_serve = int(os.environ.get("DUT_BENCH_SERVE_JOBS", 3))
         if n_serve > 0:
             result.update(run_serve_bench(n_serve))
+        # serve_fleet: jobs through N in-process daemons on ONE spool,
+        # with daemon 0 killed mid-job — measures dead-daemon takeover
+        # latency and per-class queue-wait under the lease protocol
+        # (DUT_BENCH_SERVE_DAEMONS<2 disables)
+        n_fleet = int(os.environ.get("DUT_BENCH_SERVE_DAEMONS", 2))
+        if n_serve > 0 and n_fleet >= 2:
+            result.update(run_serve_fleet_bench(n_fleet))
         # same pipeline end-to-end on XLA-CPU: the wall-clock >=50x
         # denominator (DUT_BENCH_CPU_E2E_READS=0 disables); runs after
         # every TPU leg so the 1-core box is never shared

@@ -549,6 +549,17 @@ def _cmd_call(args) -> int:
                 spool, args.wait, timeout_s=args.wait_timeout
             )
         print(json.dumps(st, sort_keys=True))
+        if st.get("state") == "rejected" and st.get("error"):
+            # the reason a job never ran must be one --status away, not
+            # buried in the daemon's journal: sheds (admission control)
+            # and invalid-spec rejections both name themselves
+            import sys as _sys
+
+            kind = "shed by admission control" if st.get("shed") else "rejected"
+            print(
+                f"[duplexumi] job {st.get('job_id')} {kind}: {st['error']}",
+                file=_sys.stderr,
+            )
         bad = st.get("state") in ("failed", "rejected", "unknown")
         return 1 if bad or st.get("timed_out") else 0
     if args.input is None or args.output is None:

@@ -23,6 +23,7 @@ once, at the very end.
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 # observability hook only (stdlib-only module, no import cycle): every
@@ -56,6 +57,21 @@ def fsync_dir(path: str) -> None:
         pass
     finally:
         os.close(fd)
+
+
+def unique_tmp(dst: str) -> str:
+    """A staging name no other writer of ``dst`` can collide with.
+
+    The default ``dst + ".tmp"`` staging name assumes ONE writer per
+    destination. Fleet-shared files (checkpoint manifests, chunk
+    shards, the queue journal, spool results/metrics) can be written by
+    several daemons — and, inside one daemon, by several worker
+    threads — at once: two writers interleaving bytes into one shared
+    tmp would publish a torn file under a clean atomic rename. A
+    (pid, thread) suffix keeps every in-flight staging write private;
+    the rename still serializes publication (last writer wins with a
+    complete file, never a spliced one)."""
+    return f"{dst}.tmp.{os.getpid()}.{threading.get_ident()}"
 
 
 def rewrite_from(f, offset: int, payload: bytes) -> None:

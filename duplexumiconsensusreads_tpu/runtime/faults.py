@@ -62,6 +62,12 @@ KNOWN_SITES = (
     "serve.accept",  # reading + validating a spooled job submission
     "serve.journal",  # durable admission-queue journal persist
     "serve.preempt",  # journaling a chunk-boundary preemption/requeue
+    # fleet spine (N daemons on one spool): the lease state machine's
+    # four durable steps — claim, renewal, expiry takeover, fence check
+    "serve.lease",  # durable lease claim (queued -> running + token)
+    "serve.renew",  # lease renewal (heartbeat + per-chunk commit)
+    "serve.expire",  # expired/dead-owner lease reclaim (takeover)
+    "serve.fence",  # fencing-token check before a durable commit
 )
 
 _EXC_ERRNO = {

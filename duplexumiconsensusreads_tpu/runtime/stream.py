@@ -45,6 +45,7 @@ from duplexumiconsensusreads_tpu.io.durable import (
     fsync_file,
     replace_durable,
     rewrite_from,
+    unique_tmp,
     write_durable,
 )
 from duplexumiconsensusreads_tpu.io.bam import BamHeader, BamRecords, parse_bam
@@ -769,9 +770,12 @@ class Checkpoint:
         payload = json.dumps(
             {"fingerprint": self.fingerprint, "done": self.done}
         ).encode()
+        # unique staging name: under the serve/ fleet a reclaimed job's
+        # new daemon and a not-yet-fenced zombie can both persist this
+        # manifest — private tmps keep the atomic rename torn-file-free
         _io_retry(
             "ckpt.save",
-            lambda: write_durable(self.path, payload),
+            lambda: write_durable(self.path, payload, tmp=unique_tmp(self.path)),
             "checkpoint save",
         )
 
@@ -869,6 +873,11 @@ def stream_call_consensus(
     profile_dir: str | None = None,
     cycle_shards: int = 1,
     progress=None,
+    commit_guard=None,  # called with the chunk index BEFORE each chunk's
+    # durable commit (checkpoint mark + finalise append) on the main
+    # thread. The serving layer passes its lease fence check here: a
+    # daemon whose lease was reclaimed must abort before splicing
+    # another byte, not after. Exceptions propagate unhandled.
     max_retries: int = 3,
     input_range=None,  # (start_voffset, key_lo, key_hi) — multi-host partition
     name_tag: str = "",  # disambiguates consensus names across hosts
@@ -934,7 +943,8 @@ def stream_call_consensus(
             drain_workers=drain_workers, checkpoint_path=checkpoint_path,
             resume=resume, report_path=report_path,
             profile_dir=profile_dir, cycle_shards=cycle_shards,
-            progress=progress, max_retries=max_retries,
+            progress=progress, commit_guard=commit_guard,
+            max_retries=max_retries,
             input_range=input_range, name_tag=name_tag,
             mate_aware=mate_aware, max_reads=max_reads,
             per_base_tags=per_base_tags, read_group=read_group,
@@ -967,6 +977,7 @@ def _stream_call(
     profile_dir: str | None = None,
     cycle_shards: int = 1,
     progress=None,
+    commit_guard=None,
     max_retries: int = 3,
     input_range=None,
     name_tag: str = "",
@@ -1366,6 +1377,12 @@ def _stream_call(
         its own phase ("ckpt") since PR 3: on shared pod storage the
         per-chunk manifest fsync is a real cost that used to hide
         inside "finalise"."""
+        if commit_guard is not None:
+            # fleet fence: the serving layer verifies its lease is
+            # still the job's current one BEFORE this chunk becomes
+            # durable — resumed (marked=True) chunks included, since
+            # their finalise append splices bytes all the same
+            commit_guard(k)
         shard, size, crc, n_rec, n_pairs, codec, data, marked = payload
         shards[k] = shard
         if ckpt and not marked:
@@ -1726,7 +1743,10 @@ def _write_shard(shard_dir: str, k: int, payload: bytes) -> tuple[str, int, int]
     crc = zlib.crc32(payload)
 
     def _once():
-        write_durable(path, payload)
+        # private tmp per writer: two fleet daemons recomputing the
+        # same chunk (zombie overlap) publish complete — and, bytes
+        # being a pure function of (input, config), identical — shards
+        write_durable(path, payload, tmp=unique_tmp(path))
         return path, len(payload), crc
 
     return _io_retry("shard.write", _once, f"shard {k} write")

@@ -20,6 +20,15 @@ byte-identical output. SIGTERM triggers graceful drain: finish the
 in-flight chunk, checkpoint, journal the queue, exit 0; a restarted
 daemon resumes both the queue and the interrupted job.
 
+A FLEET is N daemons on one spool: every journal mutation is a flock'd
+transaction, each job runs under exactly one daemon's durable LEASE
+(fencing token + monotonic expiry, renewed per chunk commit and per
+heartbeat), dead daemons' jobs are taken over and resumed from their
+checkpoints, zombies are fenced off before they can write a byte, and
+overload sheds by per-class policy with queue-wait / time-to-first-
+chunk percentiles in ``metrics.json`` (see ARCHITECTURE.md "Fleet &
+leases").
+
 Attribute access is lazy (PEP 562): the CLIENT side
 (``serve.client``/``serve.queue``, behind ``call --submit/--status/
 --wait``) must stay importable without dragging in the executor stack

@@ -13,6 +13,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import random
 import time
 
 from duplexumiconsensusreads_tpu.serve.job import validate_spec
@@ -20,6 +21,13 @@ from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
 
 # states with nothing left to wait for
 TERMINAL_STATES = ("done", "failed", "rejected", "unknown")
+
+# --wait backoff: the delay doubles from poll_s up to this cap, with
+# multiplicative jitter so a herd of waiting clients (every `--wait`
+# is a journal read off the shared spool) decorrelates instead of
+# hammering the filesystem in lockstep
+WAIT_BACKOFF_CAP_S = 2.0
+_WAIT_JITTER = (0.5, 1.0)
 
 
 def make_job_id(spec_fields: dict) -> str:
@@ -71,13 +79,25 @@ def wait(
     waiting on a job nobody submitted must not hang). ``timeout_s`` 0 =
     wait forever; on expiry the last status is returned with
     ``timed_out: true`` rather than raising — the job is still running,
-    which is an answer, not an error."""
+    which is an answer, not an error.
+
+    Polling is jitter-backed-off: delays start at ``poll_s``, double up
+    to ~:data:`WAIT_BACKOFF_CAP_S`, and each is scaled by a random
+    factor — long jobs cost a handful of journal reads per second of
+    waiting fleet-wide instead of a fixed-rate stampede, while a job
+    finishing quickly is still noticed quickly."""
     q = SpoolQueue(spool_dir)
     t0 = time.monotonic()
+    delay = min(poll_s, WAIT_BACKOFF_CAP_S)
     while True:
         st = q.status(job_id)
         if st.get("state") in TERMINAL_STATES:
             return st
-        if timeout_s > 0 and time.monotonic() - t0 >= timeout_s:
+        remaining = timeout_s - (time.monotonic() - t0) if timeout_s > 0 else None
+        if remaining is not None and remaining <= 0:
             return {**st, "timed_out": True}
-        time.sleep(poll_s)
+        step = delay * random.uniform(*_WAIT_JITTER)
+        if remaining is not None:
+            step = min(step, remaining)  # never oversleep the deadline
+        time.sleep(step)
+        delay = min(delay * 2, WAIT_BACKOFF_CAP_S)

@@ -1,7 +1,8 @@
 """``dut-serve`` — the long-running consensus daemon.
 
     dut-serve SPOOL_DIR [--chunk-budget N] [--max-queue N] [--workers N]
-                        [--heartbeat S] [--no-trace] [--once] ...
+                        [--lease S] [--class-depth SPEC] [--heartbeat S]
+                        [--no-trace] [--once] ...
 
 Runs a :class:`~duplexumiconsensusreads_tpu.serve.service.ConsensusService`
 over SPOOL_DIR until SIGTERM/SIGINT, which trigger graceful drain:
@@ -10,9 +11,19 @@ as queued, the admission queue is already durable, and the process
 exits 0. Restarting the daemon on the same spool resumes the queue and
 every interrupted job (checkpoint resume skips their committed chunks).
 
+FLEET MODE is just more daemons: start ``dut-serve SPOOL_DIR`` N times
+(same host — the journal's flock + monotonic lease clock scope a spool
+to one machine) and they coordinate through the journal's lease/claim
+protocol — each job runs under exactly one daemon's lease, a SIGKILLed
+daemon's jobs are taken over (immediately when its pid is provably
+dead, within ``--lease`` seconds otherwise) and resumed from their last
+durable checkpoint mark, and a zombie daemon is fenced off by its stale
+token before it can splice a byte.
+
 Submit work with ``duplexumi call IN -o OUT --submit --spool SPOOL_DIR``
 and follow it with ``call --status/--wait`` (or read
-``SPOOL_DIR/metrics.json`` for the live service snapshot).
+``SPOOL_DIR/metrics.json`` for the live service snapshot, including
+per-priority-class queue-wait / time-to-first-chunk percentiles).
 """
 
 from __future__ import annotations
@@ -51,6 +62,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="devices per job slice (default: all local)",
     )
     p.add_argument(
+        "--lease", type=float, default=None, metavar="SECONDS",
+        help="job lease length for fleet coordination (default 30). "
+        "Healthy daemons renew every chunk commit and every heartbeat; "
+        "a daemon silent this long forfeits its running jobs to the "
+        "other daemons on the spool",
+    )
+    p.add_argument(
+        "--class-depth", default=None, metavar="SPEC",
+        help="per-priority-class admission bounds as CLASS=DEPTH pairs "
+        "(e.g. '0=8,1=4'): submissions over their class's queued depth "
+        "are shed with a journaled reason instead of queued (classes "
+        "not listed are bounded only by --max-queue)",
+    )
+    p.add_argument(
+        "--daemon-id", default=None,
+        help="fleet identity for lease ownership (default: a unique "
+        "pid-derived id; override only for debugging)",
+    )
+    p.add_argument(
         "--poll", type=float, default=0.25, metavar="SECONDS",
         help="inbox poll interval when idle (default 0.25)",
     )
@@ -81,6 +111,19 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(f"--chunk-budget must be >= 0 (got {args.chunk_budget})")
     if args.workers < 1:
         raise SystemExit(f"--workers must be >= 1 (got {args.workers})")
+    if args.lease is not None and args.lease <= 0:
+        raise SystemExit(f"--lease must be > 0 (got {args.lease})")
+    class_depths = None
+    if args.class_depth:
+        from duplexumiconsensusreads_tpu.serve.scheduler import (
+            parse_class_depths,
+        )
+
+        try:
+            class_depths = parse_class_depths(args.class_depth)
+        except ValueError as e:
+            raise SystemExit(f"--class-depth: {e}")
+    from duplexumiconsensusreads_tpu.serve.queue import LEASE_DEFAULT_S
     from duplexumiconsensusreads_tpu.serve.service import ConsensusService
 
     os.makedirs(args.spool, exist_ok=True)
@@ -98,6 +141,9 @@ def main(argv: list[str] | None = None) -> int:
         heartbeat_s=args.heartbeat,
         trace_path=trace_path,
         n_devices=args.devices,
+        lease_s=args.lease if args.lease is not None else LEASE_DEFAULT_S,
+        class_depths=class_depths,
+        daemon_id=args.daemon_id,
     )
 
     def _drain(signum, _frame):
@@ -114,7 +160,8 @@ def main(argv: list[str] | None = None) -> int:
     print(
         f"[dut-serve] serving {os.path.abspath(args.spool)} "
         f"(workers={args.workers}, chunk_budget={args.chunk_budget}, "
-        f"max_queue={args.max_queue}, pid={os.getpid()})",
+        f"max_queue={args.max_queue}, lease_s={service.lease_s}, "
+        f"daemon_id={service.daemon_id}, pid={os.getpid()})",
         file=sys.stderr,
         flush=True,
     )

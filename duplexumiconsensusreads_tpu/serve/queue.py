@@ -1,6 +1,6 @@
 """Durable spool + admission queue for the consensus service.
 
-Spool layout (one directory, shared by clients and the daemon):
+Spool layout (one directory, shared by clients and N daemons):
 
   inbox/<job_id>.json   client submissions — written durably by the
                         client, removed by the daemon only AFTER the
@@ -8,19 +8,49 @@ Spool layout (one directory, shared by clients and the daemon):
                         admission re-admits instead of losing the job;
                         job_id is the dedupe key, so re-admission can
                         never double-enter)
-  queue.json            the daemon's admission-queue journal: every
-                        accepted job with its state machine
-                        (queued → running → done | failed), persisted
-                        via the tmp+fsync+rename protocol on EVERY
-                        transition — whatever the journal says survived
-                        the crash is exactly what the restarted daemon
-                        resumes
+  queue.json            the admission-queue journal: every accepted job
+                        with its state machine (queued → running →
+                        done | failed), persisted via the tmp+fsync+
+                        rename protocol on EVERY transition — whatever
+                        the journal says survived the crash is exactly
+                        what a restarted (or surviving) daemon resumes
+  journal.lock          flock target serializing journal transactions
+                        (holds no data; see "Fleet transactions" below)
   results/<job_id>.json final per-job report (durable), read by
                         ``call --status/--wait``
   metrics.json          the live service heartbeat snapshot
 
+Fleet transactions: with several ``dut-serve`` daemons on one spool the
+journal is multi-writer, so every mutation is a flock'd READ-MODIFY-
+WRITE — take ``journal.lock``, reload queue.json, apply the transition,
+persist durably, release. In-memory ``jobs`` is only ever a cache of
+the last transaction's view. flock arbitrates both across processes and
+between one daemon's threads (each transaction opens its own fd), and a
+SIGKILLed holder releases it automatically — the lock can never outlive
+a crash the way journal state does.
+
+Leases: a job enters ``running`` only by CLAIMING it — the claiming
+transaction writes a lease entry (daemon id + pid/host, a monotonically
+increasing per-job FENCING TOKEN, and a monotonic-clock expiry) into
+the journal. Leases are renewed from the daemon's heartbeat and from
+every chunk commit; an expired lease — or one whose owner is provably
+dead — lets another daemon reclaim the job (queued again, original
+seq), resuming from the last durable checkpoint mark. The token is
+checked at every durable commit (chunk checkpoint mark via the
+executor's ``commit_guard``, result publish, every journal update by
+the slice), so a zombie daemon that wakes up after its job was
+reclaimed raises :class:`JobFenced` before splicing a single byte.
+Expiry uses ``time.monotonic()`` (machine-wide CLOCK_MONOTONIC), which
+makes lease arithmetic NTP-proof but scopes a spool to one host — the
+same scope flock already imposes.
+
 Fault sites: ``serve.accept`` guards the read+parse+validate of each
-submission; ``serve.journal`` guards every journal persist. Both ride
+submission and ``serve.journal`` every durable journal persist (both
+here); the serving layer wraps the lease operations at their own sites
+— ``serve.lease`` around :meth:`SpoolQueue.claim`, ``serve.renew``
+around renewal, ``serve.expire`` around :meth:`SpoolQueue.reclaim_dead`
+and ``serve.fence`` around :meth:`SpoolQueue.verify_lease` — so chaos
+schedules can target each step of the lease state machine. All ride
 the streaming executor's bounded host-I/O retry ladder, so transient
 faults are absorbed and an injected kill leaves exactly the on-disk
 state a real SIGKILL would.
@@ -28,26 +58,60 @@ state a real SIGKILL would.
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import json
 import os
+import socket
+import threading
+import time
 
-from duplexumiconsensusreads_tpu.io.durable import write_durable
+from duplexumiconsensusreads_tpu.io.durable import unique_tmp, write_durable
 from duplexumiconsensusreads_tpu.serve.job import JobSpec, validate_spec
 
 JOURNAL_VERSION = 1
 
 # journal job states; the only legal transitions are
-# queued -> running -> (done | failed | queued on preempt/recovery)
+# queued -> running -> (done | failed | queued on preempt/reclaim)
 JOB_STATES = ("queued", "running", "done", "failed", "rejected")
+
+# default lease length. Healthy daemons renew every chunk commit AND
+# every heartbeat, so expiry only ever fires on a daemon that stopped
+# making progress for this long — a real zombie, not a slow chunk.
+LEASE_DEFAULT_S = 30.0
+
+_HOST = socket.gethostname()
+
+
+class JobFenced(BaseException):
+    """A daemon's fencing token no longer matches the journal: its job
+    was reclaimed (lease expired / owner presumed dead) and every
+    durable commit it still owes is void. BaseException on purpose —
+    like InjectedKill, no retry or isolation ladder may absorb it: the
+    slice must abort immediately, committing nothing, and the service
+    drops the result on the floor (the reclaiming daemon owns the job
+    now and will produce the identical bytes)."""
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (OSError, OverflowError):
+        return True  # exists but not ours (EPERM), or unprobeable: assume alive
+    return True
 
 
 class SpoolQueue:
     """The admission queue over one spool directory.
 
-    All mutating methods persist the journal durably before returning;
-    the in-memory ``jobs`` dict is only ever a cache of queue.json.
-    Thread safety is the caller's job (serve.service serializes all
-    journal mutations under its scheduler lock).
+    All mutating methods are flock'd journal transactions (reload →
+    mutate → durable persist), safe against concurrent daemons; the
+    in-memory ``jobs`` dict is only ever a cache of queue.json.
+    In-process thread safety rides the same flock (each transaction
+    opens a private fd); serve.service additionally serializes its own
+    scheduling decisions under its lock.
     """
 
     def __init__(self, root: str, max_queue: int = 64,
@@ -64,13 +128,21 @@ class SpoolQueue:
         # must stay bounded on a long-lived daemon: terminal entries
         # (done/failed/rejected) beyond this many are compacted away on
         # save — their durable per-job report in results/ remains the
-        # record (status() falls back to it)
+        # record (status() falls back to it). Compaction NEVER touches
+        # open (queued/running) entries, so lease/token state survives
+        # every rewrite.
         self.max_terminal_kept = max_terminal_kept
+        # admission policy hook (serve.service wires the scheduler's
+        # shed policy here): callable(jobs, spec) -> rejection reason
+        # string, or None to admit. Purely advisory load shedding —
+        # invalid specs and the global bound are still enforced here.
+        self.admission_policy = None
         self.inbox_dir = os.path.join(root, "inbox")
         self.results_dir = os.path.join(root, "results")
         os.makedirs(self.inbox_dir, exist_ok=True)
         os.makedirs(self.results_dir, exist_ok=True)
         self.journal_path = os.path.join(root, "queue.json")
+        self._lock_path = os.path.join(root, "journal.lock")
         self.jobs: dict[str, dict] = {}
         self.seq = 0
         self._load()
@@ -80,16 +152,18 @@ class SpoolQueue:
     def submit(self, spec: JobSpec) -> str:
         """Durably spool one validated job into the inbox (client side;
         the daemon never calls this). Returns the job id."""
+        path = os.path.join(self.inbox_dir, spec.job_id + ".json")
         payload = json.dumps(spec.to_dict(), sort_keys=True).encode()
-        write_durable(
-            os.path.join(self.inbox_dir, spec.job_id + ".json"), payload
-        )
+        write_durable(path, payload, tmp=unique_tmp(path))
         return spec.job_id
 
     def status(self, job_id: str) -> dict:
         """One job's observable state, from the journal + inbox +
-        results — readable while the daemon runs (every file involved
-        is only ever atomically replaced).
+        results — readable while daemons run (every file involved is
+        only ever atomically replaced), no lock taken. Client-side
+        only: the bare reloads here assume a single-threaded instance
+        (daemon threads sharing a queue must use :meth:`refresh`, which
+        serializes against in-flight transactions).
 
         Admission-race discipline: the daemon journals BEFORE unlinking
         the inbox file, but a reader that loads the journal first and
@@ -121,18 +195,70 @@ class SpoolQueue:
 
     def _status_from_result(self, job_id: str) -> dict:
         """Jobs whose terminal journal entry was compacted away still
-        answer from their durable result file."""
+        answer from their durable result file — rejections included,
+        so a shed reason survives overload-time journal churn (which is
+        exactly when sheds are frequent and compaction fastest)."""
         result_path = os.path.join(self.results_dir, job_id + ".json")
         try:
             with open(result_path) as f:
                 result = json.load(f)
         except (OSError, ValueError):
             return {"job_id": job_id, "state": "unknown"}
-        state = "failed" if "error" in result else "done"
-        return {"job_id": job_id, "state": state, "result": result,
-                "compacted": True}
+        state = (
+            "rejected" if result.get("rejected")
+            else "failed" if "error" in result
+            else "done"
+        )
+        out = {"job_id": job_id, "state": state, "result": result,
+               "compacted": True}
+        if result.get("shed"):
+            out["shed"] = True
+        if "error" in result:
+            out["error"] = result["error"]
+        return out
+
+    def _write_rejection_result(
+        self, job_id: str, reason: str, shed: bool
+    ) -> None:
+        """Durable record of WHY a submission never ran: like
+        done/failed results, it outlives the journal entry's
+        compaction."""
+        path = os.path.join(self.results_dir, job_id + ".json")
+        payload: dict = {"error": reason[:2000], "rejected": True}
+        if shed:
+            payload["shed"] = True
+        write_durable(
+            path,
+            json.dumps(payload, sort_keys=True).encode(),
+            tmp=unique_tmp(path),
+        )
 
     # ------------------------------------------------------- daemon side
+
+    @contextlib.contextmanager
+    def _txn(self):
+        """One flock'd journal transaction: exclusive lock, fresh load,
+        caller mutates and persists, lock released (incl. on error/kill
+        — the kernel drops flock with the fd)."""
+        fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            self._load()
+            yield
+        finally:
+            os.close(fd)
+
+    def refresh(self) -> None:
+        """Re-read the journal so the service's idle check sees other
+        daemons' transitions — UNDER the transaction lock: a bare
+        reload would rebind the ``jobs`` cache while a concurrent
+        transaction on this same instance (a commit-guard renewal, the
+        heartbeat's renew_all) sits between its load and its save, and
+        that transaction would then durably write the rebound,
+        mutation-less dict — silently dropping a lease renewal or, at
+        worst, a claim."""
+        with self._txn():
+            pass
 
     def _load(self) -> None:
         """Refresh the in-memory view from queue.json. A torn or
@@ -156,7 +282,10 @@ class SpoolQueue:
     def _compact(self) -> None:
         """Bound the journal: drop the OLDEST terminal entries beyond
         ``max_terminal_kept`` (their results/ file stays the durable
-        record). Open jobs (queued/running) are never touched."""
+        record). Open jobs (queued/running) are never touched — their
+        lease/token state must survive every save, or a restarted
+        daemon would schedule (and fence) differently than the dead
+        one would have."""
         terminal = sorted(
             (
                 (int(e.get("seq", 0)), jid)
@@ -181,7 +310,9 @@ class SpoolQueue:
         ).encode()
         _io_retry(
             "serve.journal",
-            lambda: write_durable(self.journal_path, payload),
+            lambda: write_durable(
+                self.journal_path, payload, tmp=unique_tmp(self.journal_path)
+            ),
             "queue journal save",
         )
 
@@ -207,9 +338,11 @@ class SpoolQueue:
     def accept_one(self, job_id: str) -> tuple[JobSpec | None, str | None]:
         """Admit one inbox submission: read + validate (fault site
         ``serve.accept``), journal it durably, THEN remove the inbox
-        file. Returns (spec, None) on admission, (None, reason) on
-        rejection (bounded queue, invalid spec), (None, None) when the
-        submission was a duplicate of an already-journaled job.
+        file — one flock'd transaction, so two daemons scanning the
+        same inbox admit each job exactly once. Returns (spec, None) on
+        admission, (None, reason) on rejection (shed policy, bounded
+        queue, invalid spec), (None, None) when the submission was a
+        duplicate of an already-journaled job.
 
         Kill-anywhere safety: before the journal save the inbox file is
         untouched (restart re-admits); after it, re-admission dedupes on
@@ -222,56 +355,77 @@ class SpoolQueue:
             with open(path, "rb") as f:
                 return f.read()
 
-        try:
-            raw = _io_retry("serve.accept", _read, f"job {job_id} accept")
-        except FileNotFoundError:
-            return None, None  # raced away (duplicate listing)
-        if job_id in self.jobs:
-            # already journaled (kill landed between journal + unlink):
-            # admission already happened exactly once — just clean up
-            self._unlink_inbox(path)
-            return None, None
-        try:
-            spec = validate_spec(json.loads(raw.decode()))
-            if spec.job_id != job_id:
-                raise ValueError(
-                    f"spec job_id {spec.job_id!r} does not match the "
-                    f"spool filename"
+        with self._txn():
+            try:
+                raw = _io_retry("serve.accept", _read, f"job {job_id} accept")
+            except FileNotFoundError:
+                return None, None  # raced away (another daemon admitted it)
+            if job_id in self.jobs:
+                # already journaled (kill landed between journal +
+                # unlink, or another daemon won the race): admission
+                # already happened exactly once — just clean up
+                self._unlink_inbox(path)
+                return None, None
+            try:
+                spec = validate_spec(json.loads(raw.decode()))
+                if spec.job_id != job_id:
+                    raise ValueError(
+                        f"spec job_id {spec.job_id!r} does not match the "
+                        f"spool filename"
+                    )
+            except (ValueError, UnicodeDecodeError) as e:
+                self._write_rejection_result(job_id, str(e), shed=False)
+                self.jobs[job_id] = {
+                    "state": "rejected", "error": str(e)[:500], "seq": self.seq,
+                }
+                self.seq += 1
+                self.save()
+                self._unlink_inbox(path)
+                return None, str(e)
+            # admission control: the scheduler's per-class shed policy
+            # first, the global open-jobs bound as the backstop — both
+            # journaled as explicit shed-with-reason rejections, so an
+            # overloaded fleet degrades by policy (and tells the client
+            # why), never by an inbox silently rotting
+            reason = None
+            if self.admission_policy is not None:
+                reason = self.admission_policy(self.jobs, spec)
+            if reason is None:
+                n_open = sum(
+                    1 for j in self.jobs.values()
+                    if j.get("state") in ("queued", "running")
                 )
-        except (ValueError, UnicodeDecodeError) as e:
+                if n_open >= self.max_queue:
+                    reason = (
+                        f"shed: queue full ({n_open}/{self.max_queue} "
+                        f"jobs open)"
+                    )
+            if reason is not None:
+                self._write_rejection_result(job_id, reason, shed=True)
+                self.jobs[job_id] = {
+                    "state": "rejected", "error": reason, "shed": True,
+                    "priority": spec.priority, "seq": self.seq,
+                }
+                self.seq += 1
+                self.save()
+                self._unlink_inbox(path)
+                return None, reason
             self.jobs[job_id] = {
-                "state": "rejected", "error": str(e)[:500], "seq": self.seq,
+                "state": "queued",
+                "seq": self.seq,
+                "priority": spec.priority,
+                "spec": spec.to_dict(),
+                "slices": 0,
+                "chunks_done": 0,
+                # admission timestamp on the shared monotonic clock:
+                # whichever daemon eventually claims the job computes
+                # its queue-wait against this
+                "admitted_m": round(time.monotonic(), 3),
             }
             self.seq += 1
             self.save()
             self._unlink_inbox(path)
-            return None, str(e)
-        n_open = sum(
-            1 for j in self.jobs.values() if j.get("state") in ("queued", "running")
-        )
-        if n_open >= self.max_queue:
-            # bounded admission: REJECT (journaled, so --status answers)
-            # rather than silently stalling the inbox forever
-            reason = f"queue full ({n_open}/{self.max_queue} jobs open)"
-            self.jobs[job_id] = {
-                "state": "rejected", "error": reason, "seq": self.seq,
-            }
-            self.seq += 1
-            self.save()
-            self._unlink_inbox(path)
-            return None, reason
-        self.jobs[job_id] = {
-            "state": "queued",
-            "seq": self.seq,
-            "priority": spec.priority,
-            "spec": spec.to_dict(),
-            "slices": 0,
-            "chunks_done": 0,
-        }
-        self.seq += 1
-        self.save()
-        self._unlink_inbox(path)
-        return spec, None
+            return spec, None
 
     @staticmethod
     def _unlink_inbox(path: str) -> None:
@@ -280,65 +434,241 @@ class SpoolQueue:
         except OSError:
             pass  # re-admission dedupes; a leftover file is harmless
 
+    # ------------------------------------------------------------ leases
+
+    def _check_fence(self, job_id: str, daemon_id: str, token: int) -> dict:
+        """Raise :class:`JobFenced` unless ``daemon_id`` still holds
+        ``job_id``'s CURRENT lease under fencing token ``token``.
+        Returns the journal entry. Caller holds the transaction."""
+        entry = self.jobs.get(job_id)
+        lease = (entry or {}).get("lease")
+        if (
+            entry is None
+            or entry.get("state") != "running"
+            or lease is None
+            or lease.get("owner") != daemon_id
+            or int(entry.get("token", 0)) != int(token)
+        ):
+            raise JobFenced(
+                f"job {job_id}: lease lost (holder token {token}, journal "
+                f"token {(entry or {}).get('token')!r}, owner "
+                f"{(lease or {}).get('owner')!r})"
+            )
+        return entry
+
+    def claim(
+        self, job_id: str, daemon_id: str, lease_s: float = LEASE_DEFAULT_S
+    ) -> int | None:
+        """Claim a queued job for ``daemon_id``: bump the fencing token,
+        write the lease, mark it running — one durable transaction
+        (fault site ``serve.lease``). Returns the new token, or None if
+        the job raced away (another daemon claimed or finished it)."""
+        with self._txn():
+            entry = self.jobs.get(job_id)
+            if entry is None or entry.get("state") != "queued":
+                return None
+            token = int(entry.get("token", 0)) + 1
+            entry["token"] = token
+            entry["state"] = "running"
+            entry["slices"] = int(entry.get("slices", 0)) + 1
+            entry["lease"] = {
+                "owner": daemon_id,
+                "pid": os.getpid(),
+                "host": _HOST,
+                "expires_m": round(time.monotonic() + lease_s, 3),
+            }
+            self.save()
+            return token
+
+    def verify_lease(self, job_id: str, daemon_id: str, token: int) -> None:
+        """The fence check: raise :class:`JobFenced` unless this
+        (daemon, token) is still the job's current lease. Read-only;
+        called (under fault site ``serve.fence``) before every durable
+        commit a slice makes."""
+        with self._txn():
+            self._check_fence(job_id, daemon_id, token)
+
+    def renew_lease(
+        self, job_id: str, daemon_id: str, token: int,
+        lease_s: float = LEASE_DEFAULT_S,
+    ) -> None:
+        """Extend the lease (fault site ``serve.renew``), fenced: a
+        zombie must not be able to resurrect a reclaimed lease."""
+        with self._txn():
+            entry = self._check_fence(job_id, daemon_id, token)
+            entry["lease"]["expires_m"] = round(time.monotonic() + lease_s, 3)
+            self.save()
+
+    def renew_all(self, daemon_id: str, lease_s: float = LEASE_DEFAULT_S) -> int:
+        """Heartbeat-path renewal: extend every running lease this
+        daemon holds. Returns the number renewed (0 = nothing to save)."""
+        with self._txn():
+            renewed = 0
+            deadline = round(time.monotonic() + lease_s, 3)
+            for entry in self.jobs.values():
+                lease = entry.get("lease")
+                if (
+                    entry.get("state") == "running"
+                    and lease is not None
+                    and lease.get("owner") == daemon_id
+                ):
+                    lease["expires_m"] = deadline
+                    renewed += 1
+            if renewed:
+                self.save()
+            return renewed
+
+    def reclaim_dead(self, daemon_id: str, is_live=None) -> list[dict]:
+        """Dead-daemon takeover: requeue every running job whose lease
+        no longer protects it — expired (the zombie case: the owner may
+        still be alive, which is exactly what the fencing token guards
+        against), owned by a provably dead local pid, or missing
+        entirely (a pre-lease journal). Reclaimed jobs keep their
+        ORIGINAL seq (they reached the front once already) and their
+        token (the NEXT claim bumps it, fencing the previous holder).
+
+        ``is_live`` (optional callable daemon_id -> bool) identifies
+        live daemons within THIS process — the in-process fleet used by
+        tests and the bench, where every daemon shares one pid.
+        Returns [{job_id, reason, prev_owner}, ...]; the persist rides
+        fault site ``serve.expire``."""
+        now = time.monotonic()
+        with self._txn():
+            reclaimed = []
+            for job_id, entry in self.jobs.items():
+                if entry.get("state") != "running":
+                    continue
+                lease = entry.get("lease")
+                reason = None
+                if lease is None:
+                    reason = "no-lease"
+                elif float(lease.get("expires_m", 0)) <= now:
+                    reason = "expired"
+                elif lease.get("host") == _HOST:
+                    pid = int(lease.get("pid", -1))
+                    if not _pid_alive(pid):
+                        reason = "dead-owner"
+                    elif (
+                        pid == os.getpid()
+                        and is_live is not None
+                        and not is_live(lease.get("owner"))
+                    ):
+                        reason = "dead-owner"
+                if reason is None:
+                    continue
+                entry["state"] = "queued"
+                prev = (lease or {}).get("owner")
+                entry.pop("lease", None)
+                reclaimed.append(
+                    {"job_id": job_id, "reason": reason, "prev_owner": prev}
+                )
+            if reclaimed:
+                self.save()
+            return reclaimed
+
     # ----------------------------------------------- state transitions
 
-    def mark_running(self, job_id: str) -> None:
-        entry = self.jobs[job_id]
-        entry["state"] = "running"
-        entry["slices"] = int(entry.get("slices", 0)) + 1
-        self.save()
-
-    def requeue(self, job_id: str, chunks_done: int, back: bool) -> None:
-        """Preempted (or crash-recovered) job back to the queue.
+    def requeue(
+        self, job_id: str, chunks_done: int, back: bool,
+        daemon_id: str | None = None, token: int | None = None,
+    ) -> None:
+        """Preempted job back to the queue, releasing its lease.
         ``back=True`` moves it behind its class's waiting jobs (the
         budget-yield fairness rule); ``back=False`` keeps its original
-        seq (crash recovery must not penalise the interrupted job)."""
-        entry = self.jobs[job_id]
-        entry["state"] = "queued"
-        entry["chunks_done"] = int(chunks_done)
-        if back:
-            entry["seq"] = self.seq
-            self.seq += 1
-        self.save()
+        seq (drain must not penalise the interrupted job). Fenced when
+        the caller passes its lease identity: a zombie's requeue of a
+        job someone else now owns must be void."""
+        with self._txn():
+            if daemon_id is not None:
+                self._check_fence(job_id, daemon_id, int(token or 0))
+            entry = self.jobs[job_id]
+            entry["state"] = "queued"
+            entry["chunks_done"] = int(chunks_done)
+            entry.pop("lease", None)
+            if back:
+                entry["seq"] = self.seq
+                self.seq += 1
+            self.save()
 
-    def mark_done(self, job_id: str, result: dict) -> None:
+    def mark_done(
+        self, job_id: str, result: dict,
+        daemon_id: str | None = None, token: int | None = None,
+    ) -> None:
         """Result file first, journal second: a kill between the two
         re-runs the job's (idempotent, checkpointed) tail rather than
-        journaling a result that was never durably written."""
-        write_durable(
-            os.path.join(self.results_dir, job_id + ".json"),
-            json.dumps(result, sort_keys=True).encode(),
-        )
-        entry = self.jobs[job_id]
-        entry["state"] = "done"
-        entry.pop("error", None)
-        self.save()
-
-    def mark_failed(self, job_id: str, error: str) -> None:
-        write_durable(
-            os.path.join(self.results_dir, job_id + ".json"),
-            json.dumps({"error": error[:2000]}, sort_keys=True).encode(),
-        )
-        entry = self.jobs[job_id]
-        entry["state"] = "failed"
-        entry["error"] = error[:500]
-        self.save()
-
-    def recover_running(self) -> list[str]:
-        """Daemon start: every job the journal says was RUNNING was
-        interrupted by the previous daemon's death — requeue it at its
-        ORIGINAL seq (it reached the front once already) with resume
-        semantics (its checkpoint, if any survived, skips done chunks)."""
-        recovered = []
-        for job_id, entry in self.jobs.items():
-            if entry.get("state") == "running":
-                entry["state"] = "queued"
-                recovered.append(job_id)
-        if recovered:
+        journaling a result that was never durably written. The fence
+        check and the publish share one transaction, so a reclaim
+        cannot slip between them."""
+        with self._txn():
+            if daemon_id is not None:
+                self._check_fence(job_id, daemon_id, int(token or 0))
+            path = os.path.join(self.results_dir, job_id + ".json")
+            write_durable(
+                path,
+                json.dumps(result, sort_keys=True).encode(),
+                tmp=unique_tmp(path),
+            )
+            entry = self.jobs[job_id]
+            entry["state"] = "done"
+            entry.pop("error", None)
+            entry.pop("lease", None)
             self.save()
-        return recovered
+
+    def mark_failed(
+        self, job_id: str, error: str,
+        daemon_id: str | None = None, token: int | None = None,
+    ) -> None:
+        with self._txn():
+            if daemon_id is not None:
+                self._check_fence(job_id, daemon_id, int(token or 0))
+            path = os.path.join(self.results_dir, job_id + ".json")
+            write_durable(
+                path,
+                json.dumps({"error": error[:2000]}, sort_keys=True).encode(),
+                tmp=unique_tmp(path),
+            )
+            entry = self.jobs[job_id]
+            entry["state"] = "failed"
+            entry["error"] = error[:500]
+            entry.pop("lease", None)
+            self.save()
 
     def queue_depth(self) -> int:
         return sum(
             1 for j in self.jobs.values() if j.get("state") == "queued"
         )
+
+    # ------------------------------------------------------- maintenance
+
+    def sweep_orphan_tmps(self) -> int:
+        """Remove staging files orphaned by dead daemons. Fleet writers
+        stage through ``<dst>.tmp.<pid>.<tid>`` names (io.durable.
+        unique_tmp) so concurrent writers can't collide — but a daemon
+        killed between its tmp write and the rename leaves that file
+        behind forever (no later writer reuses the name). A file is an
+        orphan exactly when its embedded pid is dead — no clocks, no
+        guessing; live daemons' in-flight staging files are untouched.
+        Called at daemon startup; returns the number removed."""
+        removed = 0
+        for d in (self.root, self.inbox_dir, self.results_dir):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for n in names:
+                parts = n.rsplit(".", 3)
+                if len(parts) != 4 or parts[1] != "tmp":
+                    continue
+                try:
+                    pid = int(parts[2])
+                    int(parts[3])
+                except ValueError:
+                    continue
+                if _pid_alive(pid):
+                    continue
+                try:
+                    os.remove(os.path.join(d, n))
+                    removed += 1
+                except OSError:
+                    pass  # raced away / permissions: litter, not a fault
+        return removed

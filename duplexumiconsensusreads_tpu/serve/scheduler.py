@@ -12,22 +12,80 @@ The policy is deliberately small enough to state in full:
     and re-enters its class at the BACK, so a jumbo job interleaves
     with small ones instead of starving them. Preemption happens at a
     chunk boundary, where the streaming executor's checkpoint/resume
-    contract makes the yield free (the next slice recomputes nothing).
+    contract makes the yield free (the next slice recomputes nothing);
+  * ADMISSION SHEDDING per class: each priority class can carry a
+    queue-depth bound, and a submission that would exceed its class's
+    bound is rejected at admission with an explicit journaled reason —
+    overload degrades by policy (urgent classes keep their budgeted
+    room), never by an unbounded queue quietly absorbing everything.
 
 Pure functions over the journal's ``jobs`` dict: no state of its own,
-so a restarted daemon schedules exactly as the dead one would have.
+so every daemon of a fleet — or a restarted daemon — schedules exactly
+as any other would from the same journal.
 """
 
 from __future__ import annotations
 
 
+def parse_class_depths(spec: str) -> dict[int, int]:
+    """``"0=8,1=4"`` → {0: 8, 1: 4}: per-priority-class queued-depth
+    bounds for ``dut-serve --class-depth``. Raises ValueError naming
+    the offending entry."""
+    out: dict[int, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, eq, depth = part.partition("=")
+        try:
+            if not eq:
+                raise ValueError
+            c, d = int(cls), int(depth)
+            if c < 0 or d < 1:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"bad class-depth entry {part!r} (want CLASS=DEPTH with "
+                f"CLASS >= 0 and DEPTH >= 1, e.g. '0=8,1=4')"
+            ) from None
+        out[c] = d
+    return out
+
+
 class FairScheduler:
-    def __init__(self, chunk_budget: int = 0):
+    def __init__(
+        self, chunk_budget: int = 0,
+        class_depths: dict[int, int] | None = None,
+    ):
         """``chunk_budget`` = fresh chunks a slice may commit before
-        yielding (0 = run to completion; no preemption)."""
+        yielding (0 = run to completion; no preemption).
+        ``class_depths`` maps priority class -> max QUEUED jobs of that
+        class (absent classes are unbounded up to the queue's global
+        open-jobs cap)."""
         if chunk_budget < 0:
             raise ValueError(f"chunk_budget must be >= 0 (got {chunk_budget})")
         self.chunk_budget = chunk_budget
+        self.class_depths = dict(class_depths or {})
+
+    def shed_reason(self, jobs: dict, priority: int) -> str | None:
+        """Admission-control verdict for one incoming submission: a
+        reason string when its priority class is at its queued-depth
+        bound (the queue journals it as an explicit shed), else None.
+        Pure over the journal, so every daemon sheds identically."""
+        bound = self.class_depths.get(int(priority))
+        if bound is None:
+            return None
+        depth = sum(
+            1 for e in jobs.values()
+            if e.get("state") == "queued"
+            and int(e.get("priority", 1)) == int(priority)
+        )
+        if depth >= bound:
+            return (
+                f"shed: priority class {priority} queue depth "
+                f"{depth}/{bound} (admission control)"
+            )
+        return None
 
     @staticmethod
     def pick(jobs: dict) -> str | None:

@@ -1,24 +1,42 @@
 """The consensus service: admission → fair scheduling → warm slices.
 
 One ``ConsensusService`` owns a spool directory and drains it through a
-small pool of warm workers. All queue/journal mutations are serialized
-under one lock; the slices themselves (the expensive part) run outside
-it. The service is equally usable in-process (tests, the bench's
-``serve_n_jobs`` leg) and as the ``dut-serve`` daemon (serve.daemon).
+small pool of warm workers — and N services (processes or instances)
+can share ONE spool as a fleet: every queue/journal mutation is a
+flock'd transaction (serve.queue), a job runs only under a durable
+LEASE claimed in that journal, and every durable commit a slice makes
+is fenced by the lease's token. Two daemons can therefore never run one
+job at the same time, a daemon that dies mid-job is taken over (lease
+expiry, or immediately when its pid is provably dead) with the next
+slice resuming from the last durable checkpoint mark, and a zombie
+daemon that wakes up after takeover aborts before splicing a byte.
+In-process scheduling decisions stay serialized under one lock; the
+slices themselves (the expensive part) run outside it. The service is
+equally usable in-process (tests, the bench's ``serve_n_jobs`` /
+``serve_fleet`` legs) and as the ``dut-serve`` daemon (serve.daemon).
+
+Admission control: beyond the global open-jobs bound, each priority
+class can carry a queued-depth bound (``class_depths``); submissions
+over a bound are journaled as explicit shed-with-reason rejections
+(``job_shed`` trace events, ``shed: ...`` reasons in ``--status``), and
+per-class queue-wait / time-to-first-chunk percentiles land in
+``metrics.json`` — overload degrades by policy, observably.
 
 Graceful drain: :meth:`request_drain` (the daemon's SIGTERM handler)
 makes every running slice yield at its next chunk boundary — the
 executor checkpoints the committed prefix, the job is re-journaled as
-queued, and :meth:`run` returns cleanly. A restarted service resumes
-both the queue and every interrupted job from exactly that state; the
-chaos-kill path (InjectedKill anywhere in admission or a slice) leaves
-the same journal a real SIGKILL would, which the recovery test pins.
+queued (lease released), and :meth:`run` returns cleanly. A restarted
+service resumes both the queue and every interrupted job from exactly
+that state; the chaos-kill path (InjectedKill anywhere in admission or
+a slice) leaves the same journal a real SIGKILL would, which the
+recovery tests pin.
 
 Telemetry: with ``trace_path`` set the service records a
 kind="service" capture (telemetry/trace.py): job lifecycle events on
-``job-<id>`` lanes, service heartbeats carrying the queue snapshot, and
-— because the recorder is installed as the process-global hook — every
-fault/retry/durable event the switchboard emits while jobs run.
+``job-<id>`` lanes (now including ``job_shed``, ``job_fenced`` and
+``lease_takeover``), service heartbeats carrying the queue snapshot,
+and — because the recorder is installed as the process-global hook —
+every fault/retry/durable event the switchboard emits while jobs run.
 ``tools/serve_report.py`` summarises it; ``tools/check_trace.py``
 validates it.
 """
@@ -28,15 +46,40 @@ from __future__ import annotations
 import os
 import threading
 import time
+import uuid
 
-from duplexumiconsensusreads_tpu.io.durable import write_durable
+from duplexumiconsensusreads_tpu.io.durable import unique_tmp, write_durable
 from duplexumiconsensusreads_tpu.runtime.stream import _io_retry
 from duplexumiconsensusreads_tpu.serve.job import validate_spec
-from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
+from duplexumiconsensusreads_tpu.serve.queue import (
+    LEASE_DEFAULT_S,
+    JobFenced,
+    SpoolQueue,
+)
 from duplexumiconsensusreads_tpu.serve.scheduler import FairScheduler
-from duplexumiconsensusreads_tpu.serve.worker import WarmWorker
+from duplexumiconsensusreads_tpu.serve.worker import LeaseContext, WarmWorker
 from duplexumiconsensusreads_tpu.telemetry import trace as telemetry
+from duplexumiconsensusreads_tpu.telemetry.report import _pctl
 from duplexumiconsensusreads_tpu.telemetry.trace import Heartbeat, TraceRecorder
+
+# Live daemons in THIS process, by daemon id. The lease liveness probe
+# can ask the kernel whether another process's pid is alive, but an
+# in-process fleet (tests, the bench's serve_fleet leg, embedded use)
+# shares one pid — this registry is the equivalent probe for those:
+# a lease whose owner registered here and then unwound (crash or clean
+# exit both pass through run()'s finally) is reclaimable immediately.
+_LIVE_LOCK = threading.Lock()
+_LIVE_DAEMONS: set = set()
+
+
+def _daemon_is_live(daemon_id: str) -> bool:
+    with _LIVE_LOCK:
+        return daemon_id in _LIVE_DAEMONS
+
+
+# per-class latency sample caps: enough for honest p95s on a long-lived
+# daemon without unbounded growth (oldest samples age out)
+_LAT_SAMPLES_KEPT = 512
 
 
 class ConsensusService:
@@ -50,27 +93,51 @@ class ConsensusService:
         heartbeat_s: float = 0.0,
         trace_path: str | None = None,
         n_devices: int | None = None,
+        lease_s: float = LEASE_DEFAULT_S,
+        class_depths: dict | None = None,
+        daemon_id: str | None = None,
     ):
         if workers < 1:
             raise ValueError(f"workers must be >= 1 (got {workers})")
         if poll_s <= 0:
             raise ValueError(f"poll_s must be > 0 (got {poll_s})")
+        if lease_s <= 0:
+            raise ValueError(f"lease_s must be > 0 (got {lease_s})")
         self.queue = SpoolQueue(spool_dir, max_queue=max_queue)
-        self.sched = FairScheduler(chunk_budget=chunk_budget)
+        self.sched = FairScheduler(
+            chunk_budget=chunk_budget, class_depths=class_depths
+        )
+        # the scheduler's shed policy gates admission (pure over the
+        # journal, so every fleet member sheds identically)
+        self.queue.admission_policy = (
+            lambda jobs, spec: self.sched.shed_reason(jobs, spec.priority)
+        )
         self.worker = WarmWorker(n_devices=n_devices)
         self.workers = workers
         self.poll_s = poll_s
         self.heartbeat_s = heartbeat_s
         self.trace_path = trace_path
+        self.lease_s = lease_s
+        # fleet identity: unique per service INSTANCE (not per pid), so
+        # an in-process restart is a new daemon whose predecessor's
+        # leases are provably dead via the live registry
+        self.daemon_id = daemon_id or (
+            f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        )
         self._lock = threading.Lock()
         self._drain = threading.Event()
         self._fatal: BaseException | None = None
         self._n_running = 0
         self._t0 = time.monotonic()
         self._job_seconds: dict[str, dict] = {}
+        # per-priority-class latency samples: queue-wait (admission ->
+        # first claim) and time-to-first-chunk (admission -> first
+        # fresh chunk durable), bounded FIFO
+        self._lat: dict[int, dict[str, list]] = {}
         self.counters = {
-            "jobs_accepted": 0, "jobs_rejected": 0, "jobs_done": 0,
-            "jobs_failed": 0, "preemptions": 0, "jobs_recovered": 0,
+            "jobs_accepted": 0, "jobs_rejected": 0, "jobs_shed": 0,
+            "jobs_done": 0, "jobs_failed": 0, "jobs_fenced": 0,
+            "preemptions": 0, "jobs_recovered": 0,
         }
         self._tr: TraceRecorder | None = None
 
@@ -96,22 +163,81 @@ class ConsensusService:
             }
         return snap
 
+    def _note_latency_locked(self, priority: int, kind: str, value_s: float) -> None:
+        samples = self._lat.setdefault(
+            int(priority), {"queue_wait": [], "ttfc": []}
+        )[kind]
+        samples.append(round(value_s, 4))
+        del samples[:-_LAT_SAMPLES_KEPT]
+
+    def _class_latency_locked(self) -> dict:
+        """Per-priority-class p50/p95 of queue-wait and time-to-first-
+        chunk — the service's SLO surface, snapshotted into
+        metrics.json beside the queue depth."""
+        out = {}
+        for pri in sorted(self._lat):
+            row = {}
+            for kind, key in (("queue_wait", "queue_wait"), ("ttfc", "ttfc")):
+                vals = sorted(self._lat[pri][kind])
+                row[f"n_{key}"] = len(vals)
+                row[f"{key}_p50_s"] = round(_pctl(vals, 0.50), 4)
+                row[f"{key}_p95_s"] = round(_pctl(vals, 0.95), 4)
+            out[str(pri)] = row
+        return out
+
     def _write_metrics(self, snap: dict) -> None:
         """The live snapshot file beside the journal: queue depth, jobs
-        in flight, per-job phase seconds, compile-cache hit rate —
-        readable by ops/`call --status` while the daemon runs."""
+        in flight, per-job phase seconds, compile-cache hit rate, and
+        the per-class latency percentiles — readable by ops/`call
+        --status` while the daemon runs. Fleet note: every daemon
+        snapshots the same path (private tmp, atomic replace — never
+        torn); last writer wins and names itself in ``daemon_id``."""
         import json
 
         with self._lock:
             payload = json.dumps(
-                {**snap, "job_seconds": self._job_seconds}, sort_keys=True
+                {
+                    **snap,
+                    "daemon_id": self.daemon_id,
+                    "lease_s": self.lease_s,
+                    "job_seconds": self._job_seconds,
+                    "class_latency": self._class_latency_locked(),
+                },
+                sort_keys=True,
             ).encode()
+        path = os.path.join(self.queue.root, "metrics.json")
         try:
-            write_durable(os.path.join(self.queue.root, "metrics.json"), payload)
+            write_durable(path, payload, tmp=unique_tmp(path))
         except OSError:
             pass  # the snapshot is observability, never worth a crash
 
     def _beat_stats(self) -> dict:
+        # the heartbeat is the lease keep-alive path: every beat
+        # extends this daemon's running leases, so a paused daemon
+        # (whose beats stop) expires within lease_s while a healthy
+        # one can never expire between chunk commits. A dying daemon
+        # (fatal set) must NOT renew — its leases should lapse so the
+        # fleet takes its jobs over as fast as possible.
+        if self._fatal is None:
+            try:
+                _io_retry(
+                    "serve.renew",
+                    lambda: self.queue.renew_all(self.daemon_id, self.lease_s),
+                    "heartbeat lease renewal",
+                )
+            except OSError:
+                pass  # beyond retries: per-chunk renewal still covers
+            except BaseException as e:  # noqa: BLE001 — modelled kill
+                # an InjectedKill landing on the heartbeat thread must
+                # take the DAEMON down, not just this thread — a
+                # half-alive daemon that keeps committing after its
+                # modelled death would break the kill-equals-SIGKILL
+                # contract the chaos suite is phrased over
+                with self._lock:
+                    if self._fatal is None:
+                        self._fatal = e
+                self._drain.set()
+                raise
         snap = self.stats()
         self._write_metrics(snap)
         return snap
@@ -120,51 +246,59 @@ class ConsensusService:
 
     def run(self, once: bool = False) -> dict:
         """Drain the spool. ``once=True`` returns when the queue, inbox
-        and workers are all idle (tests, the bench leg); ``once=False``
-        runs until :meth:`request_drain`. Returns the final stats
-        snapshot; re-raises a fatal error (injected kill, journal I/O
-        beyond retries) after the surviving workers stop."""
+        and all fleet work are idle (tests, the bench legs);
+        ``once=False`` runs until :meth:`request_drain`. Returns the
+        final stats snapshot; re-raises a fatal error (injected kill,
+        journal I/O beyond retries) after the surviving workers stop."""
         from duplexumiconsensusreads_tpu.utils.compile_cache import (
             enable_compile_cache,
         )
 
         enable_compile_cache(per_host_cpu=True)
+        with _LIVE_LOCK:
+            _LIVE_DAEMONS.add(self.daemon_id)
         tr = None
         hooked = False
-        if self.trace_path:
-            tr = TraceRecorder(self.trace_path, kind="service")
-            self._tr = tr
-            if telemetry.get_active() is None:
-                # the service capture doubles as the switchboard sink:
-                # fault/retry/durable events from admissions AND from
-                # untraced job slices land here
-                telemetry.install(tr)
-                hooked = True
         hb = None
-        if self.heartbeat_s and self.heartbeat_s > 0:
-            hb = Heartbeat(self.heartbeat_s, self._beat_stats, recorder=tr)
-            hb.start()
-        recovered = self.queue.recover_running()
-        with self._lock:
-            self.counters["jobs_recovered"] += len(recovered)
-        for job_id in recovered:
-            if tr is not None:
-                tr.event(
-                    "resume", job=job_id, lane=f"job-{job_id}",
-                    decision="requeued_running",
-                )
-        threads = [
-            threading.Thread(
-                target=self._worker_loop, args=(once,),
-                name=f"dut-serve_{i}", daemon=True,
-            )
-            for i in range(self.workers)
-        ]
         try:
+            if self.trace_path:
+                tr = TraceRecorder(self.trace_path, kind="service")
+                self._tr = tr
+                if telemetry.get_active() is None:
+                    # the service capture doubles as the switchboard
+                    # sink: fault/retry/durable events from admissions
+                    # AND from untraced job slices land here
+                    telemetry.install(tr)
+                    hooked = True
+            if self.heartbeat_s and self.heartbeat_s > 0:
+                hb = Heartbeat(self.heartbeat_s, self._beat_stats, recorder=tr)
+                hb.start()
+            # startup sweeps: staging files orphaned by dead daemons
+            # (crash litter — their pid-suffixed tmps are never reused)
+            # and jobs the journal says are running under a dead
+            # daemon's (or no) lease, requeued before the workers start
+            # so recovery counters/events land once
+            self.queue.sweep_orphan_tmps()
+            with self._lock:
+                self._reclaim_locked()
+            threads = [
+                threading.Thread(
+                    target=self._worker_loop, args=(once,),
+                    name=f"dut-serve_{i}", daemon=True,
+                )
+                for i in range(self.workers)
+            ]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
+        except BaseException as e:  # noqa: BLE001 — incl. startup kills
+            # a kill during setup/startup reclaim is the same modelled
+            # death as one inside a worker: record it, clean up below,
+            # re-raise with durable state exactly as the kill left it
+            with self._lock:
+                if self._fatal is None:
+                    self._fatal = e
         finally:
             if hb is not None:
                 hb.stop()
@@ -178,6 +312,11 @@ class ConsensusService:
                     telemetry.uninstall()
                 tr.close()
                 self._tr = None
+            # crash or clean exit, this daemon is dead to the fleet:
+            # deregistering lets a successor reclaim its leases
+            # immediately instead of waiting out the expiry
+            with _LIVE_LOCK:
+                _LIVE_DAEMONS.discard(self.daemon_id)
         if self._fatal is not None:
             raise self._fatal
         return snap
@@ -202,19 +341,72 @@ class ConsensusService:
                         queue_depth=self.queue.queue_depth(),
                     )
             elif reason is not None:
-                self.counters["jobs_rejected"] += 1
-                if tr is not None:
-                    tr.event(
-                        "job_rejected", job=job_id, lane=f"job-{job_id}",
-                        reason=reason[:200],
-                    )
+                entry = self.queue.jobs.get(job_id, {})
+                if entry.get("shed"):
+                    # admission-control rejection: valid job, no room
+                    # in its class (or the global bound) — a distinct
+                    # event so overload is legible in the capture
+                    self.counters["jobs_shed"] += 1
+                    if tr is not None:
+                        tr.event(
+                            "job_shed", job=job_id, lane=f"job-{job_id}",
+                            reason=reason[:200],
+                            priority=entry.get("priority", 1),
+                        )
+                else:
+                    self.counters["jobs_rejected"] += 1
+                    if tr is not None:
+                        tr.event(
+                            "job_rejected", job=job_id, lane=f"job-{job_id}",
+                            reason=reason[:200],
+                        )
+
+    def _reclaim_locked(self) -> list[dict]:
+        """One takeover sweep (caller holds the lock): requeue every
+        running job whose lease is expired or whose owner is provably
+        dead. The scan itself rides fault site ``serve.expire`` (the
+        persist inside reclaim_dead does too), so chaos schedules can
+        target takeover even on passes that reclaim nothing."""
+        tr = self._tr
+        reclaimed = _io_retry(
+            "serve.expire",
+            lambda: self.queue.reclaim_dead(
+                self.daemon_id, is_live=_daemon_is_live
+            ),
+            "lease reclaim sweep",
+        )
+        if reclaimed:
+            self.counters["jobs_recovered"] += len(reclaimed)
+        for r in reclaimed:
+            if tr is not None:
+                lane = f"job-{r['job_id']}"
+                tr.event(
+                    "lease_takeover", job=r["job_id"], lane=lane,
+                    reason=r["reason"],
+                    prev_owner=str(r["prev_owner"])[:80],
+                    by=self.daemon_id,
+                )
+                tr.event(
+                    "resume", job=r["job_id"], lane=lane,
+                    decision="requeued_running",
+                )
+        return reclaimed
 
     def _idle_done(self, once: bool) -> bool:
         if not once:
             return False
         with self._lock:
+            # fleet-aware idleness: a job running under ANOTHER
+            # daemon's live lease is still open work — a --once drain
+            # must not declare victory (or strand a waiting takeover)
+            # while the journal holds any open job
+            self.queue.refresh()
+            open_jobs = any(
+                e.get("state") in ("queued", "running")
+                for e in self.queue.jobs.values()
+            )
             return (
-                self.queue.queue_depth() == 0
+                not open_jobs
                 and self._n_running == 0
                 and not self.queue.pending_submissions()
             )
@@ -222,25 +414,46 @@ class ConsensusService:
     def _worker_loop(self, once: bool) -> None:
         try:
             while not self._drain.is_set():
+                claimed = None
                 with self._lock:
                     self._accept_pending_locked()
+                    self._reclaim_locked()
                     job_id = self.sched.pick(self.queue.jobs)
                     if job_id is not None:
-                        entry = self.queue.jobs[job_id]
-                        # journaled spec, not a cached object: a daemon
-                        # restarted onto an old journal must run exactly
-                        # what admission durably recorded
-                        spec = validate_spec(entry["spec"])
-                        self.queue.mark_running(job_id)
-                        first_slice = entry["slices"] == 1
-                        self._n_running += 1
-                if job_id is None:
+                        # the pick is advisory until the CLAIM commits:
+                        # the flock'd transaction re-checks the state,
+                        # so two daemons picking the same job resolve
+                        # to exactly one lease holder. The claim rides
+                        # fault site serve.lease — a transient fault is
+                        # retried, a kill dies with the job still queued
+                        token = _io_retry(
+                            "serve.lease",
+                            lambda: self.queue.claim(
+                                job_id, self.daemon_id, self.lease_s
+                            ),
+                            f"job {job_id} lease claim",
+                        )
+                        if token is not None:
+                            entry = self.queue.jobs[job_id]
+                            # journaled spec, not a cached object: a
+                            # daemon restarted onto an old journal must
+                            # run exactly what admission durably recorded
+                            spec = validate_spec(entry["spec"])
+                            first_slice = entry["slices"] == 1
+                            if first_slice and "admitted_m" in entry:
+                                self._note_latency_locked(
+                                    entry.get("priority", 1), "queue_wait",
+                                    time.monotonic() - entry["admitted_m"],
+                                )
+                            self._n_running += 1
+                            claimed = (spec, first_slice, token)
+                if claimed is None:
                     if self._idle_done(once):
                         return
                     self._drain.wait(self.poll_s)
                     continue
                 try:
-                    self._run_one(spec, first_slice)
+                    self._run_one(*claimed)
                 finally:
                     with self._lock:
                         self._n_running -= 1
@@ -254,7 +467,18 @@ class ConsensusService:
                     self._fatal = e
             self._drain.set()
 
-    def _run_one(self, spec, first_slice: bool) -> None:
+    def _fenced(self, job_id: str, lane: str, detail: str) -> None:
+        """A slice lost its lease: count it, record it, commit nothing.
+        Not a failure — the reclaiming daemon owns the job and will
+        produce the identical bytes."""
+        tr = self._tr
+        with self._lock:
+            self.counters["jobs_fenced"] += 1
+        if tr is not None:
+            tr.event("job_fenced", job=job_id, lane=lane,
+                     detail=detail[:200])
+
+    def _run_one(self, spec, first_slice: bool, token: int) -> None:
         tr = self._tr
         job_id = spec.job_id
         lane = f"job-{job_id}"
@@ -264,22 +488,53 @@ class ConsensusService:
                 n_slice = self.queue.jobs[job_id]["slices"]
             tr.event(
                 "job_started", job=job_id, lane=lane, slice=n_slice,
-                warm=warm, resumed=not first_slice,
+                warm=warm, resumed=not first_slice, token=token,
             )
 
         def should_yield() -> bool:
             with self._lock:
                 return self.sched.others_waiting(self.queue.jobs, job_id)
 
+        on_first_chunk = None
+        if first_slice:
+            with self._lock:
+                entry = self.queue.jobs.get(job_id, {})
+                admitted_m = entry.get("admitted_m")
+                priority = entry.get("priority", 1)
+            if admitted_m is not None:
+
+                def on_first_chunk():
+                    with self._lock:
+                        self._note_latency_locked(
+                            priority, "ttfc",
+                            time.monotonic() - admitted_m,
+                        )
+
+        lease = LeaseContext(
+            queue=self.queue, daemon_id=self.daemon_id, token=token,
+            lease_s=self.lease_s, on_first_chunk=on_first_chunk,
+        )
         t0 = time.monotonic()
         try:
             out = self.worker.run_slice(
-                spec, self.sched.chunk_budget, should_yield, self._drain
+                spec, self.sched.chunk_budget, should_yield, self._drain,
+                lease=lease,
             )
+        except JobFenced as e:
+            self._fenced(job_id, lane, str(e))
+            return
         except Exception as e:  # noqa: BLE001 — job-scoped failure
-            with self._lock:
-                self.queue.mark_failed(job_id, repr(e))
-                self.counters["jobs_failed"] += 1
+            try:
+                with self._lock:
+                    self.queue.mark_failed(
+                        job_id, repr(e), self.daemon_id, token
+                    )
+                    self.counters["jobs_failed"] += 1
+            except JobFenced as f:
+                # the job died HERE but was already reclaimed: the new
+                # owner decides its fate; this daemon records nothing
+                self._fenced(job_id, lane, str(f))
+                return
             if tr is not None:
                 tr.event("job_failed", job=job_id, lane=lane,
                          error=repr(e)[:200])
@@ -287,10 +542,16 @@ class ConsensusService:
         wall = round(time.monotonic() - t0, 3)
         if out[0] == "done":
             _, result = out
-            with self._lock:
-                self.queue.mark_done(job_id, result)
-                self.counters["jobs_done"] += 1
-                self._job_seconds[job_id] = result.get("seconds", {})
+            try:
+                with self._lock:
+                    self.queue.mark_done(
+                        job_id, result, self.daemon_id, token
+                    )
+                    self.counters["jobs_done"] += 1
+                    self._job_seconds[job_id] = result.get("seconds", {})
+            except JobFenced as f:
+                self._fenced(job_id, lane, str(f))
+                return
             if tr is not None:
                 tr.event(
                     "job_completed", job=job_id, lane=lane, wall_s=wall,
@@ -304,14 +565,20 @@ class ConsensusService:
             def _requeue():
                 with self._lock:
                     self.queue.requeue(
-                        job_id, chunks_done, back=(reason == "budget")
+                        job_id, chunks_done, back=(reason == "budget"),
+                        daemon_id=self.daemon_id, token=token,
                     )
 
             # serve.preempt guards the preemption commit: a transient
             # fault re-runs the idempotent requeue; an injected kill
-            # leaves the job journaled "running", which restart recovery
-            # requeues — the same convergence a real crash gets
-            _io_retry("serve.preempt", _requeue, f"job {job_id} requeue")
+            # leaves the job journaled "running" under this lease,
+            # which takeover (expiry/dead-owner) requeues — the same
+            # convergence a real crash gets
+            try:
+                _io_retry("serve.preempt", _requeue, f"job {job_id} requeue")
+            except JobFenced as f:
+                self._fenced(job_id, lane, str(f))
+                return
             with self._lock:
                 self.counters["preemptions"] += 1
             if tr is not None:

@@ -21,6 +21,7 @@ slice needs and nothing else. Fault-site scoping: a job carrying a
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import threading
@@ -32,6 +33,7 @@ from duplexumiconsensusreads_tpu.serve.job import (
     serve_provenance,
     spec_signature,
 )
+from duplexumiconsensusreads_tpu.serve.queue import LEASE_DEFAULT_S, SpoolQueue
 
 
 class JobPreempted(Exception):
@@ -43,6 +45,26 @@ class JobPreempted(Exception):
         super().__init__(f"preempted after {chunks_done} chunks ({reason})")
         self.chunks_done = chunks_done
         self.reason = reason
+
+
+@dataclasses.dataclass
+class LeaseContext:
+    """The slice's fleet identity: which lease it runs under and how to
+    keep it alive. The worker turns this into the executor's
+    ``commit_guard``: before EVERY durable chunk commit the fencing
+    token is verified against the journal (site ``serve.fence``) and
+    the lease deadline is pushed out (site ``serve.renew``) — so a
+    healthy slice can never expire mid-run, and a zombie slice aborts
+    via :class:`~..serve.queue.JobFenced` before splicing a byte.
+    ``on_first_chunk`` (optional) fires once, right after the job's
+    first fresh chunk of its first slice is durable — the service's
+    time-to-first-chunk sample."""
+
+    queue: SpoolQueue
+    daemon_id: str
+    token: int
+    lease_s: float = LEASE_DEFAULT_S
+    on_first_chunk: object = None
 
 
 def _ckpt_done_count(out_path: str) -> int:
@@ -106,14 +128,20 @@ class WarmWorker:
         budget: int,
         should_yield,
         drain_event: threading.Event,
+        lease: LeaseContext | None = None,
     ):
         """One slice of ``spec``. Returns ("done", report_dict) or
-        ("preempted", chunks_done, reason); job errors propagate.
+        ("preempted", chunks_done, reason); job errors propagate, and a
+        lost lease surfaces as :class:`~..serve.queue.JobFenced` (a
+        BaseException — nothing here may absorb it).
 
         ``budget`` bounds FRESH chunks this slice commits (0 = no
         bound); ``should_yield()`` is consulted before yielding so the
-        budget only preempts when another job is actually waiting."""
+        budget only preempts when another job is actually waiting.
+        ``lease`` (fleet mode) wires the fencing/renewal commit guard —
+        see :class:`LeaseContext`."""
         from duplexumiconsensusreads_tpu.runtime.stream import (
+            _io_retry,
             stream_call_consensus,
         )
 
@@ -121,12 +149,45 @@ class WarmWorker:
         n_resumed = _ckpt_done_count(spec.output)
         commits = [0]
 
+        commit_guard = None
+        if lease is not None:
+
+            def commit_guard(_k):
+                # pre-commit, on the executor main thread: one fenced
+                # RENEWAL transaction — renew_lease verifies the token
+                # first (raising JobFenced through both ladders on a
+                # mismatch) and pushes the deadline out in the same
+                # flock'd journal write, so the guard costs a single
+                # transaction per chunk. The two nested retry ladders
+                # keep the fence check and the renewal persist
+                # individually targetable by chaos schedules
+                # (serve.fence / serve.renew) while transient faults at
+                # either site are absorbed.
+                _io_retry(
+                    "serve.fence",
+                    lambda: _io_retry(
+                        "serve.renew",
+                        lambda: lease.queue.renew_lease(
+                            spec.job_id, lease.daemon_id, lease.token,
+                            lease.lease_s,
+                        ),
+                        f"job {spec.job_id} lease renewal",
+                    ),
+                    f"job {spec.job_id} fence check",
+                )
+
         def progress(_k, _rep):
             # called on the executor's main thread inside _commit, after
             # chunk _k's checkpoint mark is durable — the one point where
             # yielding is free by the resume contract
             commits[0] += 1
             fresh = commits[0] - n_resumed
+            if (
+                fresh == 1
+                and lease is not None
+                and lease.on_first_chunk is not None
+            ):
+                lease.on_first_chunk()
             if drain_event.is_set():
                 raise JobPreempted(commits[0], "drain")
             if budget > 0 and fresh >= budget and should_yield():
@@ -150,6 +211,7 @@ class WarmWorker:
                 n_devices=self.n_devices,
                 resume=True,
                 progress=progress,
+                commit_guard=commit_guard,
                 trace_path=spec.trace,
                 # canonical config-derived @PG CL: the job's bytes must
                 # not depend on the daemon's argv or restart history

@@ -159,8 +159,9 @@ def capture_kind(records: list[dict]) -> str:
 
 
 _JOB_EVENTS = (
-    "job_accepted", "job_rejected", "job_started", "job_preempted",
-    "job_completed", "job_failed",
+    "job_accepted", "job_rejected", "job_shed", "job_started",
+    "job_preempted", "job_completed", "job_failed",
+    "lease_takeover", "job_fenced",
 )
 
 

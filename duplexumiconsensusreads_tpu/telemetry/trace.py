@@ -80,11 +80,16 @@ KNOWN_EVENTS = (
     # a "job-<id>" lane, so one capture decomposes per job the way a run
     # capture decomposes per chunk (validate_service_trace enforces it).
     "job_accepted",  # admission: inbox submission -> journaled queue
-    "job_rejected",  # admission refused (bounded queue / invalid spec)
+    "job_rejected",  # admission refused (invalid spec)
+    "job_shed",  # admission-control rejection: class/queue bound hit
     "job_started",  # a scheduler slice began (attrs: slice, resumed)
     "job_preempted",  # chunk-boundary yield (budget or drain)
     "job_completed",  # finalise done (attrs: wall_s, per-phase seconds)
     "job_failed",  # slice raised; job journaled failed, service lives on
+    # fleet lease protocol (serve/queue.py): takeover of a dead/expired
+    # lease, and a zombie slice aborted by its stale fencing token
+    "lease_takeover",  # running job reclaimed (attrs: reason, prev_owner)
+    "job_fenced",  # slice lost its lease; committed nothing, not a failure
 )
 
 

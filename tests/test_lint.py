@@ -40,10 +40,11 @@ def rules_of(result):
 # ---------------------------------------------------------------- engine
 
 class TestEngine:
-    def test_registry_has_the_six_invariant_rules(self):
+    def test_registry_has_the_invariant_rules(self):
         assert {
             "clock-discipline", "durability-protocol", "fault-registry",
             "phase-registry", "lock-discipline", "hook-guard",
+            "lease-discipline",
         } <= set(RULES)
         for rule in RULES.values():
             assert rule.title
@@ -487,6 +488,102 @@ class TestLockDiscipline:
         assert res.ok  # rule scope is stream.py + trace.py only
 
 
+class TestLeaseDiscipline:
+    FAULTS = 'KNOWN_SITES = ("shard.write", "serve.lease", "serve.fence")\n'
+    QUEUE_OK = """
+        from pkg.io.durable import write_durable
+        class Q:
+            def claim(self, entry):
+                entry["token"] = 1
+                entry["lease"] = {"owner": "d"}
+                self.save()
+            def release(self, entry):
+                entry.pop("lease", None)
+                self.save()
+            def save(self):
+                write_durable("queue.json", b"{}")
+        """
+    SERVICE_OK = """
+        def loop(q):
+            _io_retry("serve.lease", q.claim, "claim")
+            _io_retry("serve.fence", q.verify, "fence")
+        """
+    TESTS_OK = """
+        SERVE_SITES = ("serve.lease", "serve.fence")
+        def test_kill_matrix():
+            run("serve.lease:1:kill")
+            run("serve.fence:1:kill")
+        """
+
+    def base(self, **over):
+        files = {
+            "pkg/runtime/faults.py": self.FAULTS,
+            "pkg/serve/queue.py": self.QUEUE_OK,
+            "pkg/serve/service.py": self.SERVICE_OK,
+            "tests/test_serve.py": self.TESTS_OK,
+        }
+        files.update(over)
+        return lint(files, rules=["lease-discipline"])
+
+    def test_passes_when_consistent(self):
+        assert self.base().ok
+
+    def test_fires_on_unregistered_serve_site(self):
+        res = self.base(**{"pkg/serve/worker.py": """
+            def g(f):
+                _io_retry("serve.typo", f, "x")
+            """})
+        assert rules_of(res) == [("lease-discipline", "pkg/serve/worker.py")]
+        assert "serve.typo" in res.findings[0].message
+        # non-serve sites in serve/ are the fault-registry rule's job
+        ok = self.base(**{"pkg/serve/worker.py": """
+            def g(f):
+                _io_retry("shard.write", f, "x")
+            """})
+        assert ok.ok
+
+    def test_fires_on_serving_suite_coverage_gap(self):
+        res = self.base(**{"tests/test_serve.py": """
+            def test_only_lease():
+                run("serve.lease:1:kill")
+            """})
+        assert [f.rule for f in res.findings] == ["lease-discipline"]
+        assert "serve.fence" in res.findings[0].message
+        assert res.findings[0].path == "tests/test_serve.py"
+
+    def test_missing_serving_suite_skips_coverage_check(self):
+        files = {
+            "pkg/runtime/faults.py": self.FAULTS,
+            "pkg/serve/queue.py": self.QUEUE_OK,
+            "pkg/serve/service.py": self.SERVICE_OK,
+        }
+        assert lint(files, rules=["lease-discipline"]).ok
+
+    def test_fires_on_undurable_lease_mutation(self):
+        res = self.base(**{"pkg/serve/queue.py": self.QUEUE_OK + """
+        def steal(entry):
+            entry["lease"] = {"owner": "thief"}
+        """})
+        assert [f.rule for f in res.findings] == ["lease-discipline"]
+        assert "steal" in res.findings[0].message
+        assert "save" in res.findings[0].hint
+
+    def test_fires_on_undurable_lease_pop(self):
+        res = self.base(**{"pkg/serve/queue.py": self.QUEUE_OK + """
+        def drop(entry):
+            entry.pop("lease", None)
+        """})
+        assert [f.rule for f in res.findings] == ["lease-discipline"]
+        assert "drop" in res.findings[0].message
+
+    def test_read_only_lease_access_needs_no_persist(self):
+        res = self.base(**{"pkg/serve/queue.py": self.QUEUE_OK + """
+        def check(entry, token):
+            return entry["lease"]["owner"] == "d" and entry["token"] == token
+        """})
+        assert res.ok  # reads fence; only WRITES must persist
+
+
 class TestHookGuard:
     def test_fires_on_unguarded_hook(self):
         res = lint(
@@ -618,6 +715,9 @@ class TestShippedTree:
             "tools/profile_components.py", "tools/profile_phases.py",
             "tools/tune_ssc.py",
             "tests/test_chaos.py", "tests/test_telemetry.py",
+            # the serving suite anchors the lease-discipline rule's
+            # serve.*-site coverage check
+            "tests/test_serve.py",
             os.path.join("duplexumiconsensusreads_tpu", "runtime",
                          "stream.py"),
             os.path.join("duplexumiconsensusreads_tpu", "serve",

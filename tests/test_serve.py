@@ -40,6 +40,8 @@ from duplexumiconsensusreads_tpu.serve.job import (
     spec_signature,
     validate_spec,
 )
+from duplexumiconsensusreads_tpu.serve.queue import JobFenced
+from duplexumiconsensusreads_tpu.serve.scheduler import parse_class_depths
 from duplexumiconsensusreads_tpu.simulate import SimConfig
 from duplexumiconsensusreads_tpu.telemetry import report as trace_report
 from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
@@ -53,6 +55,24 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONFIG = dict(grouping="adjacency", mode="duplex", capacity=128, chunk_reads=90)
 GP = GroupingParams(strategy="adjacency", paired=True)
 CP = ConsensusParams(mode="duplex")
+
+# every fault site the serving layer owns — the registry-pin test and
+# the dutlint lease-discipline rule both anchor on this tuple, and the
+# FLEET subset drives the per-site kill/takeover matrix below
+SERVE_SITES = (
+    "serve.accept", "serve.journal", "serve.preempt",
+    "serve.lease", "serve.renew", "serve.expire", "serve.fence",
+)
+FLEET_SITES = ("serve.lease", "serve.renew", "serve.expire", "serve.fence")
+
+
+def test_serve_sites_registered():
+    """The serving layer's site registry pin: KNOWN_SITES and this
+    suite agree on exactly which sites serve/ owns."""
+    assert set(SERVE_SITES) == {
+        s for s in faults.KNOWN_SITES if s.startswith("serve.")
+    }
+    assert set(FLEET_SITES) <= set(SERVE_SITES)
 
 
 @pytest.fixture(scope="module")
@@ -223,6 +243,57 @@ class TestSpoolQueue:
                             config=dict(CONFIG))
         assert q.status(jid)["state"] == "submitted"
 
+    def test_compaction_round_trip_preserves_leases_and_decisions(
+        self, tmp_path
+    ):
+        """The compaction satellite: a save (which compacts) followed
+        by a fresh load must leave non-terminal entries — INCLUDING
+        their lease/token state — intact, so the reloaded journal
+        yields identical scheduler decisions and identical fencing
+        verdicts."""
+        q = SpoolQueue(str(tmp_path), max_terminal_kept=1)
+        for i in range(3):  # terminal ballast beyond the cap
+            jid = client.submit(str(tmp_path), __file__,
+                                str(tmp_path / f"t{i}.bam"),
+                                config=dict(CONFIG))
+            q.accept_one(jid)
+            q.mark_failed(jid, f"ballast {i}")
+        running = client.submit(str(tmp_path), __file__,
+                                str(tmp_path / "run.bam"),
+                                config=dict(CONFIG))
+        q.accept_one(running)
+        token = q.claim(running, "daemon-1", lease_s=60.0)
+        waiting = []
+        for pri in (1, 0):
+            w = client.submit(str(tmp_path), __file__,
+                              str(tmp_path / f"w{pri}.bam"),
+                              config=dict(CONFIG), priority=pri)
+            q.accept_one(w)
+            waiting.append(w)
+        pick_before = FairScheduler.pick(q.jobs)
+        q.save()  # compacts the terminal ballast
+        q2 = SpoolQueue(str(tmp_path), max_terminal_kept=1)
+        # identical scheduler decision from the reloaded journal
+        assert FairScheduler.pick(q2.jobs) == pick_before == waiting[1]
+        # the running job's lease survived the rewrite verbatim
+        e = q2.jobs[running]
+        assert e["state"] == "running" and e["token"] == token == 1
+        assert e["lease"]["owner"] == "daemon-1"
+        assert e["lease"]["expires_m"] == q.jobs[running]["lease"]["expires_m"]
+        # identical fencing verdicts: the current token passes, a stale
+        # or foreign one is fenced
+        q2.verify_lease(running, "daemon-1", token)
+        with pytest.raises(JobFenced):
+            q2.verify_lease(running, "daemon-1", token + 1)
+        with pytest.raises(JobFenced):
+            q2.verify_lease(running, "daemon-2", token)
+        # terminal ballast compacted to the cap, open entries untouched
+        n_terminal = sum(
+            1 for e in q2.jobs.values() if e["state"] == "failed"
+        )
+        assert n_terminal == 1
+        assert {running, *waiting} <= set(q2.jobs)
+
     def test_journal_compaction_bounds_terminal_entries(self, tmp_path):
         """A long-lived daemon's journal is rewritten+fsynced on every
         transition, so it must stay bounded: terminal entries beyond
@@ -300,6 +371,15 @@ class TestServiceSoak:
             metrics = json.load(f)
         assert metrics["jobs_done"] == 3
         assert set(metrics["job_seconds"]) == {j for j, _ in jobs}
+        assert metrics["daemon_id"] == svc.daemon_id
+        # per-class SLO surface: both priority classes carry queue-wait
+        # and time-to-first-chunk percentiles
+        lat = metrics["class_latency"]
+        assert set(lat) == {"0", "1"}
+        for row in lat.values():
+            assert row["n_queue_wait"] >= 1 and row["n_ttfc"] >= 1
+            assert row["queue_wait_p95_s"] >= row["queue_wait_p50_s"] >= 0
+            assert row["ttfc_p95_s"] >= row["ttfc_p50_s"] >= 0
         # the capture validates as a service capture, with a summary
         recs, events = _events(trace)
         assert trace_report.validate_service_trace(recs) == []
@@ -404,15 +484,16 @@ class TestCrashRecovery:
     def test_kill_between_accept_and_dispatch_runs_exactly_once(
         self, sim, tmp_path
     ):
-        """The queue-journal crash-recovery satellite: journal save #1
-        is the admission write, #2 is mark_running — a kill there lands
-        AFTER the job is durably accepted and BEFORE any work was
-        dispatched. The restarted daemon must run it exactly once and
-        produce the one-shot bytes."""
+        """The queue-journal crash-recovery satellite: a kill at the
+        lease claim (site serve.lease) lands AFTER the job is durably
+        accepted and BEFORE any work was dispatched — the claim never
+        persisted, so the journal still says queued. The restarted
+        daemon must run it exactly once and produce the one-shot
+        bytes."""
         in_path, ref_bytes = sim
         spool = str(tmp_path / "spool")
         jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
-        faults.install(faults.FaultPlan.parse("serve.journal:2:kill"))
+        faults.install(faults.FaultPlan.parse("serve.lease:1:kill"))
         t1 = str(tmp_path / "svc1.jsonl")
         with pytest.raises(faults.InjectedKill):
             ConsensusService(spool, trace_path=t1).run_until_idle()
@@ -449,27 +530,514 @@ class TestCrashRecovery:
             assert f.read() == ref_bytes
 
     def test_kill_mid_job_resumes_from_checkpoint(self, sim, tmp_path):
-        """A kill inside a running slice (stream site) leaves the job
-        journaled RUNNING; the restarted daemon requeues it and the
-        resumed slice converges to the one-shot bytes."""
+        """Kill-holding-lease: a kill inside a running slice (stream
+        site) leaves the job journaled RUNNING under the dead daemon's
+        lease. The next daemon must detect the dead owner, take the
+        lease over (bumping the fencing token), and converge to the
+        one-shot bytes — the acceptance scenario, in-process."""
         in_path, ref_bytes = sim
         spool = str(tmp_path / "spool")
         jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
         faults.install(faults.FaultPlan.parse("shard.write:3:kill"))
         with pytest.raises(faults.InjectedKill):
-            ConsensusService(spool).run_until_idle()
-        assert SpoolQueue(spool).jobs[jid]["state"] == "running"
+            ConsensusService(spool, daemon_id="victim").run_until_idle()
+        entry = SpoolQueue(spool).jobs[jid]
+        assert entry["state"] == "running"
+        # the dead daemon's lease (token 1) is still in the journal
+        assert entry["lease"]["owner"] == "victim" and entry["token"] == 1
         t2 = str(tmp_path / "svc2.jsonl")
         snap = ConsensusService(spool, trace_path=t2).run_until_idle()
         assert snap["jobs_done"] == 1 and snap["jobs_recovered"] == 1
         with open(out, "rb") as f:
             assert f.read() == ref_bytes
+        # takeover bumped the token: the victim's lease is fenced off
+        entry = SpoolQueue(spool).jobs[jid]
+        assert entry["token"] == 2 and "lease" not in entry
+        assert entry["slices"] == 2  # one victim slice + one takeover slice
         recs, ev2 = _events(t2)
-        # the restart recorded the recovery decision
+        # the restart recorded both the takeover and the recovery decision
+        tk = [e for e in ev2 if e["name"] == "lease_takeover"]
+        assert len(tk) == 1 and tk[0]["job"] == jid
+        assert tk[0]["reason"] == "dead-owner"
         assert any(
             e["name"] == "resume" and e.get("decision") == "requeued_running"
             for e in ev2
         )
+
+
+class TestLeaseProtocol:
+    """The lease/claim state machine on the bare queue — no service,
+    no device: claims bump the fencing token, renewal is fenced,
+    expiry/dead-owner leases reclaim, and every verdict comes from the
+    durable journal (a fresh SpoolQueue sees the same thing)."""
+
+    def _queued(self, tmp_path, name="job"):
+        q = SpoolQueue(str(tmp_path))
+        jid = client.submit(str(tmp_path), __file__,
+                            str(tmp_path / f"{name}.bam"),
+                            config=dict(CONFIG))
+        assert q.accept_one(jid)[0] is not None
+        return q, jid
+
+    def test_claim_bumps_token_and_is_exclusive(self, tmp_path):
+        q, jid = self._queued(tmp_path)
+        token = q.claim(jid, "d1", lease_s=60.0)
+        assert token == 1
+        e = q.jobs[jid]
+        assert e["state"] == "running" and e["lease"]["owner"] == "d1"
+        assert e["lease"]["pid"] == os.getpid()
+        # a second claim of a RUNNING job must lose, whoever asks
+        assert q.claim(jid, "d2", lease_s=60.0) is None
+        assert q.claim(jid, "d1", lease_s=60.0) is None
+        # and another queue instance (another daemon) sees the lease
+        assert SpoolQueue(str(tmp_path)).jobs[jid]["lease"]["owner"] == "d1"
+
+    def test_verify_and_renew_are_fenced(self, tmp_path):
+        q, jid = self._queued(tmp_path)
+        token = q.claim(jid, "d1", lease_s=60.0)
+        q.verify_lease(jid, "d1", token)
+        before = q.jobs[jid]["lease"]["expires_m"]
+        q.renew_lease(jid, "d1", token, lease_s=120.0)
+        assert q.jobs[jid]["lease"]["expires_m"] > before
+        for daemon, tok in (("d2", token), ("d1", token + 1), ("d1", 0)):
+            with pytest.raises(JobFenced):
+                q.verify_lease(jid, daemon, tok)
+            with pytest.raises(JobFenced):
+                q.renew_lease(jid, daemon, tok)
+
+    def test_expired_lease_reclaims_and_next_claim_fences_zombie(
+        self, tmp_path
+    ):
+        q, jid = self._queued(tmp_path)
+        token = q.claim(jid, "d1", lease_s=0.05)
+        time.sleep(0.08)
+        rec = q.reclaim_dead("d2")
+        assert [r["job_id"] for r in rec] == [jid]
+        assert rec[0]["reason"] == "expired" and rec[0]["prev_owner"] == "d1"
+        assert q.jobs[jid]["state"] == "queued" and "lease" not in q.jobs[jid]
+        # takeover claim bumps the token past the zombie's
+        token2 = q.claim(jid, "d2", lease_s=60.0)
+        assert token2 == token + 1
+        with pytest.raises(JobFenced):  # the zombie is fenced everywhere
+            q.verify_lease(jid, "d1", token)
+        with pytest.raises(JobFenced):
+            q.requeue(jid, 1, back=False, daemon_id="d1", token=token)
+        with pytest.raises(JobFenced):
+            q.mark_done(jid, {"n": 1}, daemon_id="d1", token=token)
+        # the journal is untouched by the fenced attempts
+        assert q.jobs[jid]["state"] == "running"
+        assert q.jobs[jid]["lease"]["owner"] == "d2"
+
+    def test_live_lease_is_not_reclaimed(self, tmp_path):
+        q, jid = self._queued(tmp_path)
+        q.claim(jid, "d1", lease_s=60.0)
+        # same pid, no liveness oracle: the owner could be a live
+        # daemon in this process — only expiry may take it
+        assert q.reclaim_dead("d2") == []
+        # with a liveness oracle saying d1 is live: still protected
+        assert q.reclaim_dead("d2", is_live=lambda d: d == "d1") == []
+        # oracle says dead (in-process daemon unwound): reclaimed now
+        rec = q.reclaim_dead("d2", is_live=lambda d: False)
+        assert rec and rec[0]["reason"] == "dead-owner"
+
+    def test_dead_pid_lease_is_reclaimed_immediately(self, tmp_path):
+        q, jid = self._queued(tmp_path)
+        q.claim(jid, "d1", lease_s=3600.0)
+        # forge the lease onto a pid that is provably dead (a spawned
+        # and reaped child), as a SIGKILLed daemon would leave it
+        child = subprocess.Popen([sys.executable, "-c", "pass"])
+        child.wait()
+        with q._txn():
+            q.jobs[jid]["lease"]["pid"] = child.pid
+            q.save()
+        rec = q.reclaim_dead("d2")
+        assert rec and rec[0]["reason"] == "dead-owner"
+        assert q.jobs[jid]["state"] == "queued"
+
+    def test_legacy_running_entry_without_lease_is_reclaimed(self, tmp_path):
+        """A pre-lease journal (or a torn claim) can say running with
+        no lease at all: recovery must requeue it, not strand it."""
+        q, jid = self._queued(tmp_path)
+        with q._txn():
+            q.jobs[jid]["state"] = "running"
+            q.save()
+        rec = q.reclaim_dead("d1")
+        assert rec and rec[0]["reason"] == "no-lease"
+        assert q.jobs[jid]["state"] == "queued"
+
+    def test_done_requeue_and_fail_release_the_lease(self, tmp_path):
+        q, jid = self._queued(tmp_path)
+        token = q.claim(jid, "d1", lease_s=60.0)
+        q.requeue(jid, 2, back=True, daemon_id="d1", token=token)
+        e = q.jobs[jid]
+        assert e["state"] == "queued" and "lease" not in e
+        assert e["token"] == token  # token survives the release...
+        token2 = q.claim(jid, "d1", lease_s=60.0)
+        assert token2 == token + 1  # ...so the next claim still bumps it
+        q.mark_done(jid, {"ok": 1}, daemon_id="d1", token=token2)
+        e = q.jobs[jid]
+        assert e["state"] == "done" and "lease" not in e
+
+
+class TestFleet:
+    """N daemons, one spool: exactly-once under concurrency, takeover
+    of a killed daemon, and a fenced zombie — the tentpole acceptance
+    scenarios."""
+
+    def test_two_daemons_one_spool_exactly_once(self, sim, tmp_path):
+        """Two services drain the same spool concurrently: every job
+        completes exactly once (across BOTH captures), byte-identical
+        to the one-shot reference."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jobs = _submit_n(spool, in_path, tmp_path, 4)
+        traces = [str(tmp_path / f"svc{i}.jsonl") for i in (0, 1)]
+        svcs = [
+            ConsensusService(
+                spool, chunk_budget=2, poll_s=0.02, trace_path=traces[i],
+                daemon_id=f"fleet-{i}",
+            )
+            for i in (0, 1)
+        ]
+        threads = [
+            threading.Thread(target=s.run_until_idle, daemon=True)
+            for s in svcs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+        for jid, out in jobs:
+            assert client.status(spool, jid)["state"] == "done"
+            with open(out, "rb") as f:
+                assert f.read() == ref_bytes
+        completed = []
+        for tp in traces:
+            _, ev = _events(tp)
+            completed += [
+                e["job"] for e in ev if e["name"] == "job_completed"
+            ]
+        # exactly once ACROSS the fleet, not per daemon
+        assert sorted(completed) == sorted(j for j, _ in jobs)
+        assert sum(s.counters["jobs_done"] for s in svcs) == len(jobs)
+        assert sum(s.counters["jobs_fenced"] for s in svcs) == 0
+
+    @pytest.mark.parametrize("site,nth", [
+        ("serve.lease", 1),   # dies claiming: job still queued
+        ("serve.renew", 1),   # dies at the first commit's renewal
+        ("serve.fence", 2),   # dies at a later commit's fence check
+        ("serve.expire", 1),  # dies in the startup takeover sweep
+    ])
+    def test_kill_at_fleet_site_then_restart_exactly_once(
+        self, site, nth, sim, tmp_path
+    ):
+        """The per-site kill matrix over the lease protocol's own fault
+        sites: wherever the daemon dies, a successor runs the job
+        exactly once and byte-identical."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        faults.install(faults.FaultPlan.parse(f"{site}:{nth}:kill"))
+        with pytest.raises(faults.InjectedKill):
+            ConsensusService(spool, chunk_budget=1).run_until_idle()
+        faults.uninstall()
+        t2 = str(tmp_path / "svc2.jsonl")
+        snap = ConsensusService(spool, trace_path=t2).run_until_idle()
+        assert snap["jobs_done"] == 1 and snap["jobs_failed"] == 0
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        _, ev = _events(t2)
+        assert len([e for e in ev if e["name"] == "job_completed"]) == 1
+
+    def test_zombie_daemon_is_fenced_after_expiry_takeover(
+        self, sim, tmp_path
+    ):
+        """The zombie acceptance scenario: daemon A pauses mid-job
+        (renewals stop, lease expires), daemon B takes the job over and
+        finishes it, then A wakes up — its next commit must be fenced
+        by the stale token, with zero corrupted outputs and exactly one
+        completion."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        t_a = str(tmp_path / "svcA.jsonl")
+        svc_a = ConsensusService(
+            spool, chunk_budget=1, trace_path=t_a, poll_s=0.05,
+            lease_s=0.4, daemon_id="daemon-A",
+        )
+        paused = threading.Event()
+        resume = threading.Event()
+        orig = svc_a.worker.run_slice
+
+        def pausing_run_slice(spec, budget, should_yield, drain_event,
+                              lease=None):
+            # the budget check consults should_yield right after the
+            # first fresh chunk commit — a deterministic mid-job pause
+            # point with the lease held and renewals stopped
+            def pause_then_no_yield():
+                paused.set()
+                resume.wait(timeout=120)
+                return False
+
+            return orig(spec, 1, pause_then_no_yield, drain_event,
+                        lease=lease)
+
+        svc_a.worker.run_slice = pausing_run_slice
+        box = {}
+        th = threading.Thread(
+            target=lambda: box.setdefault("snap", svc_a.run_until_idle()),
+            daemon=True,
+        )
+        th.start()
+        assert paused.wait(timeout=120), "daemon A never reached its pause"
+        # A is now a zombie: lease held, renewals stopped. Wait out the
+        # lease, then let daemon B take over and finish the job.
+        time.sleep(0.5)
+        t_b = str(tmp_path / "svcB.jsonl")
+        snap_b = ConsensusService(
+            spool, trace_path=t_b, poll_s=0.05, daemon_id="daemon-B",
+        ).run_until_idle()
+        assert snap_b["jobs_done"] == 1 and snap_b["jobs_recovered"] == 1
+        # wake the zombie: its very next durable commit must fence
+        resume.set()
+        th.join(timeout=120)
+        assert not th.is_alive() and "snap" in box
+        snap_a = box["snap"]
+        assert snap_a["jobs_fenced"] == 1
+        assert snap_a["jobs_done"] == 0 and snap_a["jobs_failed"] == 0
+        # zero corrupted outputs: the published BAM is byte-identical
+        # and the journal records B's completion under B's token
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        entry = SpoolQueue(spool).jobs[jid]
+        assert entry["state"] == "done" and entry["token"] == 2
+        _, ev_a = _events(t_a)
+        _, ev_b = _events(t_b)
+        completed = [
+            e for e in ev_a + ev_b if e["name"] == "job_completed"
+        ]
+        assert len(completed) == 1  # exactly once, by B
+        tk = [e for e in ev_b if e["name"] == "lease_takeover"]
+        assert len(tk) == 1 and tk[0]["reason"] == "expired"
+        assert any(e["name"] == "job_fenced" for e in ev_a)
+
+    def test_two_subprocess_daemons_kill_and_takeover(self, sim, tmp_path):
+        """The real thing: daemon A (subprocess) claims the job and is
+        SIGKILLed mid-slice; daemon B on the same spool detects the
+        dead owner, takes the lease over, and finishes exactly once,
+        byte-identical."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "duplexumiconsensusreads_tpu.serve.daemon",
+             spool, "--poll", "0.05", "--heartbeat", "0.2",
+             "--lease", "30", "--daemon-id", "sub-A"],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            deadline = time.monotonic() + 120
+            claimed = False
+            while time.monotonic() < deadline:
+                st = client.status(spool, jid)
+                if st.get("state") == "running" and st.get("lease"):
+                    claimed = True
+                    break
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            assert claimed, (
+                proc.communicate()[1] if proc.poll() is not None
+                else "job never claimed"
+            )
+            proc.kill()  # SIGKILL: no drain, the lease stays journaled
+            proc.communicate()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        st = client.status(spool, jid)
+        assert st["state"] == "running" and st["lease"]["owner"] == "sub-A"
+        # daemon B: the owner pid is provably dead, so takeover is
+        # immediate — no 30s lease wait
+        p2 = subprocess.run(
+            [sys.executable, "-m", "duplexumiconsensusreads_tpu.serve.daemon",
+             spool, "--once", "--poll", "0.05", "--heartbeat", "0",
+             "--daemon-id", "sub-B"],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+        assert p2.returncode == 0, p2.stderr
+        st = client.status(spool, jid)
+        assert st["state"] == "done" and st["token"] == 2
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        # B's capture (A's rotated to .prev) holds the takeover and the
+        # single completion
+        recs, ev = _events(os.path.join(spool, "service.trace.jsonl"))
+        assert trace_report.validate_service_trace(recs) == []
+        assert len([e for e in ev if e["name"] == "job_completed"]) == 1
+        tk = [e for e in ev if e["name"] == "lease_takeover"]
+        assert len(tk) == 1 and tk[0]["reason"] == "dead-owner"
+        assert tk[0]["prev_owner"] == "sub-A"
+        # and serve_report surfaces the takeover (not just the raw event)
+        p3 = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_report.py"),
+             os.path.join(spool, "service.trace.jsonl"), "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p3.returncode == 0, p3.stderr
+        rep = json.loads(p3.stdout)
+        assert rep["n_takeovers"] == 1 and rep["n_done"] == 1
+        assert rep["jobs"][jid]["takeovers"] == 1
+        assert rep["jobs"][jid]["takeover_reason"] == "dead-owner"
+
+
+class TestAdmissionControl:
+    def test_class_depth_shed_with_reason(self, sim, tmp_path, capsys):
+        """Per-class admission control: submissions beyond their
+        class's queued-depth bound are shed with a journaled reason,
+        the shed surfaces through --status, and the service still runs
+        what it admitted."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        trace = str(tmp_path / "svc.jsonl")
+        jobs = _submit_n(spool, in_path, tmp_path, 3)
+        svc = ConsensusService(
+            spool, chunk_budget=0, trace_path=trace, class_depths={1: 1},
+        )
+        snap = svc.run_until_idle()
+        assert snap["jobs_done"] == 1 and snap["jobs_shed"] == 2
+        assert snap["jobs_rejected"] == 0  # sheds are not spec errors
+        states = {jid: client.status(spool, jid) for jid, _ in jobs}
+        shed = [st for st in states.values() if st.get("shed")]
+        assert len(shed) == 2
+        for st in shed:
+            assert st["state"] == "rejected"
+            assert st["error"].startswith("shed: priority class 1")
+        done = [jid for jid, st in states.items() if st["state"] == "done"]
+        assert len(done) == 1
+        with open(dict(jobs)[done[0]], "rb") as f:
+            assert f.read() == ref_bytes
+        # the capture distinguishes sheds from invalid-spec rejections
+        _, ev = _events(trace)
+        shed_ev = [e for e in ev if e["name"] == "job_shed"]
+        assert len(shed_ev) == 2
+        assert all("admission control" in e["reason"] for e in shed_ev)
+        # and the CLI surfaces the reason on --status (exit 1 + stderr)
+        from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+
+        shed_jid = next(j for j, st in states.items() if st.get("shed"))
+        rc = cli_main(["call", "--status", shed_jid, "--spool", spool])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert json.loads(captured.out)["shed"] is True
+        assert "shed by admission control" in captured.err
+
+    def test_parse_class_depths(self):
+        assert parse_class_depths("0=8,1=4") == {0: 8, 1: 4}
+        assert parse_class_depths(" 2=1 ") == {2: 1}
+        for bad in ("0", "a=1", "0=0", "0=-1", "-1=2", "0:3"):
+            with pytest.raises(ValueError):
+                parse_class_depths(bad)
+
+    def test_global_bound_sheds_with_reason(self, tmp_path):
+        """The pre-existing global open-jobs bound now sheds with the
+        same explicit shed marker as the class bounds."""
+        q = SpoolQueue(str(tmp_path), max_queue=1)
+        j1 = client.submit(str(tmp_path), __file__, str(tmp_path / "a.bam"),
+                           config=dict(CONFIG))
+        j2 = client.submit(str(tmp_path), __file__, str(tmp_path / "b.bam"),
+                           config=dict(CONFIG))
+        assert q.accept_one(j1)[0] is not None
+        spec, reason = q.accept_one(j2)
+        assert spec is None and reason.startswith("shed: queue full")
+        st = q.status(j2)
+        assert st["state"] == "rejected" and st["shed"] is True
+
+    def test_shed_reason_survives_journal_compaction(self, tmp_path):
+        """Overload is exactly when sheds are frequent AND journal
+        churn is fastest: a shed verdict must outlive its journal
+        entry's compaction (durable rejection results, like
+        done/failed), not degrade to 'unknown'."""
+        q = SpoolQueue(str(tmp_path), max_queue=1, max_terminal_kept=0)
+        j1 = client.submit(str(tmp_path), __file__, str(tmp_path / "a.bam"),
+                           config=dict(CONFIG))
+        j2 = client.submit(str(tmp_path), __file__, str(tmp_path / "b.bam"),
+                           config=dict(CONFIG))
+        assert q.accept_one(j1)[0] is not None
+        _, reason = q.accept_one(j2)  # shed + compacted away immediately
+        assert j2 not in SpoolQueue(str(tmp_path)).jobs
+        st = q.status(j2)
+        assert st["state"] == "rejected" and st["compacted"]
+        assert st["shed"] is True
+        assert "queue full" in st["error"]
+        # invalid-spec rejections survive the same way
+        bad = tmp_path / "inbox" / "job-bad.json"
+        bad.write_text('{"job_id": "job-bad"}')
+        q.accept_one("job-bad")
+        assert "job-bad" not in SpoolQueue(str(tmp_path)).jobs
+        st = q.status("job-bad")
+        assert st["state"] == "rejected" and st["compacted"]
+        assert "input" in st["error"] and "shed" not in st
+
+    def test_sweep_orphan_tmps_removes_dead_writers_litter(self, tmp_path):
+        """Crash litter: pid-suffixed staging files whose writer pid is
+        dead are swept at daemon startup; a live writer's in-flight
+        staging file is untouched."""
+        q = SpoolQueue(str(tmp_path))
+        child = subprocess.Popen([sys.executable, "-c", "pass"])
+        child.wait()
+        dead = tmp_path / f"queue.json.tmp.{child.pid}.140001"
+        dead.write_text("torn half-write")
+        dead2 = tmp_path / "results" / f"job-x.json.tmp.{child.pid}.140002"
+        dead2.write_text("torn")
+        live = tmp_path / f"queue.json.tmp.{os.getpid()}.140003"
+        live.write_text("in flight")
+        other = tmp_path / "queue.json"  # not a tmp: never touched
+        other.write_text('{"jobs": {}, "seq": 0, "version": 1}')
+        assert q.sweep_orphan_tmps() == 2
+        assert not dead.exists() and not dead2.exists()
+        assert live.exists() and other.exists()
+
+
+class TestWaitBackoff:
+    def test_wait_backoff_doubles_jitters_and_caps(self, tmp_path,
+                                                   monkeypatch):
+        """--wait polling satellite: delays double from poll_s toward
+        the ~2s cap, each scaled by jitter in [0.5, 1.0), and the
+        final sleep never overshoots the deadline."""
+        spool = str(tmp_path / "spool")
+        jid = client.submit(spool, __file__, str(tmp_path / "o.bam"),
+                            config=dict(CONFIG))  # submitted, never run
+        clock = [0.0]
+        delays = []
+
+        def fake_monotonic():
+            return clock[0]
+
+        def fake_sleep(s):
+            delays.append(s)
+            clock[0] += s
+
+        monkeypatch.setattr(time, "monotonic", fake_monotonic)
+        monkeypatch.setattr(time, "sleep", fake_sleep)
+        st = client.wait(spool, jid, timeout_s=30.0, poll_s=0.1)
+        assert st["timed_out"] is True and st["state"] == "submitted"
+        assert len(delays) >= 8
+        # nominal schedule 0.1, 0.2, 0.4, ... capped at 2.0; each delay
+        # jitters within [0.5, 1.0] of nominal — except the FINAL sleep,
+        # which is clamped to the remaining deadline and may be shorter
+        nominal = 0.1
+        for d in delays[:-1]:
+            assert 0.5 * nominal - 1e-9 <= d <= nominal + 1e-9
+            nominal = min(nominal * 2, client.WAIT_BACKOFF_CAP_S)
+        assert delays[-1] <= nominal + 1e-9
+        assert max(delays) <= client.WAIT_BACKOFF_CAP_S
+        # the deadline was respected exactly: total sleep <= timeout
+        assert sum(delays) <= 30.0 + 1e-6
 
 
 class TestGracefulDrain:
@@ -536,11 +1104,13 @@ class TestGracefulDrain:
         # chunk) — deterministic mid-job drain, no sleeps
         orig = svc.worker.run_slice
 
-        def run_slice_then_drain(spec, budget, should_yield, drain_event):
+        def run_slice_then_drain(spec, budget, should_yield, drain_event,
+                                 lease=None):
             def drain_not_yield():
                 svc.request_drain()
                 return False
-            return orig(spec, budget, drain_not_yield, drain_event)
+            return orig(spec, budget, drain_not_yield, drain_event,
+                        lease=lease)
 
         svc.worker.run_slice = run_slice_then_drain
         snap = svc.run()

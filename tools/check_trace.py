@@ -14,9 +14,10 @@ run's capture must always be COMPLETE, not merely well-formed.
 The capture KIND is read from the meta header: a ``run`` capture (the
 streaming executor's per-chunk spans, the default) gets the core
 checks; a ``service`` capture (a ``dut-serve`` daemon's job-lifecycle
-record) additionally must keep every job event on its job-scoped
-``job-<id>`` lane and every service heartbeat carrying the queue
-snapshot — the contract ``tools/serve_report.py`` decomposes.
+record) additionally must keep every job event — including the fleet
+events ``job_shed``, ``lease_takeover`` and ``job_fenced`` — on its
+job-scoped ``job-<id>`` lane and every service heartbeat carrying the
+queue snapshot — the contract ``tools/serve_report.py`` decomposes.
 
 The rules live in telemetry/report.py (validate_trace /
 validate_service_trace) so the CLI, the tier-1 tests, and the report

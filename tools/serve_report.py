@@ -8,12 +8,13 @@ heartbeats carrying the queue snapshot, and every switchboard event
 (fault/retry/durable) that fired while jobs ran. This tool decomposes
 it the way ``trace_report.py`` decomposes a run capture:
 
-  * per job: state, slices, preemptions, total slice wall, final
-    chunk/consensus counts, warm (compile-cache hit) or cold start,
-    and the per-phase busy seconds the completing slice reported;
-  * service: admission/completion/failure counts, preemption total,
-    compile-cache hit rate, queue-depth curve (max/mean over the
-    heartbeats), retry/fault event counts.
+  * per job: state, slices, preemptions, lease takeovers, fenced
+    (zombie) slices, total slice wall, final chunk/consensus counts,
+    warm (compile-cache hit) or cold start, and the per-phase busy
+    seconds the completing slice reported;
+  * service: admission/shed/completion/failure counts, preemption and
+    takeover totals, compile-cache hit rate, queue-depth curve
+    (max/mean over the heartbeats), retry/fault event counts.
 
 Exit 1 on a capture that fails the service schema
 (telemetry/report.validate_service_trace) — a malformed capture must
@@ -46,7 +47,9 @@ def summarize(records: list[dict]) -> dict:
         if name == "retry":
             n_retries += 1
             continue
-        if not isinstance(name, str) or not name.startswith("job_"):
+        if not isinstance(name, str) or not (
+            name.startswith("job_") or name == "lease_takeover"
+        ):
             continue
         job = rec.get("job")
         if not isinstance(job, str):
@@ -54,13 +57,24 @@ def summarize(records: list[dict]) -> dict:
         j = jobs.setdefault(
             job,
             {"state": "accepted", "slices": 0, "preemptions": 0,
-             "wall_s": 0.0, "warm": None},
+             "takeovers": 0, "fenced": 0, "wall_s": 0.0, "warm": None},
         )
         if name == "job_accepted":
             j["priority"] = rec.get("priority")
         elif name == "job_rejected":
             j["state"] = "rejected"
             j["error"] = rec.get("reason")
+        elif name == "job_shed":
+            # admission-control rejection: the job never entered the
+            # queue — its reason is the shed policy's verdict
+            j["state"] = "shed"
+            j["error"] = rec.get("reason")
+            j["priority"] = rec.get("priority", j.get("priority"))
+        elif name == "lease_takeover":
+            j["takeovers"] += 1
+            j["takeover_reason"] = rec.get("reason")
+        elif name == "job_fenced":
+            j["fenced"] += 1
         elif name == "job_started":
             j["slices"] += 1
             if j["warm"] is None:
@@ -91,6 +105,9 @@ def summarize(records: list[dict]) -> dict:
         "n_done": done,
         "n_failed": failed,
         "n_rejected": sum(1 for j in jobs.values() if j["state"] == "rejected"),
+        "n_shed": sum(1 for j in jobs.values() if j["state"] == "shed"),
+        "n_takeovers": sum(j["takeovers"] for j in jobs.values()),
+        "n_fenced": sum(j["fenced"] for j in jobs.values()),
         "n_preemptions": sum(j["preemptions"] for j in jobs.values()),
         "n_warm_starts": sum(1 for j in warm_known if j["warm"]),
         "n_cold_starts": sum(1 for j in warm_known if not j["warm"]),
@@ -135,13 +152,19 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     print(
         f"service: {s['n_jobs']} jobs ({s['n_done']} done, "
-        f"{s['n_failed']} failed, {s['n_rejected']} rejected), "
+        f"{s['n_failed']} failed, {s['n_rejected']} rejected, "
+        f"{s['n_shed']} shed), "
         f"{s['n_preemptions']} preemptions, "
         f"{s['n_warm_starts']}/{s['n_warm_starts'] + s['n_cold_starts']} "
         f"warm starts"
         + ("" if s["clean_shutdown"] else
            "  [no summary record: daemon did not shut down cleanly]")
     )
+    if s["n_takeovers"] or s["n_fenced"]:
+        print(
+            f"fleet: {s['n_takeovers']} lease takeovers, "
+            f"{s['n_fenced']} fenced (zombie) slices"
+        )
     if s["queue_depth_max"]:
         print(
             f"queue depth over heartbeats: max {s['queue_depth_max']:.0f} "
