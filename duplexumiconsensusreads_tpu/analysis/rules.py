@@ -284,6 +284,11 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
         corpus.trees[trace_path], "KNOWN_STAGES"
     )
     events, _ = str_tuple_assign(corpus.trees[trace_path], "KNOWN_EVENTS")
+    # byte-ledger registry (absent in pre-ledger corpora: the xfer
+    # check simply has nothing to pin literals against there)
+    xfer_dirs, _ = str_tuple_assign(
+        corpus.trees[trace_path], "KNOWN_XFER_DIRS"
+    )
     if not stages:
         yield Finding(
             rule="phase-registry",
@@ -372,6 +377,20 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
                     line=node.lineno,
                     message=f"event recorded under unknown name {lit!r}",
                     hint="register the event in telemetry.trace.KNOWN_EVENTS",
+                )
+            if name == "xfer" and xfer_dirs and lit not in xfer_dirs:
+                # byte-ledger records: an unregistered direction fails
+                # the capture schema only at runtime (wirestat/
+                # check_trace exit 1 on a healthy run) — same drift
+                # class as a typo'd span stage, same gate
+                yield Finding(
+                    rule="phase-registry",
+                    path=path,
+                    line=node.lineno,
+                    message=f"xfer recorded under unknown dir {lit!r}",
+                    hint="register the direction in telemetry.trace."
+                    "KNOWN_XFER_DIRS (and the ledger analysis + "
+                    "ARCHITECTURE.md schema)",
                 )
 
     # the RunReport streaming-seconds golden in tests == stages + derived
@@ -687,16 +706,16 @@ def _lease_mutation_line(fn: ast.AST) -> int | None:
 
 @register(
     "hook-guard",
-    "recorder span/event hooks on hot paths must be behind a single "
-    "None check",
+    "recorder span/event/xfer hooks on hot paths must be behind a "
+    "single None check",
 )
 def check_hook_guard(corpus: Corpus) -> Iterator[Finding]:
     """The zero-cost-when-off contract (same discipline as
     ``faults.fault_point``): with tracing off, every telemetry hook in
     the per-chunk path must cost one None check — so a direct
-    ``tr.span(...)`` / ``tr.event(...)`` on a local recorder variable
-    must sit inside ``if tr is not None:`` (or the ``else`` of ``if tr
-    is None:``) — dotted receivers (``ctx.tr.span``,
+    ``tr.span(...)`` / ``tr.event(...)`` / ``tr.xfer(...)`` on a local
+    recorder variable must sit inside ``if tr is not None:`` (or the
+    ``else`` of ``if tr is None:``) — dotted receivers (``ctx.tr.span``,
     ``self._recorder.event``) included, guarded on the same dotted
     path. Module-hook helpers (``emit_event``, ``fault_point``) carry
     the check internally and are exempt; a bare ``self.span(...)`` is
@@ -712,7 +731,8 @@ def check_hook_guard(corpus: Corpus) -> Iterator[Finding]:
                 continue
             fn = node.func
             if not (
-                isinstance(fn, ast.Attribute) and fn.attr in ("span", "event")
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("span", "event", "xfer")
             ):
                 continue
             var = expr_path(fn.value)

@@ -1152,6 +1152,16 @@ def _stream_call(
         "shard_write": 0.0, "ckpt": 0.0, "finalise": 0.0,
         "main_loop_stall": 0.0,
     }
+    # byte-ledger running totals (telemetry/ledger.py), maintained only
+    # while tracing: every `led[...] +=` below pairs with a tr.xfer()
+    # record carrying the SAME increment, so the capture's per-record
+    # sums reproduce these totals exactly — the wirestat byte sum-check
+    # (integer equality, the byte analogue of the span sum-check).
+    # Guarded by phase_lock wherever workers touch it.
+    led = {
+        "h2d_logical": 0, "h2d_wire": 0, "d2h_wire": 0,
+        "shard_logical": 0, "shard_wire": 0, "output_overhead_bytes": 0,
+    }
 
     def dispatch(buckets, spec, chunk=None):
         t0 = time.monotonic()
@@ -1159,6 +1169,14 @@ def _stream_call(
         # submit future into materialize's retry/isolation ladder
         fault_point("dispatch.device_put")
         stacked = stack_buckets(buckets, multiple_of=n_data)
+        logical = 0
+        if tr is not None:
+            # byte ledger: the PRE-packing payload — against the wire
+            # bytes below it measures what packing actually bought this
+            # chunk (pure observation; nbytes is an attribute read)
+            logical = sum(
+                v.nbytes for v in stacked.values() if hasattr(v, "nbytes")
+            )
         if spec.packed_io:
             # one byte per cycle instead of two: base|qual packed on the
             # host, decoded on device — the host->device transfer is the
@@ -1179,8 +1197,14 @@ def _stream_call(
         with phase_lock:  # dict += from concurrent workers would race
             phase["dispatch"] += dt
             rep.bytes_h2d += h2d
+            if tr is not None:
+                led["h2d_logical"] += logical
+                led["h2d_wire"] += h2d
         if tr is not None:
             tr.span("dispatch", t0, dt, chunk=chunk, n_buckets=len(buckets))
+            # retried dispatches emit again on purpose: the ledger
+            # counts wire traffic, and a retry really crossed the wire
+            tr.xfer("h2d", logical, h2d, t0, dt, chunk=chunk)
         return out
 
     def materialize(out, cbuckets, cspec, k):
@@ -1288,15 +1312,22 @@ def _stream_call(
             t0 = time.monotonic()
             out = materialize(out, cbuckets, cspec, k)
             dt = time.monotonic() - t0
+            d2h = sum(
+                v.nbytes for v in out.values() if hasattr(v, "nbytes")
+            )
             with phase_lock:
                 phase["device_wait_fetch"] += dt
-                rep.bytes_d2h += sum(
-                    v.nbytes for v in out.values() if hasattr(v, "nbytes")
-                )
+                rep.bytes_d2h += d2h
                 rep.n_families += int(out["n_families"].sum())
                 rep.n_molecules += int(out["n_molecules"].sum())
+                if tr is not None:
+                    led["d2h_wire"] += d2h
             if tr is not None:
                 tr.span("device_wait_fetch", t0, dt, chunk=k)
+                # nothing packs the return path (yet): logical == wire,
+                # and the gap between this and a packed d2h is exactly
+                # the ROADMAP item the ledger quantifies
+                tr.xfer("d2h", d2h, d2h, t0, dt, chunk=k)
             t0 = time.monotonic()
             # chaos site drain.scatter rides the same bounded-retry
             # ladder as the host I/O steps (scatter is pure compute, so
@@ -1317,10 +1348,23 @@ def _stream_call(
             if tr is not None:
                 tr.span("scatter", t0, dt, chunk=k)
             pair_base += len(cbuckets)
+        on_xfer = None
+        if tr is not None:
+
+            def on_xfer(logical, wire, t0, dt):
+                # shard ledger record: raw record-stream bytes vs the
+                # deflated bytes that hit disk (and, verbatim, the
+                # finalise append) — the (t0, dt) pair is the deflate
+                # span's, so the record sits on the drain lane beside it
+                with phase_lock:
+                    led["shard_logical"] += logical
+                    led["shard_wire"] += wire
+                tr.xfer("shard", logical, wire, t0, dt, chunk=k)
+
         res = _finish_chunk(
             k, parts, duplex, shard_dir, serialize_bam, header_out, name_tag,
             paired_out=grouping.mate_aware, read_group=read_group,
-            on_stage=on_stage,
+            on_stage=on_stage, on_xfer=on_xfer,
         )
         return res + (False,)  # marked=False: commit still owes the mark
 
@@ -1370,6 +1414,13 @@ def _stream_call(
                 pass
             raise
         fin["f"] = f
+        if tr is not None:
+            # everything in the output that is NOT a ledgered shard:
+            # the compressed header shell now, the EOF block at
+            # publish — so output_bytes == overhead + shard wire is an
+            # EXACT identity, not a tolerance
+            with phase_lock:
+                led["output_overhead_bytes"] += len(shell_c)
 
     def _commit(k, payload):
         """Main-thread commit of a drained chunk: durable mark first,
@@ -1505,6 +1556,18 @@ def _stream_call(
                 e = ckpt.done[str(k)]
                 if tr is not None:
                     tr.event("resume", chunk=k, decision="reused")
+                    # reused shard: its bytes splice into the output
+                    # without any transfer this run, so the ledger
+                    # records them ONCE (wire only — the raw size was
+                    # never re-derived) and h2d/d2h stay untouched; a
+                    # resumed capture still sum-checks against the
+                    # finalised output with no double-counting
+                    tr.xfer(
+                        "shard", None, e["size"], time.monotonic(), 0.0,
+                        chunk=k, resumed=True,
+                    )
+                    with phase_lock:
+                        led["shard_wire"] += e["size"]
                 done_q[k] = (
                     e["path"], e["size"], e["crc32"],
                     e["n_records"], e["n_pairs"], e["codec"], None, True,
@@ -1561,6 +1624,15 @@ def _stream_call(
                 # either codec; record the run's flavor so resume
                 # verification accepts it
                 spath, ssize, scrc = _write_shard(shard_dir, k, b"")
+                if tr is not None:
+                    # the ledger covers EVERY chunk, empty ones
+                    # included — per-chunk coverage is what lets the
+                    # wirestat table read as a gap-free byte account
+                    tr.xfer(
+                        "shard", 0, ssize, time.monotonic(), 0.0, chunk=k
+                    )
+                    with phase_lock:
+                        led["shard_wire"] += ssize
                 done_q[k] = (
                     spath, ssize, scrc, 0, 0, bgzf.deflate_flavor(),
                     b"", False,
@@ -1629,6 +1701,8 @@ def _stream_call(
             fsync_file(f)
 
         _io_retry("finalise.write", _publish, "finalise")
+        if tr is not None:
+            led["output_overhead_bytes"] += len(bgzf.BGZF_EOF)
         f.close()
     except BaseException:
         if fin["f"] is not None:
@@ -1698,7 +1772,13 @@ def _stream_call(
             for _hb in hb_box:
                 _hb.stop()
         # clean shutdown: embed the report's busy totals so a capture
-        # is self-contained for the trace_report sum-check
+        # is self-contained for the trace_report sum-check, and the
+        # byte-ledger totals + finalised output size so it is equally
+        # self-contained for the wirestat byte sum-check
+        try:
+            out_bytes = os.path.getsize(out_path)
+        except OSError:
+            out_bytes = 0
         tr.write_summary(
             seconds=dict(rep.seconds),
             counters={
@@ -1706,6 +1786,15 @@ def _stream_call(
                 "n_chunks_skipped": rep.n_chunks_skipped,
                 "n_retries": rep.n_retries,
                 "n_drain_workers": rep.n_drain_workers,
+                # fresh reads this run parsed: the bytes-per-read
+                # denominator (resume-skipped chunks moved no bytes,
+                # so numerator and denominator agree by construction)
+                "n_records": rep.n_records,
+            },
+            bytes={
+                **led,
+                "output_bytes": int(out_bytes),
+                "output_path": os.path.abspath(out_path),
             },
         )
     if report_path:
@@ -1778,7 +1867,7 @@ def _count_records(data: bytes) -> tuple[int, int]:
 
 def _finish_chunk(
     k, parts, duplex, shard_dir, serialize_bam, header, name_tag="",
-    paired_out=False, read_group="A", on_stage=None,
+    paired_out=False, read_group="A", on_stage=None, on_xfer=None,
 ) -> tuple[str, int, int, int, int, bytes]:
     """Merge one chunk's per-class scattered outputs and write its
     shard. parts rows are 8-tuples — (..., cons_mate, cons_pair,
@@ -1798,7 +1887,9 @@ def _finish_chunk(
     ``on_stage(stage, t0, dt)`` is the caller's accounting hook: the
     serialize+write segments report as "shard_write" and the BGZF
     compression as "deflate" — per-stage busy phases AND trace spans
-    both flow through it, so they can never disagree."""
+    both flow through it, so they can never disagree. ``on_xfer(
+    logical, wire, t0, dt)`` is the byte-ledger hook, fired once per
+    shard with the raw vs deflated byte counts (None = ledger off)."""
     t0 = time.monotonic()
     cols = sort_consensus_outputs(*(np.concatenate(x) for x in zip(*parts)))
     cb, cq, cd, fp, fu, mate, pair, end = cols[:8]
@@ -1831,8 +1922,11 @@ def _finish_chunk(
         on_stage("shard_write", t0, time.monotonic() - t0)
     t0 = time.monotonic()
     comp, codec = bgzf.compress_fast_tagged(raw, eof=False)
+    dt = time.monotonic() - t0
     if on_stage:
-        on_stage("deflate", t0, time.monotonic() - t0)
+        on_stage("deflate", t0, dt)
+    if on_xfer:
+        on_xfer(len(raw), len(comp), t0, dt)
     t0 = time.monotonic()
     path, size, crc = _write_shard(shard_dir, k, comp)
     if on_stage:

@@ -130,6 +130,14 @@ class ConsensusService:
         self._n_running = 0
         self._t0 = time.monotonic()
         self._job_seconds: dict[str, dict] = {}
+        # per-job wire-byte totals accumulated across slices (the
+        # serving-side byte ledger: h2d/d2h/reads per job, snapshotted
+        # into metrics.json as job_bytes with bytes_per_read derived).
+        # TRAFFIC-attributed: chunks in flight at a preemption are
+        # re-transferred and re-counted by the resuming slice (see
+        # WarmWorker.run_slice) — these measure bytes moved, not
+        # bytes committed
+        self._job_bytes: dict[str, dict] = {}
         # per-priority-class latency samples: queue-wait (admission ->
         # first claim) and time-to-first-chunk (admission -> first
         # fresh chunk durable), bounded FIFO
@@ -138,6 +146,10 @@ class ConsensusService:
             "jobs_accepted": 0, "jobs_rejected": 0, "jobs_shed": 0,
             "jobs_done": 0, "jobs_failed": 0, "jobs_fenced": 0,
             "preemptions": 0, "jobs_recovered": 0,
+            # cumulative wire bytes across every slice this daemon
+            # committed — rides the heartbeat line and metrics.json, so
+            # a long-lived daemon's transfer pressure is live-readable
+            "h2d_bytes": 0, "d2h_bytes": 0,
         }
         self._tr: TraceRecorder | None = None
 
@@ -170,6 +182,31 @@ class ConsensusService:
         samples.append(round(value_s, 4))
         del samples[:-_LAT_SAMPLES_KEPT]
 
+    def _note_bytes_locked(self, job_id: str, sb: dict) -> None:
+        """Fold one slice's byte snapshot into the per-job and daemon
+        cumulative totals (caller holds the lock)."""
+        jb = self._job_bytes.setdefault(
+            job_id, {"h2d_bytes": 0, "d2h_bytes": 0, "reads": 0}
+        )
+        for key in ("h2d_bytes", "d2h_bytes", "reads"):
+            jb[key] += int(sb.get(key, 0) or 0)
+        self.counters["h2d_bytes"] += int(sb.get("h2d_bytes", 0) or 0)
+        self.counters["d2h_bytes"] += int(sb.get("d2h_bytes", 0) or 0)
+
+    def _job_bytes_snapshot_locked(self) -> dict:
+        """metrics.json's job_bytes: per-job totals plus the derived
+        bytes_per_read (total wire traffic over fresh reads)."""
+        out = {}
+        for job_id, jb in self._job_bytes.items():
+            wire = jb["h2d_bytes"] + jb["d2h_bytes"]
+            out[job_id] = {
+                **jb,
+                "bytes_per_read": (
+                    round(wire / jb["reads"], 1) if jb["reads"] else 0.0
+                ),
+            }
+        return out
+
     def _class_latency_locked(self) -> dict:
         """Per-priority-class p50/p95 of queue-wait and time-to-first-
         chunk — the service's SLO surface, snapshotted into
@@ -201,6 +238,7 @@ class ConsensusService:
                     "daemon_id": self.daemon_id,
                     "lease_s": self.lease_s,
                     "job_seconds": self._job_seconds,
+                    "job_bytes": self._job_bytes_snapshot_locked(),
                     "class_latency": self._class_latency_locked(),
                 },
                 sort_keys=True,
@@ -549,18 +587,36 @@ class ConsensusService:
                     )
                     self.counters["jobs_done"] += 1
                     self._job_seconds[job_id] = result.get("seconds", {})
+                    self._note_bytes_locked(job_id, {
+                        "h2d_bytes": result.get("bytes_h2d", 0),
+                        "d2h_bytes": result.get("bytes_d2h", 0),
+                        "reads": result.get("n_records", 0),
+                    })
+                    jb = dict(self._job_bytes.get(job_id, {}))
             except JobFenced as f:
                 self._fenced(job_id, lane, str(f))
                 return
             if tr is not None:
+                wire = jb.get("h2d_bytes", 0) + jb.get("d2h_bytes", 0)
                 tr.event(
                     "job_completed", job=job_id, lane=lane, wall_s=wall,
                     n_chunks=result.get("n_chunks", 0),
                     n_consensus=result.get("n_consensus", 0),
                     warm=warm, seconds=result.get("seconds", {}),
+                    # the job's whole-life byte totals (every slice,
+                    # preempted ones included) — serve_report's per-job
+                    # byte column reads straight off this event
+                    h2d_bytes=jb.get("h2d_bytes", 0),
+                    d2h_bytes=jb.get("d2h_bytes", 0),
+                    bytes_per_read=(
+                        round(wire / jb["reads"], 1)
+                        if jb.get("reads") else 0.0
+                    ),
                 )
         else:
-            _, chunks_done, reason = out
+            _, chunks_done, reason, slice_bytes = out
+            with self._lock:
+                self._note_bytes_locked(job_id, slice_bytes)
 
             def _requeue():
                 with self._lock:

@@ -131,9 +131,17 @@ class WarmWorker:
         lease: LeaseContext | None = None,
     ):
         """One slice of ``spec``. Returns ("done", report_dict) or
-        ("preempted", chunks_done, reason); job errors propagate, and a
-        lost lease surfaces as :class:`~..serve.queue.JobFenced` (a
-        BaseException — nothing here may absorb it).
+        ("preempted", chunks_done, reason, slice_bytes) where
+        ``slice_bytes`` is {"h2d_bytes", "d2h_bytes", "reads"} as of
+        the slice's last committed chunk — the byte ledger's
+        serving-side view, TRAFFIC-attributed (chunks in flight at a
+        preemption are re-transferred and re-counted by the resuming
+        slice; see the comment at the snapshot below). The service
+        accumulates it per job so metrics.json can answer
+        bytes-per-read per job even across preemptions. Job errors
+        propagate, and a lost lease surfaces as
+        :class:`~..serve.queue.JobFenced` (a BaseException — nothing
+        here may absorb it).
 
         ``budget`` bounds FRESH chunks this slice commits (0 = no
         bound); ``should_yield()`` is consulted before yielding so the
@@ -148,6 +156,18 @@ class WarmWorker:
         gp, cp, kwargs = job_params(spec)
         n_resumed = _ckpt_done_count(spec.output)
         commits = [0]
+        # wire bytes this slice moved, as of its last committed chunk:
+        # a preempted slice raises out of the executor, so the report
+        # object is unreachable afterwards — the progress callback
+        # snapshots its live counters instead. TRAFFIC-attributed, not
+        # commit-attributed: at the snapshot the pipeline already
+        # dispatched/fetched up to max-inflight later chunks whose
+        # commits the preemption abandons, and the resuming slice
+        # recomputes (re-transfers, re-counts) them — so job totals
+        # measure bytes daemons actually moved for the job, counting a
+        # re-transfer each time it crosses the wire, exactly like
+        # retried dispatches in the run capture's byte ledger.
+        slice_bytes = {"h2d_bytes": 0, "d2h_bytes": 0, "reads": 0}
 
         commit_guard = None
         if lease is not None:
@@ -181,6 +201,9 @@ class WarmWorker:
             # chunk _k's checkpoint mark is durable — the one point where
             # yielding is free by the resume contract
             commits[0] += 1
+            slice_bytes["h2d_bytes"] = _rep.bytes_h2d
+            slice_bytes["d2h_bytes"] = _rep.bytes_d2h
+            slice_bytes["reads"] = _rep.n_records
             fresh = commits[0] - n_resumed
             if (
                 fresh == 1
@@ -223,7 +246,7 @@ class WarmWorker:
             # compiled, so later jobs of this signature start warm
             with self._lock:
                 self._warm_specs.add(spec_signature(spec))
-            return ("preempted", p.chunks_done, p.reason)
+            return ("preempted", p.chunks_done, p.reason, dict(slice_bytes))
         finally:
             if plan is not None:
                 faults.install(prev_plan)

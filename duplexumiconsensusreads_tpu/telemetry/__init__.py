@@ -1,18 +1,21 @@
 """Per-chunk tracing + live telemetry for the streaming executor.
 
 `trace` is the recording side (span recorder, structured events, the
-heartbeat thread); `chrome` exports a capture as Chrome trace events
-(opens in Perfetto / chrome://tracing); `report` is the offline
-analysis side (schema validation, per-lane utilization, per-stage
-percentiles, per-chunk critical path, the sum-check against
-`RunReport.seconds`). The recording side imports only the stdlib so
-`runtime/faults.py` and `io/durable.py` can hook into it without an
-import cycle.
+byte-ledger `xfer` records, the heartbeat thread); `chrome` exports a
+capture as Chrome trace events (opens in Perfetto / chrome://tracing);
+`report` is the offline analysis side (schema validation, per-lane
+utilization, per-stage percentiles, per-chunk critical path, the
+sum-check against `RunReport.seconds`); `ledger` is the byte twin
+(per-chunk byte totals, measured bandwidth, the wire-floor model, the
+byte sum-checks `tools/wirestat.py` enforces). The recording side
+imports only the stdlib so `runtime/faults.py` and `io/durable.py`
+can hook into it without an import cycle.
 """
 
 from duplexumiconsensusreads_tpu.telemetry.trace import (
     KNOWN_EVENTS,
     KNOWN_STAGES,
+    KNOWN_XFER_DIRS,
     Heartbeat,
     TraceRecorder,
     emit_event,
@@ -24,6 +27,7 @@ from duplexumiconsensusreads_tpu.telemetry.trace import (
 __all__ = [
     "KNOWN_EVENTS",
     "KNOWN_STAGES",
+    "KNOWN_XFER_DIRS",
     "Heartbeat",
     "TraceRecorder",
     "emit_event",

@@ -2,9 +2,10 @@
 
 Maps a JSONL capture (telemetry/trace.py) onto the Trace Event Format
 consumed by https://ui.perfetto.dev and chrome://tracing — spans become
-complete ('X') slices, point events become instants ('i'), and each
-LANE becomes one named pseudo-thread so the main loop, transfer
-workers, and every drain worker render as parallel tracks. That
+complete ('X') slices, point events become instants ('i'), byte-ledger
+xfer records become counter ('C') tracks of bytes-in-flight per lane,
+and each LANE becomes one named pseudo-thread so the main loop,
+transfer workers, and every drain worker render as parallel tracks. That
 side-by-side rendering is the whole point: overlap that hides the
 critical path in aggregate numbers is visible at a glance.
 
@@ -37,10 +38,10 @@ def to_chrome(records) -> dict:
     (``telemetry.report.load_trace`` output). Returns the JSON-object
     form ({"traceEvents": [...]}), which Perfetto accepts directly.
     """
-    spans, instants, lanes = [], [], set()
+    spans, instants, xfers, lanes = [], [], [], set()
     for rec in records:
         kind = rec.get("type")
-        if kind not in ("span", "event"):
+        if kind not in ("span", "event", "xfer"):
             continue
         lane = rec.get("lane", "?")
         lanes.add(lane)
@@ -52,6 +53,8 @@ def to_chrome(records) -> dict:
         args = {k: v for k, v in rec.items() if k not in drop}
         if kind == "span":
             spans.append((rec, lane, args))
+        elif kind == "xfer":
+            xfers.append((rec, lane))
         else:
             instants.append((rec, lane, args))
 
@@ -85,6 +88,27 @@ def to_chrome(records) -> dict:
             "name": rec.get("name", "?"), "cat": "event", "ph": "i",
             "ts": round(float(rec.get("t", 0.0)) * 1e6, 3),
             "pid": _PID, "tid": tid[lane], "s": "t", "args": args,
+        })
+    # byte-ledger records render as COUNTER tracks ("C"): each transfer
+    # raises "<dir>_bytes (<lane>)" to its wire size for its span and
+    # drops it back to zero at the end, so Perfetto shows H2D/D2H
+    # bytes-in-flight per lane right under the span timeline — transfer
+    # pressure next to the time it cost. Counter identity is (pid,
+    # name); the lane rides in the name because tids don't key counters.
+    for rec, lane in xfers:
+        name = f"{rec.get('dir', '?')}_bytes ({lane})"
+        t0 = round(float(rec.get("t", 0.0)) * 1e6, 3)
+        t1 = round(
+            (float(rec.get("t", 0.0)) + float(rec.get("dur", 0.0))) * 1e6, 3
+        )
+        wire = int(rec.get("wire", 0))
+        events.append({
+            "name": name, "cat": "xfer", "ph": "C", "ts": t0,
+            "pid": _PID, "tid": tid[lane], "args": {"bytes": wire},
+        })
+        events.append({
+            "name": name, "cat": "xfer", "ph": "C", "ts": t1,
+            "pid": _PID, "tid": tid[lane], "args": {"bytes": 0},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

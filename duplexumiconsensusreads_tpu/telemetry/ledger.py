@@ -1,0 +1,348 @@
+"""Byte ledger: per-chunk transfer accounting and the wire-floor model.
+
+The r5 captures proved the system transfer-bound — the PCIe wire floor
+owned 63-72% of the e2e wall while device compute idled at ~5% MFU —
+but the trace capture only recorded *time* per stage: nobody could say
+how many bytes crossed the wire per chunk, what the packing bought, or
+whether a "faster" run actually moved fewer bytes. This module is the
+byte twin of the span model: the streaming executor records one typed
+``xfer`` ledger record per transfer (same capture, same lane/chunk
+ids), and the analysis here turns a capture into a *measured* wire
+model — effective bandwidth from (bytes, span dt), a wire-floor
+fraction computed from the capture itself rather than hand-waved, and
+packing-ratio / bytes-per-read stats.
+
+Ledger record (one JSONL line in the capture, ``type == "xfer"``)::
+
+  {"type": "xfer", "dir": "h2d" | "d2h" | "shard",
+   "t": <epoch-relative start s>, "dur": <transfer span s>,
+   "logical": <bytes before packing/deflate>, "wire": <bytes moved>,
+   "chunk": k, "lane": "...", ...}
+
+Directions (``KNOWN_XFER_DIRS`` — the registry dutlint pins, the byte
+analogue of ``trace.KNOWN_STAGES``):
+
+  h2d    device dispatch: logical = stacked input tensors before wire
+         packing, wire = bytes actually device_put (after packing).
+         Retried dispatches emit again — the ledger counts wire
+         traffic, not input size.
+  d2h    device fetch: consensus outputs materialised to host
+         (logical == wire — nothing packs the return path yet, which
+         is itself a ROADMAP item the ledger now quantifies).
+  shard  the chunk's durable shard: logical = raw record-stream
+         bytes, wire = BGZF-deflated bytes on disk. Resume-reused
+         chunks emit ``resumed: true`` with wire only (their raw size
+         was never re-derived) — each chunk lands in the ledger
+         exactly once per run, so shard totals always sum-check
+         against the finalised output.
+
+The terminal summary embeds the executor's running totals under
+``bytes`` (plus the finalised output size and the header/EOF overhead
+it wrote around the shards), so a capture is self-contained for the
+two byte sum-checks ``tools/wirestat.py`` enforces: record totals must
+reproduce the summary totals exactly (integer equality — bytes don't
+round), and ``output_overhead_bytes + shard wire == output_bytes`` on
+disk. Drift in either is instrumentation rot or file corruption,
+exit 1 — the byte analogue of ``trace_report.py``'s time sum-check.
+"""
+
+from __future__ import annotations
+
+from duplexumiconsensusreads_tpu.telemetry.report import (
+    _is_num,
+    _pctl,
+    summary_record,
+    wall_seconds,
+)
+from duplexumiconsensusreads_tpu.telemetry.trace import KNOWN_XFER_DIRS
+
+__all__ = [
+    "KNOWN_XFER_DIRS", "SUMMARY_BYTE_KEYS", "xfer_records", "byte_totals",
+    "bandwidth_stats", "wire_floor", "packing_stats", "per_chunk_bytes",
+    "summary_bytes", "sum_check_bytes", "output_check",
+]
+
+# summary["bytes"] keys the executor embeds (all integers; *_logical
+# and *_wire are running totals of the matching xfer records)
+SUMMARY_BYTE_KEYS = (
+    "h2d_logical", "h2d_wire", "d2h_wire", "shard_logical", "shard_wire",
+    "output_bytes", "output_overhead_bytes",
+)
+
+
+def xfer_records(records: list[dict]) -> list[dict]:
+    return [r for r in records if isinstance(r, dict) and r.get("type") == "xfer"]
+
+
+def _union_seconds(intervals: list[tuple[float, float]]) -> float:
+    """Total length of the union of (start, end) intervals — transfer
+    WALL occupancy. Summing durations instead would double-count spans
+    that overlap across the transfer workers (the pools exist precisely
+    to overlap the tunnel's per-call latency), and a "floor" bigger
+    than the wall is not a floor."""
+    total = 0.0
+    cur_a = cur_b = None
+    for a, b in sorted(intervals):
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                total += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    if cur_b is not None:
+        total += cur_b - cur_a
+    return total
+
+
+def byte_totals(records: list[dict]) -> dict[str, dict]:
+    """Per direction: record count, logical/wire byte sums, summed
+    transfer-span seconds (``dur_s``), wall occupancy of the spans'
+    union (``busy_s`` — overlap collapsed), and how many records were
+    resume-reused (``shard`` only; reused records carry no
+    ``logical``)."""
+    out: dict[str, dict] = {}
+    spans: dict[str, list[tuple[float, float]]] = {}
+    for rec in xfer_records(records):
+        direction = rec.get("dir", "?")
+        d = out.setdefault(
+            direction,
+            {"n": 0, "logical": 0, "wire": 0, "dur_s": 0.0, "busy_s": 0.0,
+             "n_resumed": 0},
+        )
+        d["n"] += 1
+        d["wire"] += int(rec.get("wire", 0))
+        if _is_num(rec.get("logical")):
+            d["logical"] += int(rec["logical"])
+        t = float(rec.get("t", 0.0))
+        dur = float(rec.get("dur", 0.0))
+        d["dur_s"] += dur
+        spans.setdefault(direction, []).append((t, t + dur))
+        if rec.get("resumed"):
+            d["n_resumed"] += 1
+    for direction, d in out.items():
+        d["dur_s"] = round(d["dur_s"], 6)
+        d["busy_s"] = round(_union_seconds(spans.get(direction, [])), 6)
+    return out
+
+
+def bandwidth_stats(
+    records: list[dict], totals: dict | None = None
+) -> dict[str, dict]:
+    """Measured bandwidth per wire direction (h2d/d2h), decimal MB/s.
+
+    ``effective`` is total wire bytes over the WALL occupancy of the
+    direction's transfer spans (their interval union — concurrent
+    transfer workers overlap the tunnel's per-call latency, and summed
+    durations would under-state the wire); p50/p95 are per-record
+    bandwidths, so tunnel weather *within* a run is visible (the r4/r5
+    probes showed ~3x intra-day swings between runs; this shows them
+    inside one capture). ``totals`` short-circuits the
+    :func:`byte_totals` re-scan for callers (wirestat) that already
+    computed it."""
+    if totals is None:
+        totals = byte_totals(records)
+    per: dict[str, list[float]] = {}
+    for rec in xfer_records(records):
+        direction = rec.get("dir")
+        if direction not in ("h2d", "d2h"):
+            continue
+        wire = float(rec.get("wire", 0))
+        dur = float(rec.get("dur", 0.0))
+        if dur > 0 and wire > 0:
+            per.setdefault(direction, []).append(wire / dur / 1e6)
+    out = {}
+    for direction in ("h2d", "d2h"):
+        if direction not in totals:
+            continue
+        busy = totals[direction]["busy_s"]
+        wire = totals[direction]["wire"]
+        vals = sorted(per.get(direction, []))
+        out[direction] = {
+            "n": totals[direction]["n"],
+            "effective_mb_s": (
+                round(wire / busy / 1e6, 2) if busy > 0 else 0.0
+            ),
+            "p50_mb_s": round(_pctl(vals, 0.50), 2),
+            "p95_mb_s": round(_pctl(vals, 0.95), 2),
+        }
+    return out
+
+
+def wire_floor(records: list[dict], totals: dict | None = None) -> dict:
+    """The measured wire-floor decomposition of this capture.
+
+    Floor seconds per direction = wall occupancy of that direction's
+    transfer spans (interval union); the combined floor is the union
+    over BOTH directions, so time when h2d and d2h genuinely overlap
+    counts once and ``frac <= 1`` by construction. Both operands are
+    MEASURED from the same capture — equivalently wire bytes over the
+    effective bandwidth ``bandwidth_stats`` reports — so
+    ``e2e_wire_floor_frac`` stops depending on a separate probe whose
+    weather may not match the run's (the r5 probes bracketed the wall
+    between 0.39 and 0.94 across runs; this number has no bracket)."""
+    if totals is None:
+        totals = byte_totals(records)
+    h2d_s = float(totals.get("h2d", {}).get("busy_s", 0.0))
+    d2h_s = float(totals.get("d2h", {}).get("busy_s", 0.0))
+    both: list[tuple[float, float]] = []
+    for rec in xfer_records(records):
+        if rec.get("dir") in ("h2d", "d2h"):
+            t = float(rec.get("t", 0.0))
+            both.append((t, t + float(rec.get("dur", 0.0))))
+    floor_s = _union_seconds(both)
+    wall = wall_seconds(records)
+    return {
+        "h2d_s": round(h2d_s, 3),
+        "d2h_s": round(d2h_s, 3),
+        "floor_s": round(floor_s, 3),
+        "wall_s": round(wall, 3),
+        "frac": round(min(floor_s / wall, 1.0), 4) if wall else 0.0,
+    }
+
+
+def packing_stats(records: list[dict], totals: dict | None = None) -> dict:
+    """Packing / compression ratios and bytes-per-read.
+
+    Ratios are logical/wire (>1 means the wire moved fewer bytes than
+    the logical payload); ``bytes_per_read`` divides the run's total
+    wire traffic (both directions) by the fresh reads the summary
+    counted — resume-skipped chunks transferred nothing, so the
+    denominator matches the numerator by construction."""
+    if totals is None:
+        totals = byte_totals(records)
+    out: dict = {}
+    h2d = totals.get("h2d", {})
+    if h2d.get("wire"):
+        out["h2d_packing_ratio"] = round(h2d["logical"] / h2d["wire"], 3)
+    shard = totals.get("shard", {})
+    if shard.get("logical") and shard.get("wire"):
+        # reused shards carry no logical: ratio over fresh records only
+        fresh_wire = shard["wire"] - _resumed_wire(records)
+        if fresh_wire > 0:
+            out["shard_deflate_ratio"] = round(
+                shard["logical"] / fresh_wire, 3
+            )
+    s = summary_record(records)
+    counters = (s or {}).get("counters") or {}
+    n_reads = counters.get("n_records")
+    if _is_num(n_reads) and n_reads > 0:
+        wire = h2d.get("wire", 0) + totals.get("d2h", {}).get("wire", 0)
+        out["bytes_per_read"] = round(wire / n_reads, 1)
+    return out
+
+
+def _resumed_wire(records: list[dict]) -> int:
+    return sum(
+        int(r.get("wire", 0))
+        for r in xfer_records(records)
+        if r.get("dir") == "shard" and r.get("resumed")
+    )
+
+
+def per_chunk_bytes(records: list[dict]) -> dict[int, dict]:
+    """Per chunk: logical/wire byte sums per direction (the byte table
+    ``wirestat.py`` prints beside ``trace_report.py``'s time table)."""
+    out: dict[int, dict] = {}
+    for rec in xfer_records(records):
+        if "chunk" not in rec:
+            continue
+        row = out.setdefault(int(rec["chunk"]), {})
+        d = row.setdefault(
+            rec.get("dir", "?"), {"logical": 0, "wire": 0, "resumed": False}
+        )
+        if _is_num(rec.get("logical")):
+            d["logical"] += int(rec["logical"])
+        d["wire"] += int(rec.get("wire", 0))
+        d["resumed"] = bool(d["resumed"] or rec.get("resumed"))
+    return dict(sorted(out.items()))
+
+
+def summary_bytes(records: list[dict]) -> dict | None:
+    """The executor's ``bytes`` totals from the terminal summary, or
+    None (crashed run, or a pre-ledger capture)."""
+    s = summary_record(records)
+    b = (s or {}).get("bytes")
+    return b if isinstance(b, dict) else None
+
+
+def sum_check_bytes(
+    records: list[dict], totals: dict | None = None
+) -> tuple[list[dict], bool]:
+    """Ledger record totals vs the summary's running totals.
+
+    Bytes are integers and both sides count the same increments, so the
+    check is EXACT equality — any drift means records were dropped,
+    double-emitted, or the capture was edited. A capture truncated by
+    the bounded recorder (summary n_dropped > 0) can only under-count:
+    the check degrades to one-sided (records <= summary), mirroring the
+    time sum-check's truncation contract. Returns (rows, ok); no
+    summary bytes -> ([], True) (nothing to check against)."""
+    want = summary_bytes(records)
+    if want is None:
+        return [], True
+    dropped = int((summary_record(records) or {}).get("n_dropped") or 0)
+    if totals is None:
+        totals = byte_totals(records)
+    got = {
+        "h2d_logical": totals.get("h2d", {}).get("logical", 0),
+        "h2d_wire": totals.get("h2d", {}).get("wire", 0),
+        "d2h_wire": totals.get("d2h", {}).get("wire", 0),
+        "shard_logical": totals.get("shard", {}).get("logical", 0),
+        "shard_wire": totals.get("shard", {}).get("wire", 0),
+    }
+    rows = []
+    ok_all = True
+    for key, rec_total in got.items():
+        sv = want.get(key)
+        expect = int(sv) if _is_num(sv) else 0
+        ok = rec_total <= expect if dropped else rec_total == expect
+        ok_all &= ok
+        rows.append({
+            "key": key, "records": rec_total, "summary": expect, "ok": ok,
+        })
+    return rows, ok_all
+
+
+def output_check(
+    records: list[dict],
+    out_path: str | None = None,
+    totals: dict | None = None,
+) -> tuple[list[str], bool]:
+    """The on-disk drift check: the finalised BAM must be EXACTLY the
+    header/EOF overhead plus every ledgered shard's wire bytes, and its
+    current on-disk size must still match what the executor measured
+    after the atomic rename. Returns (problem strings, ok); a capture
+    without summary bytes (crashed run) has nothing to check."""
+    import os
+
+    b = summary_bytes(records)
+    if b is None:
+        return [], True
+    problems: list[str] = []
+    if totals is None:
+        totals = byte_totals(records)
+    shard_wire = totals.get("shard", {}).get("wire", 0)
+    overhead = b.get("output_overhead_bytes")
+    out_bytes = b.get("output_bytes")
+    if _is_num(overhead) and _is_num(out_bytes):
+        want = int(overhead) + shard_wire
+        if want != int(out_bytes):
+            problems.append(
+                f"ledger shard bytes + overhead = {want} but the summary "
+                f"recorded output_bytes = {int(out_bytes)} "
+                f"({want - int(out_bytes):+d} drift)"
+            )
+    path = out_path or b.get("output_path")
+    if path and _is_num(out_bytes):
+        try:
+            disk = os.path.getsize(path)
+        except OSError:
+            # the output may legitimately have been moved/deleted since
+            # the run; only an EXISTING file can disagree
+            disk = None
+        if disk is not None and disk != int(out_bytes):
+            problems.append(
+                f"output file {path} is {disk} bytes on disk but the "
+                f"ledger accounts for {int(out_bytes)}"
+            )
+    return problems, not problems

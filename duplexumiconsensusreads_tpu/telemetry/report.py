@@ -27,6 +27,7 @@ import json
 from duplexumiconsensusreads_tpu.telemetry.trace import (
     KNOWN_EVENTS,
     KNOWN_STAGES,
+    KNOWN_XFER_DIRS,
     TRACE_VERSION,
 )
 
@@ -120,6 +121,37 @@ def validate_trace(records: list[dict]) -> list[str]:
                 problems.append(f"record {i}: event needs a non-empty lane")
             if name != "truncated":
                 n_counted += 1
+        elif kind == "xfer":
+            # byte-ledger record (telemetry/ledger.py): registered
+            # direction, non-negative integer byte counts, the span
+            # envelope. `logical` is optional (resume-reused shards
+            # never re-derive their raw size) but must be integral
+            # when present.
+            if rec.get("dir") not in KNOWN_XFER_DIRS:
+                problems.append(
+                    f"record {i}: unknown xfer dir {rec.get('dir')!r}"
+                )
+            if not _is_num(rec.get("t")) or rec["t"] < 0:
+                problems.append(f"record {i}: xfer needs numeric t >= 0")
+            if not _is_num(rec.get("dur")) or rec["dur"] < 0:
+                problems.append(f"record {i}: xfer needs numeric dur >= 0")
+            if not isinstance(rec.get("wire"), int) or rec["wire"] < 0:
+                problems.append(
+                    f"record {i}: xfer needs integer wire bytes >= 0"
+                )
+            if "logical" in rec and (
+                not isinstance(rec["logical"], int) or rec["logical"] < 0
+            ):
+                problems.append(
+                    f"record {i}: xfer logical bytes must be an int >= 0"
+                )
+            if not isinstance(rec.get("lane"), str) or not rec.get("lane"):
+                problems.append(f"record {i}: xfer needs a non-empty lane")
+            if "chunk" in rec and (
+                not isinstance(rec["chunk"], int) or rec["chunk"] < 0
+            ):
+                problems.append(f"record {i}: xfer chunk must be an int >= 0")
+            n_counted += 1
         elif kind == "summary":
             n_summary += 1
             if i != len(records):
@@ -134,6 +166,23 @@ def validate_trace(records: list[dict]) -> list[str]:
                             f"record {i}: summary seconds[{sk!r}] is "
                             f"non-numeric"
                         )
+            byt = rec.get("bytes")
+            if byt is not None:
+                if not isinstance(byt, dict):
+                    problems.append(f"record {i}: summary bytes must be a dict")
+                else:
+                    for bk, bv in byt.items():
+                        # byte totals are exact integers (the wirestat
+                        # sum-check is phrased as equality, and floats
+                        # would smuggle rounding slack into it); the
+                        # output path tag is the one legal string
+                        if bk == "output_path":
+                            continue
+                        if not isinstance(bv, int) or isinstance(bv, bool):
+                            problems.append(
+                                f"record {i}: summary bytes[{bk!r}] must "
+                                f"be an integer"
+                            )
             if isinstance(rec.get("n_events"), int) and rec["n_events"] != n_counted:
                 problems.append(
                     f"record {i}: summary n_events={rec['n_events']} but the "
@@ -217,7 +266,7 @@ def wall_seconds(records: list[dict]) -> float:
             return float(total)
     end = 0.0
     for rec in records:
-        if rec.get("type") == "span":
+        if rec.get("type") in ("span", "xfer"):
             end = max(end, float(rec.get("t", 0)) + float(rec.get("dur", 0)))
         elif rec.get("type") in ("event", "summary"):
             end = max(end, float(rec.get("t", 0)))

@@ -17,6 +17,11 @@ per-chunk pipeline:
   event  one structured point occurrence: a fault-injection trigger,
          a retry attempt (site + attempt + backoff), a resume decision
          (shard reused vs recomputed), a durable write, a heartbeat.
+  xfer   one byte-ledger transfer (telemetry/ledger.py): logical vs
+         wire bytes per chunk per direction (h2d/d2h/shard), with the
+         same (t, dur) pair as the stage span that moved them — the
+         capture's byte accounting, sum-checked by tools/wirestat.py
+         the way spans are sum-checked by tools/trace_report.py.
 
 Capture format: JSONL, one record per line, strictly in write order —
 a `meta` line first, then spans/events as they complete (NOT in start
@@ -90,6 +95,17 @@ KNOWN_EVENTS = (
     # lease, and a zombie slice aborted by its stale fencing token
     "lease_takeover",  # running job reclaimed (attrs: reason, prev_owner)
     "job_fenced",  # slice lost its lease; committed nothing, not a failure
+)
+
+# Byte-ledger directions (the third record kind, ``xfer`` — see
+# telemetry/ledger.py for the record schema and the analysis). One
+# registry like KNOWN_STAGES/KNOWN_EVENTS: the capture validator and
+# dutlint's phase-registry rule both pin literal ``xfer("...")`` call
+# sites to this tuple.
+KNOWN_XFER_DIRS = (
+    "h2d",  # dispatch: stacked/packed input tensors -> device
+    "d2h",  # fetch: consensus output tensors -> host
+    "shard",  # drain: raw record stream -> deflated durable shard
 )
 
 
@@ -220,6 +236,40 @@ class TraceRecorder:
             "t": round(self.rel(time.monotonic()), 6),
             "lane": lane or current_lane(),
         }
+        if chunk is not None:
+            rec["chunk"] = int(chunk)
+        if attrs:
+            rec.update(attrs)
+        self._emit(rec)
+
+    def xfer(
+        self,
+        direction: str,
+        logical: int | None,
+        wire: int,
+        t_start: float,
+        dur: float,
+        chunk: int | None = None,
+        lane: str | None = None,
+        **attrs,
+    ) -> None:
+        """Record one byte-ledger transfer (``type == "xfer"``).
+
+        ``logical`` is the payload before packing/deflate and ``wire``
+        the bytes actually moved/stored; pass ``logical=None`` when the
+        pre-wire size is unknowable (resume-reused shards). ``t_start``
+        / ``dur`` are the raw monotonic reading and measured span of
+        the transfer — the SAME pair the matching stage span records,
+        so (bytes, dt) yields a bandwidth the time sum-check already
+        vouches for."""
+        rec = {
+            "type": "xfer", "dir": direction,
+            "t": round(self.rel(t_start), 6), "dur": round(dur, 6),
+            "wire": int(wire),
+            "lane": lane or current_lane(),
+        }
+        if logical is not None:
+            rec["logical"] = int(logical)
         if chunk is not None:
             rec["chunk"] = int(chunk)
         if attrs:

@@ -348,6 +348,11 @@ GOLDEN_OK = """
         assert set(rep) == {"ingest", "finalise", "drain_utilization",
                             "total"}
     """
+TRACE_XFER_OK = """
+    KNOWN_STAGES = ("ingest", "finalise")
+    KNOWN_EVENTS = ("retry",)
+    KNOWN_XFER_DIRS = ("h2d", "d2h", "shard")
+    """
 
 
 class TestPhaseRegistry:
@@ -407,6 +412,44 @@ class TestPhaseRegistry:
         msgs = " | ".join(f.message for f in res.findings)
         assert "bonus" in msgs  # extra key
         assert "finalise" in msgs  # missing stage
+
+    def test_fires_on_unknown_xfer_dir(self):
+        res = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_XFER_OK,
+            "pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.xfer("warp", 0, 0, 0.0, 0.0)
+            """,
+        })
+        assert any(
+            "xfer" in f.message and "warp" in f.message
+            for f in res.findings
+        )
+
+    def test_passes_on_registered_xfer_dir(self):
+        res = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_XFER_OK,
+            "pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.xfer("h2d", 0, 0, 0.0, 0.0)
+            """,
+        })
+        assert res.ok
+
+    def test_pre_ledger_corpus_skips_the_xfer_check(self):
+        # a trace.py without KNOWN_XFER_DIRS (the fixture corpora, old
+        # trees) must not fail on xfer literals it cannot pin
+        res = self.base(**{"pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.xfer("anything", 0, 0, 0.0, 0.0)
+            """})
+        assert res.ok
 
 
 class TestLockDiscipline:
@@ -647,6 +690,30 @@ class TestHookGuard:
         )
         assert res.ok
 
+    def test_fires_on_unguarded_xfer_hook(self):
+        # the byte-ledger hook carries the same zero-cost-when-off
+        # obligation as span/event
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def dispatch(tr):
+                    tr.xfer("h2d", 10, 5, 0.0, 0.1)
+                """},
+            rules=["hook-guard"],
+        )
+        assert rules_of(res) == [("hook-guard", "pkg/runtime/stream.py")]
+        assert "tr.xfer" in res.findings[0].message
+
+    def test_passes_on_guarded_xfer_hook(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def dispatch(tr):
+                    if tr is not None:
+                        tr.xfer("h2d", 10, 5, 0.0, 0.1)
+                """},
+            rules=["hook-guard"],
+        )
+        assert res.ok
+
 
 # ------------------------------------------------------------------- CLI
 
@@ -708,6 +775,9 @@ class TestShippedTree:
         for must in (
             "tools/dutlint.py", "tools/check_trace.py",
             "tools/trace_report.py", "tools/serve_report.py",
+            # the byte-ledger / bench-trajectory tools carry the same
+            # schema obligations as the trace tools they sit beside
+            "tools/wirestat.py", "tools/bench_history.py",
             # the profiling/tuning tools carry the same clock +
             # durability obligations as the report tools; anchoring
             # them here means clock/durability drift in any tool is

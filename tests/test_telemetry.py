@@ -25,7 +25,7 @@ from duplexumiconsensusreads_tpu.io import read_bam, simulated_bam
 from duplexumiconsensusreads_tpu.runtime import faults
 from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
 from duplexumiconsensusreads_tpu.simulate import SimConfig
-from duplexumiconsensusreads_tpu.telemetry import chrome, report, trace
+from duplexumiconsensusreads_tpu.telemetry import chrome, ledger, report, trace
 from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 
 GP = GroupingParams(strategy="adjacency", paired=True)
@@ -318,6 +318,164 @@ class TestStreamCapture:
             assert a.read() == b.read()
 
 
+# ------------------------------------------------------------ byte ledger
+
+class TestByteLedger:
+    """The xfer record contract (telemetry/ledger.py): per-chunk
+    per-direction byte accounting whose record totals reproduce the
+    summary totals exactly and whose shard bytes reproduce the
+    finalised output, on-disk, to the byte."""
+
+    def test_xfer_record_schema_golden(self, traced):
+        records, rep, _ = traced
+        xf = ledger.xfer_records(records)
+        assert xf, "a traced streaming run must carry ledger records"
+        # golden envelopes per direction — a new field is a schema
+        # change and must be made here (and in ARCHITECTURE.md) on
+        # purpose, not by drift
+        for r in xf:
+            assert r["dir"] in trace.KNOWN_XFER_DIRS
+            base = {"type", "dir", "t", "dur", "wire", "lane", "chunk"}
+            if r.get("resumed"):
+                assert set(r) == base | {"resumed"}
+            else:
+                assert set(r) == base | {"logical"}
+            assert isinstance(r["wire"], int) and r["wire"] >= 0
+            assert r["t"] >= 0 and r["dur"] >= 0
+        # every chunk of the run is covered in every direction
+        per = ledger.per_chunk_bytes(records)
+        assert sorted(per) == list(range(rep["n_chunks"]))
+        for row in per.values():
+            assert {"h2d", "d2h", "shard"} <= set(row)
+        # packing can only shrink the h2d wire; nothing packs d2h (yet)
+        for r in xf:
+            if r["dir"] == "h2d":
+                assert r["logical"] >= r["wire"] > 0
+            elif r["dir"] == "d2h":
+                assert r["logical"] == r["wire"] > 0
+
+    def test_totals_sum_check_and_on_disk_output(self, traced):
+        records, rep, paths = traced
+        rows, ok = ledger.sum_check_bytes(records)
+        assert ok and rows
+        b = ledger.summary_bytes(records)
+        # the summary totals are the RunReport's wire counters
+        assert b["h2d_wire"] == rep["bytes_h2d"]
+        assert b["d2h_wire"] == rep["bytes_d2h"]
+        # the byte identity the whole ledger is anchored to: overhead
+        # (header shell + EOF) plus every shard's wire bytes IS the
+        # finalised BAM, measured on disk
+        tot = ledger.byte_totals(records)
+        assert b["output_bytes"] == os.path.getsize(paths["out"])
+        assert (
+            b["output_overhead_bytes"] + tot["shard"]["wire"]
+            == b["output_bytes"]
+        )
+        problems, ok2 = ledger.output_check(records)
+        assert ok2, problems
+
+    def test_wire_floor_and_bandwidth_are_measured(self, traced):
+        records, _, _ = traced
+        fl = ledger.wire_floor(records)
+        assert 0 < fl["floor_s"] <= fl["wall_s"]
+        assert 0 < fl["frac"] <= 1
+        # the union can only collapse overlap, never exceed the sums
+        assert fl["floor_s"] <= fl["h2d_s"] + fl["d2h_s"] + 1e-9
+        bw = ledger.bandwidth_stats(records)
+        assert set(bw) == {"h2d", "d2h"}
+        for row in bw.values():
+            assert row["p95_mb_s"] >= row["p50_mb_s"] >= 0
+        pack = ledger.packing_stats(records)
+        assert pack["h2d_packing_ratio"] >= 1.0
+        assert pack["bytes_per_read"] > 0
+
+    def test_validator_rejects_malformed_xfer(self):
+        base = [{"type": "meta", "version": trace.TRACE_VERSION,
+                 "kind": "run", "clock": "monotonic-relative"}]
+        bad_dir = base + [{"type": "xfer", "dir": "warp", "t": 0.0,
+                           "dur": 0.0, "wire": 1, "lane": "main"}]
+        assert any("warp" in p for p in report.validate_trace(bad_dir))
+        bad_wire = base + [{"type": "xfer", "dir": "h2d", "t": 0.0,
+                            "dur": 0.0, "wire": 1.5, "lane": "main"}]
+        assert any("wire" in p for p in report.validate_trace(bad_wire))
+        float_total = base + [{"type": "summary", "t": 1.0, "n_events": 0,
+                               "n_dropped": 0, "bytes": {"h2d_wire": 1.5}}]
+        assert any("bytes" in p for p in report.validate_trace(float_total))
+
+    def test_wirestat_cli_ok_tampered_record_and_output_drift(
+        self, traced, tmp_path
+    ):
+        """The corruption contract: a healthy capture exits 0; a
+        capture whose ledger disagrees with its summary exits 1; a
+        capture whose output file no longer matches the ledgered size
+        exits 1."""
+        _, _, paths = traced
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        wirestat = os.path.join(REPO, "tools", "wirestat.py")
+        r = subprocess.run(
+            [sys.executable, wirestat, paths["trace"]],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "byte sum-check" in r.stdout and "OK" in r.stdout
+        rj = subprocess.run(
+            [sys.executable, wirestat, paths["trace"], "--json"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert rj.returncode == 0
+        doc = json.loads(rj.stdout)
+        assert doc["sum_check"]["ok"] and doc["output_check"]["ok"]
+        assert doc["wire_floor"]["frac"] > 0
+        # tamper one shard record's wire bytes -> record/summary drift
+        tampered = str(tmp_path / "tampered.jsonl")
+        with open(paths["trace"]) as f, open(tampered, "w") as g:
+            done = False
+            for line in f:
+                rec = json.loads(line)
+                if (
+                    not done and rec.get("type") == "xfer"
+                    and rec.get("dir") == "shard"
+                ):
+                    rec["wire"] += 512
+                    done = True
+                g.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        assert done
+        r = subprocess.run(
+            [sys.executable, wirestat, tampered],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert r.returncode == 1
+        assert "DRIFT" in r.stderr
+        # grow a COPY of the output -> on-disk size drift via --out
+        grown = str(tmp_path / "grown.bam")
+        with open(paths["out"], "rb") as f:
+            data = f.read()
+        with open(grown, "wb") as f:
+            f.write(data + b"\x00")
+        r = subprocess.run(
+            [sys.executable, wirestat, paths["trace"], "--out", grown],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert r.returncode == 1
+
+    def test_chrome_export_carries_byte_counters(self, traced):
+        records, _, _ = traced
+        doc = chrome.to_chrome(records)
+        counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert counters
+        names = {e["name"] for e in counters}
+        assert any(n.startswith("h2d_bytes") for n in names)
+        assert any(n.startswith("d2h_bytes") for n in names)
+        # every raise has a matching drop back to zero
+        for e in counters:
+            assert e["args"].get("bytes") is not None
+        by_name: dict = {}
+        for e in counters:
+            by_name.setdefault(e["name"], []).append(e["args"]["bytes"])
+        for vals in by_name.values():
+            assert 0 in vals and any(v > 0 for v in vals)
+
+
 # ------------------------------------------------ chaos + resume events
 
 class TestStructuredEvents:
@@ -411,6 +569,52 @@ class TestStructuredEvents:
         # stages on either side)
         _, ok = report.sum_check(records)
         assert ok
+
+    def test_ledger_survives_kill_resume_without_double_counting(
+        self, tmp_path
+    ):
+        """Chaos pass for the byte ledger: kill mid-run, resume with a
+        fresh capture — reused chunks appear in the resumed capture as
+        exactly one wire-free shard record each (no h2d/d2h), fresh
+        chunks carry the full transfer set, and the shard totals still
+        reproduce the finalised output byte-for-byte."""
+        in_path = self._sim(tmp_path)
+        out = str(tmp_path / "o.bam")
+        t1 = str(tmp_path / "kill.jsonl")
+        t2 = str(tmp_path / "resume.jsonl")
+        faults.install(faults.FaultPlan.parse("ckpt.save:3:kill"))
+        with pytest.raises(faults.InjectedKill):
+            stream_call_consensus(
+                in_path, out, GP, CP, trace_path=t1, **KW
+            )
+        faults.uninstall()
+        stream_call_consensus(
+            in_path, out, GP, CP, trace_path=t2, resume=True, **KW
+        )
+        records = report.load_trace(t2)
+        assert report.validate_trace(records) == []
+        reused = {
+            r["chunk"] for r in records
+            if r.get("name") == "resume" and r["decision"] == "reused"
+        }
+        assert reused, "the kill must land after at least one durable mark"
+        per = ledger.per_chunk_bytes(records)
+        for chunk, row in per.items():
+            if chunk in reused:
+                # reused: one resumed shard record, zero wire traffic
+                assert set(row) == {"shard"}
+                assert row["shard"]["resumed"]
+            else:
+                assert {"h2d", "d2h", "shard"} <= set(row)
+                assert not row["shard"]["resumed"]
+        # each chunk's shard bytes counted exactly once: the capture
+        # still reproduces the output file exactly
+        rows, ok = ledger.sum_check_bytes(records)
+        assert ok, rows
+        problems, ok2 = ledger.output_check(records)
+        assert ok2, problems
+        b = ledger.summary_bytes(records)
+        assert b["output_bytes"] == os.path.getsize(out)
 
 
 # ------------------------------------------------------------ CLI + tools

@@ -4,9 +4,10 @@ Run: python tools/check_trace.py trace.jsonl [--require-summary]
 
 Exit 0 when the capture conforms to the telemetry contract
 (telemetry/trace.py: meta header first, known span stages and event
-names, numeric non-negative timestamps, one terminal summary whose
-n_events matches the record count); exit 1 listing every violation
-otherwise. ``--require-summary`` additionally fails a capture that
+names, byte-ledger xfer records with registered directions and
+integer byte counts, numeric non-negative timestamps, one terminal
+summary whose n_events matches the record count and whose byte totals
+are integers); exit 1 listing every violation otherwise. ``--require-summary`` additionally fails a capture that
 lacks the terminal summary record — i.e. one from a run that did not
 shut down cleanly — which is what the tier-1 test uses: a synthetic
 run's capture must always be COMPLETE, not merely well-formed.
@@ -64,9 +65,11 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     n_spans = sum(1 for r in records if r.get("type") == "span")
     n_events = sum(1 for r in records if r.get("type") == "event")
+    n_xfer = sum(1 for r in records if r.get("type") == "xfer")
     print(
         f"[check_trace] {args.trace}: OK "
-        f"({kind} capture, {n_spans} spans, {n_events} events)",
+        f"({kind} capture, {n_spans} spans, {n_events} events, "
+        f"{n_xfer} xfer)",
         file=sys.stderr,
     )
     return 0

@@ -91,6 +91,11 @@ def summarize(records: list[dict]) -> dict:
             sec = rec.get("seconds")
             if isinstance(sec, dict):
                 j["seconds"] = sec
+            # whole-job byte ledger (all slices), from the service's
+            # per-job accumulation — pre-ledger captures simply lack it
+            for key in ("h2d_bytes", "d2h_bytes", "bytes_per_read"):
+                if isinstance(rec.get(key), (int, float)):
+                    j[key] = rec[key]
         elif name == "job_failed":
             j["state"] = "failed"
             j["error"] = rec.get("error")
@@ -176,13 +181,20 @@ def main(argv: list[str] | None = None) -> int:
             f"{s['n_retry_events']} retries"
         )
     print(f"{'job':<18} {'state':<9} {'pri':>3} {'slices':>6} "
-          f"{'preempt':>7} {'wall_s':>8} {'warm':>5}")
+          f"{'preempt':>7} {'wall_s':>8} {'warm':>5} {'h2d_mb':>8} "
+          f"{'d2h_mb':>8} {'B/read':>7}")
+    def _mb(v):
+        return f"{v / 1e6:.1f}" if isinstance(v, (int, float)) else "-"
+
     for job_id in sorted(s["jobs"]):
         j = s["jobs"][job_id]
+        bpr = j.get("bytes_per_read")
         print(
             f"{job_id:<18} {j['state']:<9} {str(j.get('priority', '?')):>3} "
             f"{j['slices']:>6} {j['preemptions']:>7} {j['wall_s']:>8.3f} "
-            f"{str(j['warm']):>5}"
+            f"{str(j['warm']):>5} {_mb(j.get('h2d_bytes')):>8} "
+            f"{_mb(j.get('d2h_bytes')):>8} "
+            f"{f'{bpr:g}' if isinstance(bpr, (int, float)) else '-':>7}"
         )
         sec = j.get("seconds")
         if isinstance(sec, dict):

@@ -1,0 +1,183 @@
+"""Byte-ledger report for a streaming-executor trace capture.
+
+Run: python tools/wirestat.py trace.jsonl
+       (per-chunk byte table, per-direction totals with packing /
+        deflate ratios, measured bandwidth p50/p95/effective, the
+        wire-floor decomposition, and the two byte sum-checks: ledger
+        records vs the summary's running totals, and header/EOF
+        overhead + shard wire bytes vs the finalised output's on-disk
+        size — exit 1 on any drift, the byte analogue of
+        trace_report.py's time sum-check)
+     python tools/wirestat.py trace.jsonl --json
+       (the same analysis as one machine-readable JSON object)
+     python tools/wirestat.py trace.jsonl --out other.bam
+       (check the on-disk size of a moved/renamed output instead of
+        the path recorded in the capture)
+
+The analysis lives in duplexumiconsensusreads_tpu/telemetry/ledger.py;
+this file is the CLI shell (same split as trace_report.py/report.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# cap the human table; a 200M-read run has hundreds of chunks and the
+# totals/percentiles already carry the verdict (--json is unabridged)
+_TABLE_ROWS = 40
+
+
+def _fmt_bytes(n) -> str:
+    return f"{n:,}" if isinstance(n, int) else "-"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wirestat.py",
+        description="per-chunk byte accounting / measured wire model "
+        "for a `call --trace` capture",
+    )
+    ap.add_argument("trace", help="JSONL capture from call --trace")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the analysis as one JSON object instead of text",
+    )
+    ap.add_argument(
+        "--out", metavar="BAM", default=None,
+        help="output BAM to size-check (default: the path recorded in "
+        "the capture summary)",
+    )
+    args = ap.parse_args(argv)
+
+    from duplexumiconsensusreads_tpu.telemetry import ledger, report
+
+    try:
+        records = report.load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"wirestat: {e}", file=sys.stderr)
+        return 1
+    problems = report.validate_trace(records)
+    if problems:
+        for p in problems:
+            print(f"wirestat: invalid capture: {p}", file=sys.stderr)
+        return 1
+
+    # one record scan feeds every analysis below
+    totals = ledger.byte_totals(records)
+    rows, sum_ok = ledger.sum_check_bytes(records, totals=totals)
+    disk_problems, disk_ok = ledger.output_check(
+        records, out_path=args.out, totals=totals
+    )
+    ok = sum_ok and disk_ok
+    n_xfer = sum(t["n"] for t in totals.values())
+
+    if args.json:
+        print(json.dumps({
+            "n_xfer_records": n_xfer,
+            "totals": totals,
+            "bandwidth": ledger.bandwidth_stats(records, totals=totals),
+            "wire_floor": ledger.wire_floor(records, totals=totals),
+            "packing": ledger.packing_stats(records, totals=totals),
+            "chunks": ledger.per_chunk_bytes(records),
+            "summary_bytes": ledger.summary_bytes(records),
+            "sum_check": {"ok": sum_ok, "rows": rows},
+            "output_check": {"ok": disk_ok, "problems": disk_problems},
+        }))
+    else:
+        if n_xfer == 0:
+            # legal (tracing predates the ledger, or a zero-chunk run)
+            # but worth saying out loud: every check below is vacuous
+            print("capture holds no xfer records (pre-ledger capture?)")
+        chunks = ledger.per_chunk_bytes(records)
+        print(
+            f"{'chunk':>6} {'h2d_logical':>12} {'h2d_wire':>12} "
+            f"{'d2h_wire':>12} {'shard_raw':>12} {'shard_wire':>12}  note"
+        )
+        for i, (chunk, row) in enumerate(chunks.items()):
+            if i >= _TABLE_ROWS:
+                print(f"  ... {len(chunks) - _TABLE_ROWS} more chunks "
+                      f"(--json for all)")
+                break
+            h2d = row.get("h2d", {})
+            d2h = row.get("d2h", {})
+            shard = row.get("shard", {})
+            note = "resumed" if shard.get("resumed") else ""
+            print(
+                f"{chunk:>6} {_fmt_bytes(h2d.get('logical', 0)):>12} "
+                f"{_fmt_bytes(h2d.get('wire', 0)):>12} "
+                f"{_fmt_bytes(d2h.get('wire', 0)):>12} "
+                f"{_fmt_bytes(shard.get('logical', 0)):>12} "
+                f"{_fmt_bytes(shard.get('wire', 0)):>12}  {note}"
+            )
+        print()
+        for direction in ledger.KNOWN_XFER_DIRS:
+            t = totals.get(direction)
+            if not t:
+                continue
+            extra = (
+                f"  ({t['n_resumed']} resume-reused)" if t["n_resumed"] else ""
+            )
+            print(
+                f"{direction:<6} n={t['n']:<5} logical={t['logical']:,} "
+                f"wire={t['wire']:,} busy={t['busy_s']:.3f}s{extra}"
+            )
+        pack = ledger.packing_stats(records, totals=totals)
+        if pack:
+            print("packing: " + "  ".join(
+                f"{k}={v}" for k, v in pack.items()
+            ))
+        bw = ledger.bandwidth_stats(records, totals=totals)
+        for direction, b in bw.items():
+            print(
+                f"{direction} bandwidth: effective {b['effective_mb_s']} "
+                f"MB/s  p50 {b['p50_mb_s']}  p95 {b['p95_mb_s']} "
+                f"(per-transfer)"
+            )
+        fl = ledger.wire_floor(records, totals=totals)
+        print(
+            f"wire floor: h2d {fl['h2d_s']}s + d2h {fl['d2h_s']}s "
+            f"(union {fl['floor_s']}s) over wall {fl['wall_s']}s "
+            f"= frac {fl['frac']}"
+        )
+        print()
+        if rows:
+            verdict = "OK" if sum_ok else "FAIL"
+            print(f"byte sum-check (records vs summary totals): {verdict}")
+            for r in rows:
+                if not r["ok"]:
+                    print(
+                        f"  {r['key']}: records {r['records']:,} vs "
+                        f"summary {r['summary']:,}"
+                    )
+        else:
+            print("byte sum-check skipped (no summary: unclean shutdown)")
+        if disk_ok:
+            b = ledger.summary_bytes(records) or {}
+            if "output_bytes" in b:
+                print(
+                    f"output check: OK (overhead + shard wire == "
+                    f"{b['output_bytes']:,} bytes)"
+                )
+        else:
+            print("output check: FAIL")
+            for p in disk_problems:
+                print(f"  {p}")
+
+    if not ok:
+        print(
+            "BYTE LEDGER DRIFT: ledger records disagree with the summary "
+            "totals or the on-disk output — instrumentation bug or file "
+            "corruption",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import os as _os
+
+    sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    raise SystemExit(main())
