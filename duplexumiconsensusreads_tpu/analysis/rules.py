@@ -702,6 +702,225 @@ def _lease_mutation_line(fn: ast.AST) -> int | None:
     return None
 
 
+# ------------------------------------------------ rule: deadline discipline
+
+# the time-stamp key family in the serving journal: anything from it
+# must carry the domain suffix — "_m" (a time.monotonic() reading) or
+# "_s" (a duration). A bare "deadline"/"expires" key is exactly the
+# place a wall-clock reading sneaks in and survives review, because it
+# works until the first NTP step (or cross-restart comparison) voids
+# every expiry at once.
+_STAMP_KEY_PREFIXES = ("deadline", "expires", "progress", "admitted",
+                       "claimed")
+
+
+@register(
+    "deadline-discipline",
+    "serve/ deadlines/expiries live in the monotonic domain; every "
+    "journal state literal is registered and serving-suite exercised",
+)
+def check_deadline_discipline(corpus: Corpus) -> Iterator[Finding]:
+    """Three checks, all cheap to drift past review:
+
+    (a) STAMP-KEY NAMING: in ``serve/``, any journal key from the
+        time-stamp family (deadline/expires/progress/admitted/claimed)
+        must end in ``_m`` (monotonic stamp) or ``_s`` (duration) —
+        the naming convention IS the domain annotation the clock rule
+        cannot see across a dict boundary;
+    (b) MONOTONIC DERIVATION: a function that writes a ``*_m`` key
+        must read ``time.monotonic()`` in the same scope — a ``*_m``
+        key fed from anything else is a lie wearing the convention;
+    (c) STATE REGISTRY: every literal a ``serve/`` file assigns into a
+        journal entry's ``state`` is registered in
+        ``serve/queue.py`` JOB_STATES, and every registered state is
+        exercised by ``tests/test_serve.py`` as a literal — an
+        unregistered terminal state (expired, quarantined, ...) would
+        silently fall out of compaction/idle/status logic."""
+    serve_paths = [
+        p for p in corpus.trees
+        if "serve" in p.split("/")[:-1]
+    ]
+
+    # (a) stamp-key naming
+    for path in serve_paths:
+        for lit, line in _dict_key_literals(corpus.trees[path]):
+            if not lit.startswith(_STAMP_KEY_PREFIXES):
+                continue
+            if lit.endswith(("_m", "_s")):
+                continue
+            yield Finding(
+                rule="deadline-discipline",
+                path=path,
+                line=line,
+                message=f"time-stamp key {lit!r} without a clock-domain "
+                f"suffix",
+                hint="name monotonic stamps '<key>_m' and durations "
+                "'<key>_s' — the suffix is the domain annotation the "
+                "deadline arithmetic is checked against",
+            )
+
+    # (b) *_m keys must be derived from time.monotonic() in-function
+    for path in serve_paths:
+        for fn in ast.walk(corpus.trees[path]):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            line = _monotonic_stamp_assign_line(fn)
+            if line is None:
+                continue
+            mentions = any(
+                (isinstance(n, ast.Attribute) and n.attr == "monotonic")
+                or (isinstance(n, ast.Name) and "monotonic" in n.id)
+                for n in ast.walk(fn)
+            )
+            if not mentions:
+                yield Finding(
+                    rule="deadline-discipline",
+                    path=path,
+                    line=line,
+                    message=f"monotonic-domain key written in {fn.name}() "
+                    f"without a time.monotonic() reading in scope",
+                    hint="compute *_m stamps from time.monotonic() in the "
+                    "same function (wall clocks void every expiry on an "
+                    "NTP step)",
+                )
+
+    # (c) state-literal registry + serving-suite exercise
+    queue_path = corpus.find("serve/queue.py")
+    if queue_path is None:
+        return
+    states, states_line = str_tuple_assign(
+        corpus.trees[queue_path], "JOB_STATES"
+    )
+    if not states:
+        yield Finding(
+            rule="deadline-discipline",
+            path=queue_path,
+            line=1,
+            message="JOB_STATES literal tuple not found",
+            hint="keep JOB_STATES a module-level tuple of string literals "
+            "so the state machine stays statically checkable",
+        )
+        return
+    state_set = set(states)
+    for path in serve_paths:
+        for lit, line in _journal_state_literals(corpus.trees[path]):
+            if lit not in state_set:
+                yield Finding(
+                    rule="deadline-discipline",
+                    path=path,
+                    line=line,
+                    message=f"journal state literal {lit!r} is not "
+                    f"registered in serve.queue.JOB_STATES",
+                    hint="register the state (and cover it in "
+                    "tests/test_serve.py) or fix the typo",
+                )
+    serve_anchor = corpus.find("tests/test_serve.py")
+    if serve_anchor is None:
+        return
+    roots: list[ast.AST] = []
+    for node in ast.walk(corpus.trees[serve_anchor]):
+        if isinstance(node, ast.Call):
+            roots.extend(node.args)
+            roots.extend(kw.value for kw in node.keywords)
+        elif isinstance(node, ast.Assign):
+            roots.append(node.value)
+        elif isinstance(node, ast.Compare):
+            # `assert status["state"] == "expired"` is the natural way
+            # a test exercises a state — comparisons count, docstrings
+            # still don't (bare Expr statements are never roots)
+            roots.extend(node.comparators)
+    literals = [
+        lit
+        for root in roots
+        for sub in ast.walk(root)
+        if (lit := str_const(sub)) is not None
+    ]
+    for state in states:
+        if not any(state in lit for lit in literals):
+            yield Finding(
+                rule="deadline-discipline",
+                path=serve_anchor,
+                line=1,
+                message=f"journal state {state!r} is never exercised by "
+                f"the serving suite",
+                hint="add a test driving a job through it (or a "
+                "registry-pin naming it) in tests/test_serve.py",
+            )
+
+
+def _dict_key_literals(tree: ast.Module) -> Iterator[tuple[str, int]]:
+    """String literals in DICT-KEY position: dict-literal keys,
+    subscript slices, and the key arg of .get/.pop/.setdefault — the
+    places a journal field name can appear."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is None:
+                    continue
+                s = str_const(k)
+                if s is not None:
+                    yield s, k.lineno
+        elif isinstance(node, ast.Subscript):
+            s = str_const(node.slice)
+            if s is not None:
+                yield s, node.lineno
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "pop", "setdefault")
+            and node.args
+        ):
+            s = str_const(node.args[0])
+            if s is not None:
+                yield s, node.lineno
+
+
+def _monotonic_stamp_assign_line(fn: ast.AST) -> int | None:
+    """First line in ``fn`` assigning a subscript whose literal key
+    ends in ``_m`` (a monotonic stamp write)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Subscript):
+                    s = str_const(sub.slice)
+                    if s is not None and s.endswith("_m"):
+                        return node.lineno
+    return None
+
+
+def _journal_state_literals(tree: ast.Module) -> Iterator[tuple[str, int]]:
+    """State literals written INTO journal entries: ``<x>["state"] =
+    "lit"`` subscript assignments, and the ``"state"`` value of any
+    dict literal ASSIGNED to a name or into a container (which covers
+    both ``self.jobs[id] = {"state": ...}`` and the temporary-dict
+    pattern ``entry = {"state": ...}; self.jobs[id] = entry``).
+    Read-side literals built inline in ``return`` expressions (status
+    rendering, client pseudo-states like "submitted") are not journal
+    writes and are deliberately out of scope."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Subscript)
+                and str_const(t.slice) == "state"
+            ):
+                s = str_const(node.value)
+                if s is not None:
+                    yield s, node.lineno
+        if isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if k is not None and str_const(k) == "state":
+                    s = str_const(v)
+                    if s is not None:
+                        yield s, node.lineno
+
+
 # --------------------------------------------------------- rule: hook guard
 
 @register(

@@ -48,6 +48,12 @@ CANONICAL_METRICS = (
     ("e2e_packed_speedup", True, False),
     ("e2e_vs_cpu_e2e", True, False),
     ("serve_amortised_speedup", True, False),
+    # defensive serving (PR 9): quarantine depth should sit AT the
+    # max_crashes bound (lower = gave up early, higher = re-ran poison)
+    # and watchdog latency is a detection cost — informational only,
+    # never gated (they characterise defense policy, not throughput)
+    ("serve_quarantine_after_crashes", False, False),
+    ("serve_watchdog_detect_latency_s", False, False),
 )
 
 _NUM = r"-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?"

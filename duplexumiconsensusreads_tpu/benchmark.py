@@ -78,6 +78,7 @@ COMPACT_KEYS = (
     "e2e_wire_h2d_mb_s_measured", "e2e_wire_d2h_mb_s_measured",
     "e2e_bytes_per_read", "e2e_packed_speedup", "e2e_vs_cpu_e2e",
     "serve_amortised_speedup", "serve_fleet_takeover_latency_s",
+    "serve_quarantine_after_crashes", "serve_watchdog_detect_latency_s",
 )
 
 
@@ -689,6 +690,130 @@ def run_serve_fleet_bench(n_daemons: int) -> dict:
     return out
 
 
+def run_serve_defense_bench() -> dict:
+    """The ``serve_fleet`` poison/watchdog sub-leg: the defensive
+    layer's two headline numbers, measured on the same tiny fleet
+    workload (both informational, non-gating — they characterise the
+    DEFENSE, not throughput):
+
+      serve_quarantine_after_crashes  unclean aborts a deterministic
+                                      poison job (injected kill at its
+                                      first shard write, every run)
+                                      survives before the fleet
+                                      quarantines it — must equal the
+                                      max_crashes bound, proving zero
+                                      re-runs beyond it
+      serve_watchdog_detect_latency_s wall from a slice wedging (lease
+                                      alive, durable progress stopped)
+                                      to the watchdog's abort-requeue
+                                      landing in the journal
+    """
+    import shutil
+    import threading
+
+    from duplexumiconsensusreads_tpu.runtime import faults
+    from duplexumiconsensusreads_tpu.serve import ConsensusService, client
+    from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
+
+    cache = os.environ.get("DUT_BENCH_CACHE", ".bench_cache")
+    n_reads = int(os.environ.get("DUT_BENCH_SERVE_READS", 120_000))
+    in_path, _ = _e2e_input(n_reads)
+    config = dict(
+        grouping="adjacency", mode="duplex", error_model="cycle",
+        capacity=int(os.environ.get("DUT_BENCH_CAPACITY", 2048)),
+        chunk_reads=max(n_reads // 4, 10_000),
+    )
+    out: dict = {}
+
+    # ---- poison quarantine: crash-loop daemons until the fleet gives
+    # up on the job; the count of daemon deaths IS the metric
+    spool = os.path.join(cache, "serve_defense_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    poison_out = os.path.join(cache, "serve_defense_poison.bam")
+    jid = client.submit(spool, in_path, poison_out, config=config,
+                        chaos="shard.write:1:kill")
+    deaths = 0
+    for i in range(8):
+        svc = ConsensusService(spool, chunk_budget=0, poll_s=0.02,
+                               daemon_id=f"defense-{i}")
+        try:
+            svc.run_until_idle()
+            break
+        except faults.InjectedKill:
+            deaths += 1
+    q = SpoolQueue(spool)
+    q.refresh()
+    if q.jobs.get(jid, {}).get("state") != "quarantined":
+        out["serve_defense_error"] = (
+            f"poison job not quarantined after {deaths} daemon deaths"
+        )
+    else:
+        out["serve_quarantine_after_crashes"] = deaths
+
+    # ---- watchdog detect latency: wedge a slice deterministically and
+    # time the journal's running -> queued transition
+    spool2 = os.path.join(cache, "serve_defense_wd_spool")
+    shutil.rmtree(spool2, ignore_errors=True)
+    wd_out = os.path.join(cache, "serve_defense_wd.bam")
+    jid2 = client.submit(spool2, in_path, wd_out, config=config)
+    svc = ConsensusService(
+        spool2, chunk_budget=1, poll_s=0.02, lease_s=3600.0,
+        watchdog_s=0.5, daemon_id="defense-wd",
+    )
+    wedged = [0.0]
+    release = threading.Event()
+    orig = svc.worker.run_slice
+
+    def wedging_run_slice(spec, budget, should_yield, drain_event,
+                          lease=None):
+        def wedge(*_a):
+            if not wedged[0]:
+                wedged[0] = time.monotonic()
+            release.wait(timeout=120)
+            return False
+
+        return orig(spec, 1, wedge, drain_event, lease=lease)
+
+    svc.worker.run_slice = wedging_run_slice
+    th = threading.Thread(target=lambda: _swallow(svc.run_until_idle),
+                          daemon=True)
+    th.start()
+    detect = None
+    deadline = time.monotonic() + 240
+    q2 = SpoolQueue(spool2)
+    while time.monotonic() < deadline:
+        if wedged[0]:
+            q2.refresh()
+            if q2.jobs.get(jid2, {}).get("state") == "queued":
+                detect = time.monotonic() - wedged[0]
+                break
+        time.sleep(0.005)
+    # un-wedge: the fenced slice unwinds, and the NEXT claim (the
+    # requeued job) runs clean to completion so the leg ends idle
+    svc.worker.run_slice = orig
+    release.set()
+    th.join(timeout=240)
+    if detect is None:
+        out["serve_defense_error"] = out.get(
+            "serve_defense_error", "watchdog never fired on the wedge"
+        )
+    else:
+        out["serve_watchdog_detect_latency_s"] = round(detect, 3)
+    for p in (poison_out, wd_out):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    return out
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except BaseException:  # noqa: BLE001 — bench harness, never fatal
+        pass
+
+
 def run_cpu_e2e(n_target: int) -> dict:
     """The SAME streamed end-to-end pipeline forced onto the XLA-CPU
     backend (VERDICT r2 item 2: the >=50x north-star claim is about
@@ -1074,6 +1199,9 @@ def main() -> None:
         n_fleet = int(os.environ.get("DUT_BENCH_SERVE_DAEMONS", 2))
         if n_serve > 0 and n_fleet >= 2:
             result.update(run_serve_fleet_bench(n_fleet))
+            # defensive-serving sub-leg: poison-job quarantine depth +
+            # watchdog detect latency (informational, non-gating)
+            result.update(run_serve_defense_bench())
         # same pipeline end-to-end on XLA-CPU: the wall-clock >=50x
         # denominator (DUT_BENCH_CPU_E2E_READS=0 disables); runs after
         # every TPU leg so the 1-core box is never shared

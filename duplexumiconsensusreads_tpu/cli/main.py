@@ -80,8 +80,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument(
         "--wait-timeout", type=float, default=0.0, metavar="SECONDS",
-        help="--wait gives up after this long (0 = wait forever); the "
-        "last status is printed with timed_out=true",
+        help="--wait gives up after this long (0 = wait forever): the "
+        "last status is printed with timed_out=true, the job's last "
+        "journaled state/reason goes to stderr, and the exit code is 3 "
+        "(distinct from 1 = terminal failure) so scripts can tell "
+        "'still running' from 'dead'",
+    )
+    c.add_argument(
+        "--deadline", type=float, default=None, metavar="SECONDS",
+        help="--submit wall budget from admission: past it the daemon "
+        "journals the job terminal 'expired' (a running job aborts at "
+        "its next checkpoint boundary; the committed prefix survives "
+        "for a re-submitted resume). Default: the daemon's --deadline",
     )
     c.add_argument("--config", choices=sorted(CONFIG_PRESETS), help="benchmark preset")
     c.add_argument(
@@ -549,19 +559,43 @@ def _cmd_call(args) -> int:
                 spool, args.wait, timeout_s=args.wait_timeout
             )
         print(json.dumps(st, sort_keys=True))
-        if st.get("state") == "rejected" and st.get("error"):
-            # the reason a job never ran must be one --status away, not
-            # buried in the daemon's journal: sheds (admission control)
-            # and invalid-spec rejections both name themselves
+        state = st.get("state")
+        if state in ("rejected", "expired", "quarantined") and st.get("error"):
+            # the reason a job never ran (or was given up on) must be
+            # one --status away, not buried in the daemon's journal:
+            # sheds, invalid-spec rejections, deadline expiries and
+            # poison quarantines all name themselves
             import sys as _sys
 
-            kind = "shed by admission control" if st.get("shed") else "rejected"
+            kind = (
+                "shed by admission control" if st.get("shed")
+                else state if state in ("expired", "quarantined")
+                else "rejected"
+            )
             print(
                 f"[duplexumi] job {st.get('job_id')} {kind}: {st['error']}",
                 file=_sys.stderr,
             )
-        bad = st.get("state") in ("failed", "rejected", "unknown")
-        return 1 if bad or st.get("timed_out") else 0
+        if st.get("timed_out"):
+            # distinct exit code: the job is NOT dead, the wait budget
+            # just ran out — say where the journal last saw it
+            import sys as _sys
+
+            detail = st.get("error") or (
+                f"slices={st.get('slices')}" if "slices" in st else ""
+            )
+            print(
+                f"[duplexumi] --wait timed out after {args.wait_timeout}s; "
+                f"job {st.get('job_id')} last journaled state: "
+                f"{state or 'unknown'}"
+                + (f" ({detail})" if detail else ""),
+                file=_sys.stderr,
+            )
+            return 3
+        bad = state in (
+            "failed", "rejected", "expired", "quarantined", "unknown"
+        )
+        return 1 if bad else 0
     if args.input is None or args.output is None:
         raise SystemExit("call needs INPUT and -o OUTPUT (unless --status/--wait)")
 
@@ -690,6 +724,8 @@ def _cmd_call(args) -> int:
             )
         if args.priority < 0:
             raise SystemExit(f"--priority must be >= 0 (got {args.priority})")
+        if args.deadline is not None and args.deadline <= 0:
+            raise SystemExit(f"--deadline must be > 0 (got {args.deadline})")
         if args.checkpoint or args.resume or args.report or args.profile:
             # the daemon owns checkpointing/resume (preemption + crash
             # recovery) and the result report (spool results/): these
@@ -745,6 +781,7 @@ def _cmd_call(args) -> int:
                 priority=args.priority,
                 chaos=args.chaos,
                 trace=args.trace,
+                deadline_s=args.deadline,
             )
         except (ValueError, OSError) as e:
             raise SystemExit(f"--submit: {e}")
@@ -756,6 +793,13 @@ def _cmd_call(args) -> int:
             file=sys.stderr,
         )
         return 0
+    if args.deadline is not None:
+        # deadlines are a service contract (journal expiry + fenced
+        # terminal state); a direct run would silently ignore the flag
+        raise SystemExit(
+            "--deadline applies to --submit jobs (daemon default: "
+            "dut-serve --deadline)"
+        )
     if args.trace and chunk_reads <= 0:
         # only the streaming executor is span-instrumented; on the
         # whole-file path the flag would silently record nothing

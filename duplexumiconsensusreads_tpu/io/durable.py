@@ -74,6 +74,23 @@ def unique_tmp(dst: str) -> str:
     return f"{dst}.tmp.{os.getpid()}.{threading.get_ident()}"
 
 
+def free_bytes(path: str) -> int | None:
+    """Free disk space (bytes available to this process) on the
+    filesystem holding ``path``, or None when it cannot be probed.
+
+    The durable spool/checkpoint design leans on the disk everywhere —
+    journal rewrites, shard writes, incremental finalise — so the
+    serving layer's disk-pressure degradation (admission shedding below
+    a low-water mark, terminal-litter GC) needs one honest probe rather
+    than waiting for the first ENOSPC to land mid-commit. ``f_bavail``
+    (not ``f_bfree``): what an unprivileged writer can actually use."""
+    try:
+        st = os.statvfs(path)
+    except OSError:
+        return None
+    return int(st.f_bavail) * int(st.f_frsize)
+
+
 def rewrite_from(f, offset: int, payload: bytes) -> None:
     """Idempotent append to a staging file: truncate back to ``offset``
     and write ``payload`` there. A transient failure mid-write can be

@@ -68,6 +68,11 @@ KNOWN_SITES = (
     "serve.renew",  # lease renewal (heartbeat + per-chunk commit)
     "serve.expire",  # expired/dead-owner lease reclaim (takeover)
     "serve.fence",  # fencing-token check before a durable commit
+    # defensive-serving spine: the deadline sweep/expiry commit and the
+    # stuck-run watchdog's stall reclaim — both durable journal moves,
+    # both chaos-targetable like every other lease-state transition
+    "serve.deadline",  # deadline sweep + terminal `expired` commit
+    "serve.watchdog",  # no-progress stall scan + abort-requeue commit
 )
 
 _EXC_ERRNO = {

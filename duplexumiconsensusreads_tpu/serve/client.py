@@ -20,7 +20,9 @@ from duplexumiconsensusreads_tpu.serve.job import validate_spec
 from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
 
 # states with nothing left to wait for
-TERMINAL_STATES = ("done", "failed", "rejected", "unknown")
+TERMINAL_STATES = (
+    "done", "failed", "rejected", "expired", "quarantined", "unknown",
+)
 
 # --wait backoff: the delay doubles from poll_s up to this cap, with
 # multiplicative jitter so a herd of waiting clients (every `--wait`
@@ -48,10 +50,14 @@ def submit(
     priority: int = 1,
     chaos: str | None = None,
     trace: str | None = None,
+    deadline_s: float | None = None,
 ) -> str:
     """Validate + durably spool one job; returns its id. Raises
     ValueError on a bad spec and FileNotFoundError on a missing input —
-    submission-time failures belong to the submitter, not the daemon."""
+    submission-time failures belong to the submitter, not the daemon.
+    ``deadline_s``: wall budget from admission; past it the job is
+    journaled terminal "expired" instead of run (a running slice aborts
+    at its next checkpoint boundary, keeping the committed prefix)."""
     if not os.path.exists(input_path):
         raise FileNotFoundError(f"job input does not exist: {input_path}")
     fields = {
@@ -64,6 +70,8 @@ def submit(
         fields["chaos"] = chaos
     if trace:
         fields["trace"] = os.path.abspath(trace)
+    if deadline_s is not None:
+        fields["deadline_s"] = deadline_s
     spec = validate_spec({"job_id": make_job_id(fields), **fields})
     return SpoolQueue(spool_dir).submit(spec)
 

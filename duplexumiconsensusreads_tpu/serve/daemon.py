@@ -2,7 +2,8 @@
 
     dut-serve SPOOL_DIR [--chunk-budget N] [--max-queue N] [--workers N]
                         [--lease S] [--class-depth SPEC] [--heartbeat S]
-                        [--no-trace] [--once] ...
+                        [--deadline S] [--watchdog S] [--max-crashes N]
+                        [--min-free-mb MB] [--no-trace] [--once] ...
 
 Runs a :class:`~duplexumiconsensusreads_tpu.serve.service.ConsensusService`
 over SPOOL_DIR until SIGTERM/SIGINT, which trigger graceful drain:
@@ -81,6 +82,34 @@ def build_parser() -> argparse.ArgumentParser:
         "pid-derived id; override only for debugging)",
     )
     p.add_argument(
+        "--deadline", type=float, default=0.0, metavar="SECONDS",
+        help="default job deadline from admission (0 = none; a job's "
+        "own deadline_s wins). Overdue queued jobs journal terminal "
+        "'expired'; a running job aborts at its next checkpoint "
+        "boundary with the committed prefix preserved for resume",
+    )
+    p.add_argument(
+        "--watchdog", type=float, default=None, metavar="SECONDS",
+        help="stuck-run watchdog: abort-requeue a running job whose "
+        "current chunk made no durable progress for this long (0 "
+        "disables; default: derived from the observed chunk-commit "
+        "p95 once enough chunks have been seen)",
+    )
+    p.add_argument(
+        "--max-crashes", type=int, default=3, metavar="N",
+        help="quarantine bound: a job whose runs abort uncleanly "
+        "(daemon death takeover, watchdog) this many times is "
+        "journaled terminal 'quarantined' with a diagnosis bundle "
+        "instead of re-entering the queue (default 3)",
+    )
+    p.add_argument(
+        "--min-free-mb", type=int, default=64, metavar="MB",
+        help="disk low-water mark: shed new submissions when the spool "
+        "filesystem has less than this free, after a grace GC of "
+        "terminal jobs' shard/checkpoint litter (0 disables; "
+        "default 64)",
+    )
+    p.add_argument(
         "--poll", type=float, default=0.25, metavar="SECONDS",
         help="inbox poll interval when idle (default 0.25)",
     )
@@ -113,6 +142,14 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(f"--workers must be >= 1 (got {args.workers})")
     if args.lease is not None and args.lease <= 0:
         raise SystemExit(f"--lease must be > 0 (got {args.lease})")
+    if args.deadline < 0:
+        raise SystemExit(f"--deadline must be >= 0 (got {args.deadline})")
+    if args.watchdog is not None and args.watchdog < 0:
+        raise SystemExit(f"--watchdog must be >= 0 (got {args.watchdog})")
+    if args.max_crashes < 1:
+        raise SystemExit(f"--max-crashes must be >= 1 (got {args.max_crashes})")
+    if args.min_free_mb < 0:
+        raise SystemExit(f"--min-free-mb must be >= 0 (got {args.min_free_mb})")
     class_depths = None
     if args.class_depth:
         from duplexumiconsensusreads_tpu.serve.scheduler import (
@@ -144,6 +181,10 @@ def main(argv: list[str] | None = None) -> int:
         lease_s=args.lease if args.lease is not None else LEASE_DEFAULT_S,
         class_depths=class_depths,
         daemon_id=args.daemon_id,
+        default_deadline_s=args.deadline,
+        watchdog_s=args.watchdog,
+        max_crashes=args.max_crashes,
+        min_free_bytes=args.min_free_mb << 20,
     )
 
     def _drain(signum, _frame):

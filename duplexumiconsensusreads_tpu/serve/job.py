@@ -62,6 +62,12 @@ class JobSpec:
     config: dict = dataclasses.field(default_factory=dict)
     chaos: str | None = None  # per-job fault schedule (faults.FaultPlan)
     trace: str | None = None  # per-job run-capture path
+    # optional wall budget (seconds from ADMISSION, not from first
+    # chunk): admission stamps a monotonic expiry on the journal entry,
+    # the scheduler refuses to claim past it and a running slice aborts
+    # at its next checkpoint boundary — terminal state "expired", with
+    # the partial checkpoint preserved so a re-submitted job resumes
+    deadline_s: float | None = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -74,7 +80,7 @@ def validate_spec(d: dict) -> JobSpec:
     if not isinstance(d, dict):
         raise ValueError("job spec must be a JSON object")
     allowed_top = {"job_id", "input", "output", "priority", "config",
-                   "chaos", "trace"}
+                   "chaos", "trace", "deadline_s"}
     unknown = set(d) - allowed_top
     if unknown:
         raise ValueError(f"unknown job fields: {sorted(unknown)}")
@@ -119,6 +125,17 @@ def validate_spec(d: dict) -> JobSpec:
     trace = d.get("trace")
     if trace is not None and (not isinstance(trace, str) or not trace):
         raise ValueError("job trace must be a non-empty path")
+    deadline_s = d.get("deadline_s")
+    if deadline_s is not None:
+        if (
+            not isinstance(deadline_s, (int, float))
+            or isinstance(deadline_s, bool)
+            or deadline_s <= 0
+        ):
+            raise ValueError(
+                f"job deadline_s must be a number > 0 (got {deadline_s!r})"
+            )
+        deadline_s = float(deadline_s)
     return JobSpec(
         job_id=d["job_id"],
         input=d["input"],
@@ -127,6 +144,7 @@ def validate_spec(d: dict) -> JobSpec:
         config=config,
         chaos=chaos,
         trace=trace,
+        deadline_s=deadline_s,
     )
 
 

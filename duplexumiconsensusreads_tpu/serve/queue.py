@@ -48,8 +48,10 @@ Fault sites: ``serve.accept`` guards the read+parse+validate of each
 submission and ``serve.journal`` every durable journal persist (both
 here); the serving layer wraps the lease operations at their own sites
 — ``serve.lease`` around :meth:`SpoolQueue.claim`, ``serve.renew``
-around renewal, ``serve.expire`` around :meth:`SpoolQueue.reclaim_dead`
-and ``serve.fence`` around :meth:`SpoolQueue.verify_lease` — so chaos
+around renewal, ``serve.expire`` around :meth:`SpoolQueue.reclaim_dead`,
+``serve.fence`` around :meth:`SpoolQueue.verify_lease`,
+``serve.deadline`` around the deadline sweep/expiry commits and
+``serve.watchdog`` around :meth:`SpoolQueue.reclaim_stalled` — so chaos
 schedules can target each step of the lease state machine. All ride
 the streaming executor's bounded host-I/O retry ladder, so transient
 faults are absorbed and an injected kill leaves exactly the on-disk
@@ -66,14 +68,48 @@ import socket
 import threading
 import time
 
-from duplexumiconsensusreads_tpu.io.durable import unique_tmp, write_durable
+from duplexumiconsensusreads_tpu.io.durable import (
+    free_bytes,
+    unique_tmp,
+    write_durable,
+)
 from duplexumiconsensusreads_tpu.serve.job import JobSpec, validate_spec
 
 JOURNAL_VERSION = 1
 
 # journal job states; the only legal transitions are
-# queued -> running -> (done | failed | queued on preempt/reclaim)
-JOB_STATES = ("queued", "running", "done", "failed", "rejected")
+#   queued -> running -> (done | failed | queued on preempt/reclaim)
+#   queued -> expired            (deadline passed before a claim)
+#   running -> expired           (slice aborted at a chunk boundary)
+#   running -> quarantined       (crash_count reached max_crashes on a
+#                                 takeover/watchdog abort — the job is
+#                                 poison: it kills whatever runs it, so
+#                                 it must never re-enter the queue)
+JOB_STATES = ("queued", "running", "done", "failed", "rejected",
+              "expired", "quarantined")
+
+# states with nothing left to schedule: compaction may drop them (their
+# durable results/ file remains the record) and the idle check ignores
+# them
+TERMINAL_STATES = ("done", "failed", "rejected", "expired", "quarantined")
+
+# poison quarantine: a job whose run aborts THIS many times without a
+# clean preemption (daemon death takeovers, watchdog stall reclaims) is
+# journaled terminal `quarantined` with a diagnosis bundle instead of
+# re-entering the queue — without this bound a deterministic poison job
+# ping-pongs between fleet daemons forever, killing each in turn
+MAX_CRASHES_DEFAULT = 3
+
+# per-job lease claims kept for the quarantine diagnosis bundle
+_LEASE_HISTORY_KEPT = 8
+
+# disk-pressure low-water mark: admission sheds new jobs when the spool
+# filesystem has less than this free (after a grace GC pass over
+# terminal jobs' shard/checkpoint litter). The durable design spends
+# disk on every transition — journal rewrites, shard writes, finalise
+# staging — so refusing new work while it can still be refused cleanly
+# beats dying on ENOSPC mid-commit.
+DISK_LOW_WATER_BYTES = 64 << 20
 
 # default lease length. Healthy daemons renew every chunk commit AND
 # every heartbeat, so expiry only ever fires on a daemon that stopped
@@ -91,6 +127,51 @@ class JobFenced(BaseException):
     slice must abort immediately, committing nothing, and the service
     drops the result on the floor (the reclaiming daemon owns the job
     now and will produce the identical bytes)."""
+
+
+def _remove_counting(path: str) -> int:
+    """Remove one file, returning the bytes it held (0 when absent or
+    unremovable — GC is best-effort)."""
+    try:
+        size = os.path.getsize(path)
+        os.remove(path)
+    except OSError:
+        return 0
+    return size
+
+
+def _trace_tail(path: str, max_bytes: int = 8192, max_lines: int = 20):
+    """Last ``max_lines`` lines of a (JSONL) capture file, for the
+    quarantine diagnosis bundle. Read-only and size-bounded: the bundle
+    must stay a small durable JSON, not re-spool the whole capture."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(size - max_bytes, 0))
+            data = f.read(max_bytes)
+    except OSError:
+        return None
+    lines = data.decode("utf-8", "replace").splitlines()
+    return [ln[:500] for ln in lines[-max_lines:]] or None
+
+
+def _last_fault_site(tail_lines) -> str | None:
+    """The last injected-fault site named in a capture tail — the
+    poison job's smoking gun when it carries a chaos schedule."""
+    site = None
+    for line in tail_lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if (
+            isinstance(rec, dict)
+            and rec.get("name") == "fault_injected"
+            and isinstance(rec.get("site"), str)
+        ):
+            site = rec["site"]
+    return site
 
 
 def _pid_alive(pid: int) -> bool:
@@ -115,15 +196,33 @@ class SpoolQueue:
     """
 
     def __init__(self, root: str, max_queue: int = 64,
-                 max_terminal_kept: int = 256):
+                 max_terminal_kept: int = 256,
+                 max_crashes: int = MAX_CRASHES_DEFAULT,
+                 default_deadline_s: float = 0.0,
+                 min_free_bytes: int = DISK_LOW_WATER_BYTES):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1 (got {max_queue})")
         if max_terminal_kept < 0:
             raise ValueError(
                 f"max_terminal_kept must be >= 0 (got {max_terminal_kept})"
             )
+        if max_crashes < 1:
+            raise ValueError(f"max_crashes must be >= 1 (got {max_crashes})")
+        if default_deadline_s < 0:
+            raise ValueError(
+                f"default_deadline_s must be >= 0 (got {default_deadline_s})"
+            )
         self.root = root
         self.max_queue = max_queue
+        # quarantine bound: aborts-without-clean-preemption before a job
+        # is declared poison (see reclaim_dead/reclaim_stalled)
+        self.max_crashes = max_crashes
+        # daemon-level deadline default (seconds; 0 = none): admission
+        # stamps spec.deadline_s or this onto the journal entry as a
+        # monotonic expiry
+        self.default_deadline_s = default_deadline_s
+        # disk-pressure admission bound (bytes; 0 disables the probe)
+        self.min_free_bytes = min_free_bytes
         # the journal is rewritten+fsynced on every transition, so it
         # must stay bounded on a long-lived daemon: terminal entries
         # (done/failed/rejected) beyond this many are compacted away on
@@ -183,9 +282,9 @@ class SpoolQueue:
         out = {"job_id": job_id, **{k: v for k, v in entry.items()
                                     if k != "spec"}}
         result_path = os.path.join(self.results_dir, job_id + ".json")
-        if entry.get("state") in ("done", "failed") and os.path.exists(
-            result_path
-        ):
+        if entry.get("state") in (
+            "done", "failed", "expired", "quarantined"
+        ) and os.path.exists(result_path):
             try:
                 with open(result_path) as f:
                     out["result"] = json.load(f)
@@ -206,6 +305,8 @@ class SpoolQueue:
             return {"job_id": job_id, "state": "unknown"}
         state = (
             "rejected" if result.get("rejected")
+            else "quarantined" if result.get("quarantined")
+            else "expired" if result.get("expired")
             else "failed" if "error" in result
             else "done"
         )
@@ -290,7 +391,7 @@ class SpoolQueue:
             (
                 (int(e.get("seq", 0)), jid)
                 for jid, e in self.jobs.items()
-                if e.get("state") in ("done", "failed", "rejected")
+                if e.get("state") in TERMINAL_STATES
             ),
         )
         for _, jid in terminal[: max(len(terminal) - self.max_terminal_kept, 0)]:
@@ -382,13 +483,15 @@ class SpoolQueue:
                 self.save()
                 self._unlink_inbox(path)
                 return None, str(e)
-            # admission control: the scheduler's per-class shed policy
-            # first, the global open-jobs bound as the backstop — both
-            # journaled as explicit shed-with-reason rejections, so an
-            # overloaded fleet degrades by policy (and tells the client
-            # why), never by an inbox silently rotting
-            reason = None
-            if self.admission_policy is not None:
+            # admission control: disk pressure first (accepting a job
+            # the spool cannot even journal for is the worst shed),
+            # then the scheduler's per-class shed policy, then the
+            # global open-jobs bound as the backstop — all journaled as
+            # explicit shed-with-reason rejections, so an overloaded
+            # fleet degrades by policy (and tells the client why),
+            # never by an inbox silently rotting
+            reason = self._disk_shed_reason()
+            if reason is None and self.admission_policy is not None:
                 reason = self.admission_policy(self.jobs, spec)
             if reason is None:
                 n_open = sum(
@@ -410,7 +513,7 @@ class SpoolQueue:
                 self.save()
                 self._unlink_inbox(path)
                 return None, reason
-            self.jobs[job_id] = {
+            entry = {
                 "state": "queued",
                 "seq": self.seq,
                 "priority": spec.priority,
@@ -422,6 +525,16 @@ class SpoolQueue:
                 # its queue-wait against this
                 "admitted_m": round(time.monotonic(), 3),
             }
+            # deadline: the job's own budget wins over the daemon-level
+            # default; stamped as a MONOTONIC expiry at admission (the
+            # budget runs from acceptance, queue-wait included), the
+            # one clock domain the whole lease machinery already uses
+            deadline_s = spec.deadline_s or self.default_deadline_s
+            if deadline_s and deadline_s > 0:
+                entry["deadline_m"] = round(
+                    time.monotonic() + float(deadline_s), 3
+                )
+            self.jobs[job_id] = entry
             self.seq += 1
             self.save()
             self._unlink_inbox(path)
@@ -477,6 +590,18 @@ class SpoolQueue:
                 "host": _HOST,
                 "expires_m": round(time.monotonic() + lease_s, 3),
             }
+            # durable-progress stamp: a fresh claim counts as progress
+            # (the watchdog must not declare a just-claimed job stalled
+            # while it compiles); every chunk-commit renewal re-stamps
+            entry["progress_m"] = round(time.monotonic(), 3)
+            # bounded claim history: who ran this job under which token
+            # — the quarantine diagnosis bundle's lease trail
+            hist = entry.setdefault("lease_history", [])
+            hist.append({
+                "owner": daemon_id, "pid": os.getpid(), "token": token,
+                "claimed_m": round(time.monotonic(), 3),
+            })
+            del hist[:-_LEASE_HISTORY_KEPT]
             self.save()
             return token
 
@@ -493,10 +618,19 @@ class SpoolQueue:
         lease_s: float = LEASE_DEFAULT_S,
     ) -> None:
         """Extend the lease (fault site ``serve.renew``), fenced: a
-        zombie must not be able to resurrect a reclaimed lease."""
+        zombie must not be able to resurrect a reclaimed lease.
+
+        Called from the per-chunk commit guard — i.e. exactly when a
+        chunk became durable — so it also re-stamps ``progress_m``, the
+        watchdog's DURABLE-progress clock. The heartbeat's
+        :meth:`renew_all` deliberately does not: a wedged device step
+        keeps the heartbeat (liveness) alive while committing nothing,
+        and conflating the two is exactly the hang this distinction
+        exists to catch."""
         with self._txn():
             entry = self._check_fence(job_id, daemon_id, token)
             entry["lease"]["expires_m"] = round(time.monotonic() + lease_s, 3)
+            entry["progress_m"] = round(time.monotonic(), 3)
             self.save()
 
     def renew_all(self, daemon_id: str, lease_s: float = LEASE_DEFAULT_S) -> int:
@@ -530,8 +664,15 @@ class SpoolQueue:
         ``is_live`` (optional callable daemon_id -> bool) identifies
         live daemons within THIS process — the in-process fleet used by
         tests and the bench, where every daemon shares one pid.
-        Returns [{job_id, reason, prev_owner}, ...]; the persist rides
-        fault site ``serve.expire``."""
+        Returns [{job_id, reason, prev_owner, crash_count[,
+        quarantined]}, ...]; the persist rides fault site
+        ``serve.expire``.
+
+        Every reclaim here is an abort that was NOT a clean preemption
+        (the owner died or went silent holding the lease), so it
+        increments the job's ``crash_count``; at ``max_crashes`` the
+        job is quarantined instead of requeued (see
+        :meth:`_abort_requeue_locked`)."""
         now = time.monotonic()
         with self._txn():
             reclaimed = []
@@ -556,15 +697,196 @@ class SpoolQueue:
                         reason = "dead-owner"
                 if reason is None:
                     continue
-                entry["state"] = "queued"
-                prev = (lease or {}).get("owner")
-                entry.pop("lease", None)
                 reclaimed.append(
-                    {"job_id": job_id, "reason": reason, "prev_owner": prev}
+                    self._abort_requeue_locked(job_id, entry, reason)
                 )
+                entry.pop("lease", None)
             if reclaimed:
                 self.save()
             return reclaimed
+
+    def reclaim_stalled(self, stall_s: float | None) -> list[dict]:
+        """Stuck-run watchdog reclaim: abort-requeue every RUNNING job
+        whose last durable progress (``progress_m``: stamped at claim
+        and on every chunk-commit renewal) is older than ``stall_s`` —
+        regardless of lease freshness. This is the hole lease expiry
+        cannot see: a wedged device step keeps the owner's heartbeat
+        (and therefore its lease renewals) alive while committing
+        nothing, forever. The requeue rides the normal lease/fence
+        path: the token is kept and the NEXT claim bumps it, so the
+        wedged slice — should it ever wake — is fenced at its first
+        durable commit, exactly like a zombie after expiry takeover.
+
+        ``stall_s`` None = disabled (returns []); the call still sits
+        under fault site ``serve.watchdog`` at the caller, so chaos
+        schedules target the watchdog step even when it reclaims
+        nothing. Counts as a crash (not a clean preemption) toward
+        quarantine, like takeover."""
+        if stall_s is None or stall_s <= 0:
+            return []
+        now = time.monotonic()
+        with self._txn():
+            reclaimed = []
+            for job_id, entry in self.jobs.items():
+                if entry.get("state") != "running":
+                    continue
+                progress_m = entry.get("progress_m")
+                if progress_m is None:
+                    continue  # pre-watchdog journal: expiry still covers
+                stalled = now - float(progress_m)
+                if stalled <= stall_s:
+                    continue
+                rec = self._abort_requeue_locked(job_id, entry, "stalled")
+                rec["stalled_s"] = round(stalled, 3)
+                reclaimed.append(rec)
+                entry.pop("lease", None)
+            if reclaimed:
+                self.save()
+            return reclaimed
+
+    def _abort_requeue_locked(
+        self, job_id: str, entry: dict, reason: str
+    ) -> dict:
+        """One unclean abort of a running job: bump ``crash_count``,
+        then either requeue at ORIGINAL seq with the token kept (the
+        next claim fences the old holder) or — at ``max_crashes`` —
+        move the job to terminal ``quarantined`` with a durable
+        diagnosis bundle. The CALLER holds the transaction, pops the
+        lease and saves ONCE after its sweep — saving per job here
+        would run compaction mid-iteration (mutating the dict being
+        swept) and rewrite+fsync the journal N times for one sweep.
+        Returns the reclaim record for the caller's counters/events."""
+        lease = entry.get("lease")
+        prev = (lease or {}).get("owner")
+        crashes = int(entry.get("crash_count", 0)) + 1
+        entry["crash_count"] = crashes
+        rec = {
+            "job_id": job_id, "reason": reason, "prev_owner": prev,
+            "crash_count": crashes,
+        }
+        if crashes >= self.max_crashes:
+            diagnosis = self._diagnosis(entry, reason)
+            error = (
+                f"quarantined after {crashes} crashed runs "
+                f"(max_crashes={self.max_crashes}; last abort: {reason})"
+            )
+            self._write_terminal_result(
+                job_id, {"error": error, "quarantined": True,
+                         "diagnosis": diagnosis},
+            )
+            entry["state"] = "quarantined"
+            entry["error"] = error[:500]
+            rec["quarantined"] = True
+        else:
+            entry["state"] = "queued"
+        return rec
+
+    def _diagnosis(self, entry: dict, reason: str) -> dict:
+        """The quarantine post-mortem bundle, durable in the job's
+        result file: why the fleet gave up, who held the job when, and
+        — when the job carried its own trace capture — the capture's
+        tail with the last injected/observed fault site, so the
+        operator (or the poison-job test) never has to re-run the
+        poison to learn what it does."""
+        out = {
+            "crash_count": int(entry.get("crash_count", 0)),
+            "max_crashes": self.max_crashes,
+            "last_abort": reason,
+            "lease_history": list(entry.get("lease_history", [])),
+        }
+        # capture sources, most-specific first: the job's own --trace
+        # capture, then the SERVICE captures — a daemon running with
+        # the (default) service trace owns the process-global telemetry
+        # hook, so the poison's fault_injected event lands in the
+        # service capture, not the job's; and the daemon the poison
+        # crashed is a PREVIOUS daemon whose capture the current one
+        # rotated to .prev on startup. Each is scanned over a generous
+        # suffix (the fault event lands before the in-flight drain
+        # spans that follow it into the capture), but only a short tail
+        # is bundled — the diagnosis must stay a small durable JSON.
+        candidates = []
+        trace = (entry.get("spec") or {}).get("trace")
+        if trace:
+            candidates.append(trace)
+        svc_trace = os.path.join(self.root, "service.trace.jsonl")
+        candidates += [svc_trace + ".prev", svc_trace]
+        for path in candidates:
+            lines = _trace_tail(path, max_bytes=65536, max_lines=512)
+            if not lines:
+                continue
+            out.setdefault("trace_tail", lines[-20:])
+            site = _last_fault_site(lines)
+            if site is not None:
+                out["last_fault_site"] = site
+                break
+        return out
+
+    def _write_terminal_result(self, job_id: str, payload: dict) -> None:
+        """Durable result write shared by the quarantine/expiry paths
+        (same protocol as done/failed results: the file outlives the
+        journal entry's compaction)."""
+        path = os.path.join(self.results_dir, job_id + ".json")
+        write_durable(
+            path,
+            json.dumps(payload, sort_keys=True).encode(),
+            tmp=unique_tmp(path),
+        )
+
+    # ---------------------------------------------------------- deadlines
+
+    def expire_deadlines(self) -> list[dict]:
+        """Terminal-ize every QUEUED job whose admission-stamped
+        monotonic deadline has passed: journal state ``expired`` with a
+        durable reason (fault site ``serve.deadline`` at the caller).
+        Running jobs are not touched here — their own slice aborts at
+        the next checkpoint boundary via the commit-path deadline check
+        — and the partial checkpoint is left intact either way, so a
+        re-submitted job resumes instead of recomputing (and can never
+        splice: resume re-verifies every shard)."""
+        now = time.monotonic()
+        with self._txn():
+            expired = []
+            for job_id, entry in self.jobs.items():
+                if entry.get("state") != "queued":
+                    continue
+                deadline_m = entry.get("deadline_m")
+                if deadline_m is None or float(deadline_m) > now:
+                    continue
+                overdue = now - float(deadline_m)
+                error = (
+                    f"expired: deadline passed {overdue:.3f}s ago before "
+                    f"the job could run (queued since admission)"
+                )
+                self._write_terminal_result(
+                    job_id, {"error": error, "expired": True},
+                )
+                entry["state"] = "expired"
+                entry["error"] = error[:500]
+                expired.append({"job_id": job_id, "reason": error})
+            if expired:
+                self.save()
+            return expired
+
+    def mark_expired(
+        self, job_id: str, reason: str,
+        daemon_id: str | None = None, token: int | None = None,
+    ) -> None:
+        """A RUNNING slice hit its deadline at a chunk boundary: fenced
+        terminal transition to ``expired`` with a durable reason. The
+        committed checkpoint prefix is preserved byte-for-byte — the
+        abort happened between commits, so the manifest is a valid
+        gap-free prefix and a re-submitted job resumes from it."""
+        with self._txn():
+            if daemon_id is not None:
+                self._check_fence(job_id, daemon_id, int(token or 0))
+            self._write_terminal_result(
+                job_id, {"error": reason[:2000], "expired": True},
+            )
+            entry = self.jobs[job_id]
+            entry["state"] = "expired"
+            entry["error"] = reason[:500]
+            entry.pop("lease", None)
+            self.save()
 
     # ----------------------------------------------- state transitions
 
@@ -637,6 +959,63 @@ class SpoolQueue:
         return sum(
             1 for j in self.jobs.values() if j.get("state") == "queued"
         )
+
+    # ------------------------------------------------------ disk pressure
+
+    def _disk_shed_reason(self) -> str | None:
+        """Admission-control verdict for disk pressure: a ``shed:
+        disk`` reason when the spool filesystem is below the low-water
+        mark even after a grace GC pass over terminal jobs' litter,
+        else None. An unprobeable filesystem admits (the durable writes
+        themselves will say otherwise soon enough)."""
+        if self.min_free_bytes <= 0:
+            return None
+        free = free_bytes(self.root)
+        if free is None or free >= self.min_free_bytes:
+            return None
+        # grace pass: terminal jobs' shard/checkpoint litter is the one
+        # reclaimable thing the queue owns — drop it and re-probe
+        # before refusing work
+        self.gc_terminal_litter()
+        free = free_bytes(self.root)
+        if free is None or free >= self.min_free_bytes:
+            return None
+        return (
+            f"shed: disk free {free >> 20}MB below low-water "
+            f"{self.min_free_bytes >> 20}MB on the spool filesystem"
+        )
+
+    def gc_terminal_litter(self) -> int:
+        """Delete terminal jobs' recovery litter: the ``<output>.ckpt``
+        manifest, ``<output>.shards/`` directory and ``<output>.tmp``
+        staging file of every journaled done/failed/expired/quarantined
+        job. A terminal job will never resume, so its checkpoint state
+        is pure disk pressure; the published output itself (and the
+        durable result) is never touched. Returns bytes freed.
+        Best-effort by design — called under disk pressure and before
+        failing a job on ENOSPC, where raising would only make the
+        victim's story worse."""
+        freed = 0
+        for entry in list(self.jobs.values()):
+            if entry.get("state") not in TERMINAL_STATES:
+                continue
+            output = (entry.get("spec") or {}).get("output")
+            if not output:
+                continue
+            for path in (output + ".ckpt", output + ".tmp"):
+                freed += _remove_counting(path)
+            shard_dir = output + ".shards"
+            try:
+                names = os.listdir(shard_dir)
+            except OSError:
+                continue
+            for n in names:
+                freed += _remove_counting(os.path.join(shard_dir, n))
+            try:
+                os.rmdir(shard_dir)
+            except OSError:
+                pass
+        return freed
 
     # ------------------------------------------------------- maintenance
 

@@ -88,13 +88,25 @@ class FairScheduler:
         return None
 
     @staticmethod
-    def pick(jobs: dict) -> str | None:
-        """The next job to run: min (priority, seq) over queued jobs."""
+    def pick(jobs: dict, now: float | None = None) -> str | None:
+        """The next job to run: min (priority, seq) over queued jobs.
+
+        ``now`` (a ``time.monotonic()`` reading — deadlines live only
+        in the monotonic domain) makes the pick deadline-aware: a
+        queued job whose admission-stamped ``deadline_m`` has passed is
+        never claimed. The service's deadline sweep journals such jobs
+        terminal ``expired`` in the same pass; refusing here too closes
+        the fleet race where another daemon picks between this
+        daemon's sweep and its claim."""
         best = None
         best_key = None
         for job_id, entry in jobs.items():
             if entry.get("state") != "queued":
                 continue
+            if now is not None:
+                deadline_m = entry.get("deadline_m")
+                if deadline_m is not None and float(deadline_m) <= now:
+                    continue  # expired: the sweep owns its terminal move
             key = (int(entry.get("priority", 1)), int(entry.get("seq", 0)))
             if best_key is None or key < best_key:
                 best, best_key = job_id, key

@@ -22,6 +22,24 @@ over a bound are journaled as explicit shed-with-reason rejections
 per-class queue-wait / time-to-first-chunk percentiles land in
 ``metrics.json`` — overload degrades by policy, observably.
 
+Defensive layer (deadlines / watchdog / quarantine / disk pressure):
+jobs may carry a ``deadline_s`` (or inherit the daemon's default) —
+admission stamps a monotonic expiry, the scheduler refuses expired
+picks, a per-pass sweep journals overdue queued jobs terminal
+``expired``, and a running slice aborts at its next checkpoint
+boundary with the committed prefix preserved for a re-submitted
+resume. A per-daemon WATCHDOG thread compares each running job's
+durable-progress stamp (re-written on every chunk-commit lease
+renewal, NOT by the heartbeat) against a stall threshold (explicit, or
+derived from the observed chunk-commit p95) and abort-requeues wedged
+runs through the lease/fence path. Every such unclean abort — watchdog
+or dead-daemon takeover — bumps the job's ``crash_count``; at
+``max_crashes`` the job is QUARANTINED terminally with a durable
+diagnosis bundle instead of re-poisoning the fleet. Admission sheds
+new jobs when the spool filesystem is below a low-water mark (after a
+grace GC of terminal jobs' litter), and an ENOSPC inside a job fails
+that job cleanly — durable reason, daemon alive.
+
 Graceful drain: :meth:`request_drain` (the daemon's SIGTERM handler)
 makes every running slice yield at its next chunk boundary — the
 executor checkpoints the committed prefix, the job is re-journaled as
@@ -43,6 +61,7 @@ validates it.
 
 from __future__ import annotations
 
+import errno
 import os
 import threading
 import time
@@ -52,12 +71,18 @@ from duplexumiconsensusreads_tpu.io.durable import unique_tmp, write_durable
 from duplexumiconsensusreads_tpu.runtime.stream import _io_retry
 from duplexumiconsensusreads_tpu.serve.job import validate_spec
 from duplexumiconsensusreads_tpu.serve.queue import (
+    DISK_LOW_WATER_BYTES,
     LEASE_DEFAULT_S,
+    MAX_CRASHES_DEFAULT,
     JobFenced,
     SpoolQueue,
 )
 from duplexumiconsensusreads_tpu.serve.scheduler import FairScheduler
-from duplexumiconsensusreads_tpu.serve.worker import LeaseContext, WarmWorker
+from duplexumiconsensusreads_tpu.serve.worker import (
+    JobDeadlineExceeded,
+    LeaseContext,
+    WarmWorker,
+)
 from duplexumiconsensusreads_tpu.telemetry import trace as telemetry
 from duplexumiconsensusreads_tpu.telemetry.report import _pctl
 from duplexumiconsensusreads_tpu.telemetry.trace import Heartbeat, TraceRecorder
@@ -81,6 +106,19 @@ def _daemon_is_live(daemon_id: str) -> bool:
 # daemon without unbounded growth (oldest samples age out)
 _LAT_SAMPLES_KEPT = 512
 
+# stuck-run watchdog: with no explicit --watchdog the stall threshold
+# derives from this daemon's OBSERVED chunk cadence — a run is declared
+# stalled only when its current chunk has made no durable progress for
+# WATCHDOG_P95_MULT x the p95 inter-commit interval (floored at
+# WATCHDOG_MIN_S), and only once enough samples exist to know what
+# "normal" looks like. Conservative by design: a watchdog that fires on
+# a slow-but-alive chunk converts honest work into a fenced abort and a
+# crash_count tick.
+WATCHDOG_MIN_S = 10.0
+WATCHDOG_P95_MULT = 20.0
+WATCHDOG_MIN_SAMPLES = 8
+_CHUNK_SAMPLES_KEPT = 256
+
 
 class ConsensusService:
     def __init__(
@@ -96,14 +134,31 @@ class ConsensusService:
         lease_s: float = LEASE_DEFAULT_S,
         class_depths: dict | None = None,
         daemon_id: str | None = None,
+        default_deadline_s: float = 0.0,
+        watchdog_s: float | None = None,
+        max_crashes: int = MAX_CRASHES_DEFAULT,
+        min_free_bytes: int = DISK_LOW_WATER_BYTES,
     ):
+        """Defensive knobs: ``default_deadline_s`` (daemon-level job
+        deadline, 0 = none; a job's own ``deadline_s`` wins),
+        ``watchdog_s`` (stall threshold for the stuck-run watchdog —
+        None = derive from observed chunk p95, 0 = disabled),
+        ``max_crashes`` (unclean aborts before a job is quarantined),
+        ``min_free_bytes`` (disk low-water mark below which admission
+        sheds, 0 = no probe)."""
         if workers < 1:
             raise ValueError(f"workers must be >= 1 (got {workers})")
         if poll_s <= 0:
             raise ValueError(f"poll_s must be > 0 (got {poll_s})")
         if lease_s <= 0:
             raise ValueError(f"lease_s must be > 0 (got {lease_s})")
-        self.queue = SpoolQueue(spool_dir, max_queue=max_queue)
+        if watchdog_s is not None and watchdog_s < 0:
+            raise ValueError(f"watchdog_s must be >= 0 (got {watchdog_s})")
+        self.queue = SpoolQueue(
+            spool_dir, max_queue=max_queue, max_crashes=max_crashes,
+            default_deadline_s=default_deadline_s,
+            min_free_bytes=min_free_bytes,
+        )
         self.sched = FairScheduler(
             chunk_budget=chunk_budget, class_depths=class_depths
         )
@@ -142,16 +197,51 @@ class ConsensusService:
         # first claim) and time-to-first-chunk (admission -> first
         # fresh chunk durable), bounded FIFO
         self._lat: dict[int, dict[str, list]] = {}
+        self.watchdog_s = watchdog_s
+        # observed inter-chunk-commit intervals (bounded FIFO): the
+        # auto-mode watchdog threshold derives from their p95
+        self._chunk_durs: list[float] = []
         self.counters = {
             "jobs_accepted": 0, "jobs_rejected": 0, "jobs_shed": 0,
             "jobs_done": 0, "jobs_failed": 0, "jobs_fenced": 0,
             "preemptions": 0, "jobs_recovered": 0,
+            "jobs_expired": 0, "jobs_quarantined": 0, "watchdog_fired": 0,
             # cumulative wire bytes across every slice this daemon
             # committed — rides the heartbeat line and metrics.json, so
             # a long-lived daemon's transfer pressure is live-readable
             "h2d_bytes": 0, "d2h_bytes": 0,
         }
+        # a restarted daemon's counters must not lie about the spool it
+        # serves: seed the job-outcome counters from the journal the
+        # restart inherited, so metrics.json stays truthful across
+        # restarts (bounded by journal compaction — results/ remains
+        # the per-job record beyond it)
+        self._rebuild_counters_from_journal()
         self._tr: TraceRecorder | None = None
+
+    def _rebuild_counters_from_journal(self) -> None:
+        """Seed the outcome counters from the durable journal at
+        startup. Only JOURNAL-derivable counters are rebuilt (terminal
+        states and admissions); event counters a restart cannot know
+        (preemptions, fenced slices, takeovers, byte totals) start at
+        zero, honestly."""
+        by_state = {
+            "done": "jobs_done", "failed": "jobs_failed",
+            "expired": "jobs_expired", "quarantined": "jobs_quarantined",
+        }
+        for entry in self.queue.jobs.values():
+            state = entry.get("state")
+            if state == "rejected":
+                if entry.get("shed"):
+                    self.counters["jobs_shed"] += 1
+                else:
+                    self.counters["jobs_rejected"] += 1
+                continue
+            # every non-rejected journal entry passed admission
+            self.counters["jobs_accepted"] += 1
+            key = by_state.get(state)
+            if key is not None:
+                self.counters[key] += 1
 
     # ------------------------------------------------------------ control
 
@@ -174,6 +264,26 @@ class ConsensusService:
                 "compile_hit_rate": round(self.worker.compile_hit_rate(), 3),
             }
         return snap
+
+    def _note_chunk_locked(self, interval_s: float) -> None:
+        """One observed inter-chunk-commit interval (caller holds the
+        lock): the auto-watchdog's notion of a normal chunk."""
+        self._chunk_durs.append(round(interval_s, 4))
+        del self._chunk_durs[:-_CHUNK_SAMPLES_KEPT]
+
+    def _watchdog_threshold(self) -> float | None:
+        """The effective stall threshold: the explicit setting, or —
+        in auto mode — WATCHDOG_P95_MULT x the observed chunk-commit
+        p95 (floored at WATCHDOG_MIN_S) once enough samples exist.
+        None = the watchdog must not fire (disabled, or auto mode still
+        calibrating)."""
+        if self.watchdog_s is not None:
+            return self.watchdog_s if self.watchdog_s > 0 else None
+        with self._lock:
+            if len(self._chunk_durs) < WATCHDOG_MIN_SAMPLES:
+                return None
+            vals = sorted(self._chunk_durs)
+        return max(WATCHDOG_MIN_S, WATCHDOG_P95_MULT * _pctl(vals, 0.95))
 
     def _note_latency_locked(self, priority: int, kind: str, value_s: float) -> None:
         samples = self._lat.setdefault(
@@ -298,6 +408,8 @@ class ConsensusService:
         tr = None
         hooked = False
         hb = None
+        wd_stop = threading.Event()
+        wd = None
         try:
             if self.trace_path:
                 tr = TraceRecorder(self.trace_path, kind="service")
@@ -311,6 +423,14 @@ class ConsensusService:
             if self.heartbeat_s and self.heartbeat_s > 0:
                 hb = Heartbeat(self.heartbeat_s, self._beat_stats, recorder=tr)
                 hb.start()
+            # the stuck-run watchdog: independent of the workers (a
+            # wedged slice freezes them) and of the heartbeat (which
+            # keeps renewing the very lease a wedged run hides behind)
+            wd = threading.Thread(
+                target=self._watchdog_loop, args=(wd_stop,),
+                name="dut-watchdog", daemon=True,
+            )
+            wd.start()
             # startup sweeps: staging files orphaned by dead daemons
             # (crash litter — their pid-suffixed tmps are never reused)
             # and jobs the journal says are running under a dead
@@ -338,6 +458,9 @@ class ConsensusService:
                 if self._fatal is None:
                     self._fatal = e
         finally:
+            wd_stop.set()
+            if wd is not None and wd.is_alive():
+                wd.join(timeout=2.0)
             if hb is not None:
                 hb.stop()
             snap = self._beat_stats()
@@ -413,8 +536,9 @@ class ConsensusService:
             ),
             "lease reclaim sweep",
         )
-        if reclaimed:
-            self.counters["jobs_recovered"] += len(reclaimed)
+        requeued = [r for r in reclaimed if not r.get("quarantined")]
+        if requeued:
+            self.counters["jobs_recovered"] += len(requeued)
         for r in reclaimed:
             if tr is not None:
                 lane = f"job-{r['job_id']}"
@@ -424,11 +548,109 @@ class ConsensusService:
                     prev_owner=str(r["prev_owner"])[:80],
                     by=self.daemon_id,
                 )
-                tr.event(
-                    "resume", job=r["job_id"], lane=lane,
-                    decision="requeued_running",
-                )
+                if not r.get("quarantined"):
+                    tr.event(
+                        "resume", job=r["job_id"], lane=lane,
+                        decision="requeued_running",
+                    )
+        # a reclaim that crossed max_crashes went to quarantine, not
+        # back to the queue: count + record it
+        self._note_reclaim_quarantines_locked(reclaimed)
         return reclaimed
+
+    def _expire_deadlines_locked(self) -> list[dict]:
+        """One deadline sweep (caller holds the lock): journal every
+        queued job whose monotonic deadline has passed as terminal
+        ``expired`` with a durable reason. Rides fault site
+        ``serve.deadline`` every pass (like the takeover sweep), so
+        chaos schedules can target the deadline step even when nothing
+        expires."""
+        tr = self._tr
+        expired = _io_retry(
+            "serve.deadline",
+            self.queue.expire_deadlines,
+            "deadline sweep",
+        )
+        if expired:
+            self.counters["jobs_expired"] += len(expired)
+        for r in expired:
+            if tr is not None:
+                tr.event(
+                    "job_expired", job=r["job_id"],
+                    lane=f"job-{r['job_id']}", reason=r["reason"][:200],
+                )
+        return expired
+
+    def _note_reclaim_quarantines_locked(self, reclaimed: list[dict]) -> int:
+        """Shared bookkeeping for takeover/watchdog reclaims whose
+        crash count crossed the quarantine bound: counter + event per
+        quarantined job. CALLER HOLDS the service lock (the recorder
+        has its own lock and never takes this one, so recording under
+        it cannot invert an ordering). Returns how many of
+        ``reclaimed`` were quarantined (the rest were requeued)."""
+        tr = self._tr
+        n = 0
+        for r in reclaimed:
+            if not r.get("quarantined"):
+                continue
+            n += 1
+            self.counters["jobs_quarantined"] += 1
+            if tr is not None:
+                tr.event(
+                    "job_quarantined", job=r["job_id"],
+                    lane=f"job-{r['job_id']}", reason=r["reason"],
+                    crash_count=r.get("crash_count", 0),
+                    prev_owner=str(r.get("prev_owner"))[:80],
+                )
+        return n
+
+    def _watchdog_sweep(self) -> list[dict]:
+        """One stuck-run scan: abort-requeue every running job with no
+        durable progress for the stall threshold (the lease/fence path
+        does the fencing — a wedged slice that wakes later is fenced at
+        its first commit). Rides fault site ``serve.watchdog`` on every
+        tick, reclaim or not, so chaos can target the watchdog step."""
+        tr = self._tr
+        threshold = self._watchdog_threshold()
+        reclaimed = _io_retry(
+            "serve.watchdog",
+            lambda: self.queue.reclaim_stalled(threshold),
+            "watchdog stall scan",
+        )
+        for r in reclaimed:
+            if tr is not None:
+                tr.event(
+                    "watchdog_fired", job=r["job_id"],
+                    lane=f"job-{r['job_id']}",
+                    stalled_s=r.get("stalled_s"),
+                    threshold_s=round(threshold, 3),
+                    prev_owner=str(r.get("prev_owner"))[:80],
+                )
+        if reclaimed:
+            with self._lock:
+                self.counters["watchdog_fired"] += len(reclaimed)
+                self._note_reclaim_quarantines_locked(reclaimed)
+        return reclaimed
+
+    def _watchdog_loop(self, stop: threading.Event) -> None:
+        """The per-daemon watchdog thread. A separate thread on
+        purpose: with every worker wedged inside a stuck slice the
+        scheduler loop never runs again, so only an independent thread
+        can notice that durable progress stopped while the heartbeat
+        kept the lease alive."""
+        while not stop.wait(0.25):
+            try:
+                self._watchdog_sweep()
+            except OSError:
+                continue  # beyond retries: observe again next tick
+            except BaseException as e:  # noqa: BLE001 — modelled kill
+                # same contract as the heartbeat thread: an injected
+                # kill on the watchdog takes the daemon down whole
+                with self._lock:
+                    if self._fatal is None:
+                        self._fatal = e
+                self._drain.set()
+                raise
 
     def _idle_done(self, once: bool) -> bool:
         if not once:
@@ -456,7 +678,12 @@ class ConsensusService:
                 with self._lock:
                     self._accept_pending_locked()
                     self._reclaim_locked()
-                    job_id = self.sched.pick(self.queue.jobs)
+                    self._expire_deadlines_locked()
+                    # deadline-aware pick: never claim a job the sweep
+                    # (or another daemon's sweep) is about to expire
+                    job_id = self.sched.pick(
+                        self.queue.jobs, now=time.monotonic()
+                    )
                     if job_id is not None:
                         # the pick is advisory until the CLAIM commits:
                         # the flock'd transaction re-checks the state,
@@ -534,23 +761,34 @@ class ConsensusService:
                 return self.sched.others_waiting(self.queue.jobs, job_id)
 
         on_first_chunk = None
-        if first_slice:
-            with self._lock:
-                entry = self.queue.jobs.get(job_id, {})
-                admitted_m = entry.get("admitted_m")
-                priority = entry.get("priority", 1)
-            if admitted_m is not None:
+        with self._lock:
+            entry = self.queue.jobs.get(job_id, {})
+            admitted_m = entry.get("admitted_m")
+            priority = entry.get("priority", 1)
+            deadline_m = entry.get("deadline_m")
+        if first_slice and admitted_m is not None:
 
-                def on_first_chunk():
-                    with self._lock:
-                        self._note_latency_locked(
-                            priority, "ttfc",
-                            time.monotonic() - admitted_m,
-                        )
+            def on_first_chunk():
+                with self._lock:
+                    self._note_latency_locked(
+                        priority, "ttfc",
+                        time.monotonic() - admitted_m,
+                    )
+
+        # chunk-cadence sampling: inter-commit intervals feed the
+        # auto-watchdog threshold (what a "normal" chunk costs here)
+        last_commit = [time.monotonic()]
+
+        def on_chunk():
+            now = time.monotonic()
+            with self._lock:
+                self._note_chunk_locked(now - last_commit[0])
+            last_commit[0] = now
 
         lease = LeaseContext(
             queue=self.queue, daemon_id=self.daemon_id, token=token,
             lease_s=self.lease_s, on_first_chunk=on_first_chunk,
+            on_chunk=on_chunk, deadline_m=deadline_m,
         )
         t0 = time.monotonic()
         try:
@@ -561,7 +799,39 @@ class ConsensusService:
         except JobFenced as e:
             self._fenced(job_id, lane, str(e))
             return
+        except JobDeadlineExceeded as e:
+            # deadline abort at a chunk boundary: terminal `expired`
+            # with a durable reason; the committed checkpoint prefix is
+            # preserved byte-for-byte for a future re-submission. The
+            # fenced transition rides fault site serve.deadline, like
+            # the queued-side sweep.
+            try:
+                _io_retry(
+                    "serve.deadline",
+                    lambda: self.queue.mark_expired(
+                        job_id, str(e), self.daemon_id, token
+                    ),
+                    f"job {job_id} deadline expiry",
+                )
+            except JobFenced as f:
+                self._fenced(job_id, lane, str(f))
+                return
+            with self._lock:
+                self.counters["jobs_expired"] += 1
+            if tr is not None:
+                tr.event("job_expired", job=job_id, lane=lane,
+                         reason=str(e)[:200],
+                         chunks_done=e.chunks_done)
+            return
         except Exception as e:  # noqa: BLE001 — job-scoped failure
+            enospc = isinstance(e, OSError) and e.errno == errno.ENOSPC
+            if enospc:
+                # disk-pressure degradation: before journaling the
+                # failure (itself a durable write that needs space),
+                # drop terminal jobs' shard/checkpoint litter. The
+                # victim fails cleanly with a durable reason; the
+                # daemon — and every other job — lives on.
+                self.queue.gc_terminal_litter()
             try:
                 with self._lock:
                     self.queue.mark_failed(
@@ -575,7 +845,7 @@ class ConsensusService:
                 return
             if tr is not None:
                 tr.event("job_failed", job=job_id, lane=lane,
-                         error=repr(e)[:200])
+                         error=repr(e)[:200], enospc=enospc)
             return
         wall = round(time.monotonic() - t0, 3)
         if out[0] == "done":

@@ -25,6 +25,7 @@ import dataclasses
 import json
 import os
 import threading
+import time
 
 from duplexumiconsensusreads_tpu.runtime import faults
 from duplexumiconsensusreads_tpu.serve.job import (
@@ -47,6 +48,23 @@ class JobPreempted(Exception):
         self.reason = reason
 
 
+class JobDeadlineExceeded(Exception):
+    """A slice crossed its job's monotonic deadline and aborted at the
+    next checkpoint boundary — the same yield point preemption uses, so
+    every committed chunk stays durable and byte-identical: a
+    re-submitted job RESUMES the checkpoint, it never splices. The
+    service journals the job terminal ``expired`` with this message."""
+
+    def __init__(self, chunks_done: int, overdue_s: float):
+        super().__init__(
+            f"expired: deadline passed {overdue_s:.3f}s ago; slice "
+            f"aborted at the chunk boundary after {chunks_done} committed "
+            f"chunks (checkpoint preserved for resume)"
+        )
+        self.chunks_done = chunks_done
+        self.overdue_s = overdue_s
+
+
 @dataclasses.dataclass
 class LeaseContext:
     """The slice's fleet identity: which lease it runs under and how to
@@ -58,13 +76,20 @@ class LeaseContext:
     via :class:`~..serve.queue.JobFenced` before splicing a byte.
     ``on_first_chunk`` (optional) fires once, right after the job's
     first fresh chunk of its first slice is durable — the service's
-    time-to-first-chunk sample."""
+    time-to-first-chunk sample. ``on_chunk`` (optional) fires on EVERY
+    chunk commit — the service's chunk-cadence sample, which derives
+    the watchdog's default stall threshold. ``deadline_m`` is the
+    job's admission-stamped monotonic expiry (None = no deadline): the
+    commit path checks it right after each chunk's mark is durable and
+    aborts the slice with :class:`JobDeadlineExceeded` when passed."""
 
     queue: SpoolQueue
     daemon_id: str
     token: int
     lease_s: float = LEASE_DEFAULT_S
     on_first_chunk: object = None
+    on_chunk: object = None
+    deadline_m: float | None = None
 
 
 def _ckpt_done_count(out_path: str) -> int:
@@ -205,12 +230,22 @@ class WarmWorker:
             slice_bytes["d2h_bytes"] = _rep.bytes_d2h
             slice_bytes["reads"] = _rep.n_records
             fresh = commits[0] - n_resumed
+            if lease is not None and lease.on_chunk is not None:
+                lease.on_chunk()
             if (
                 fresh == 1
                 and lease is not None
                 and lease.on_first_chunk is not None
             ):
                 lease.on_first_chunk()
+            if lease is not None and lease.deadline_m is not None:
+                # deadline abort rides the preemption contract: this
+                # chunk's mark is already durable, nothing later is —
+                # the strongest point to stop without wasting the
+                # prefix or splicing a byte
+                overdue = time.monotonic() - lease.deadline_m
+                if overdue >= 0:
+                    raise JobDeadlineExceeded(commits[0], overdue)
             if drain_event.is_set():
                 raise JobPreempted(commits[0], "drain")
             if budget > 0 and fresh >= budget and should_yield():
@@ -247,6 +282,12 @@ class WarmWorker:
             with self._lock:
                 self._warm_specs.add(spec_signature(spec))
             return ("preempted", p.chunks_done, p.reason, dict(slice_bytes))
+        except JobDeadlineExceeded:
+            # same warm logic: the slice ran real chunks before the
+            # deadline abort; the service owns the terminal transition
+            with self._lock:
+                self._warm_specs.add(spec_signature(spec))
+            raise
         finally:
             if plan is not None:
                 faults.install(prev_plan)

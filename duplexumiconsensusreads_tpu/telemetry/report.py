@@ -211,6 +211,10 @@ _JOB_EVENTS = (
     "job_accepted", "job_rejected", "job_shed", "job_started",
     "job_preempted", "job_completed", "job_failed",
     "lease_takeover", "job_fenced",
+    # defensive serving: deadline expiries, poison quarantines and
+    # watchdog aborts are per-job verdicts — anonymous ones cannot be
+    # decomposed, same contract as every other job event
+    "job_expired", "job_quarantined", "watchdog_fired",
 )
 
 

@@ -95,6 +95,11 @@ KNOWN_EVENTS = (
     # lease, and a zombie slice aborted by its stale fencing token
     "lease_takeover",  # running job reclaimed (attrs: reason, prev_owner)
     "job_fenced",  # slice lost its lease; committed nothing, not a failure
+    # defensive serving (deadlines / watchdog / quarantine): all
+    # job-scoped — they ride job-<id> lanes like every job_* event
+    "job_expired",  # deadline passed: terminal, durable reason
+    "job_quarantined",  # crash_count hit max_crashes: terminal + diagnosis
+    "watchdog_fired",  # no durable progress for watchdog_s: abort-requeue
 )
 
 # Byte-ledger directions (the third record kind, ``xfer`` — see

@@ -435,6 +435,51 @@ def test_kill_mid_out_of_order_drain_then_resume_converges(
     assert not os.path.exists(out + ".ckpt")
 
 
+def test_enospc_fails_only_the_victim_job_service_survives(
+    sim, serve_ref, tmp_path
+):
+    """Disk-pressure acceptance: a real ENOSPC surfacing from a durable
+    write inside one job (here: every retry of its first shard write)
+    must fail THAT job cleanly — durable reason in results/, daemon
+    alive — while every other job completes byte-identical. The repo's
+    pre-defensive behaviour on persistent write errors was a daemon
+    that either died or retried forever; this pins the degradation
+    contract instead."""
+    from duplexumiconsensusreads_tpu.serve import ConsensusService, client
+
+    path, _ = sim
+    spool = str(tmp_path / "spool")
+    config = dict(
+        grouping="adjacency", mode="duplex",
+        capacity=KW["capacity"], chunk_reads=KW["chunk_reads"],
+    )
+    # one past the host-I/O retry budget, so the ladder really
+    # exhausts and surfaces ENOSPC instead of absorbing it; a single
+    # drain worker keeps every hit on ONE chunk's ladder (two workers
+    # would interleave hit counts across chunks and could let both
+    # ladders squeak through)
+    schedule = ",".join(f"shard.write:{n}:enospc" for n in range(1, 6))
+    victim = client.submit(
+        spool, path, str(tmp_path / "victim.bam"),
+        config={**config, "drain_workers": 1}, chaos=schedule,
+    )
+    healthy = client.submit(
+        spool, path, str(tmp_path / "healthy.bam"), config=config
+    )
+    svc = ConsensusService(spool, chunk_budget=0)
+    snap = svc.run_until_idle()  # must return, not raise: daemon alive
+    assert snap["jobs_failed"] == 1 and snap["jobs_done"] == 1
+    st = client.status(spool, victim)
+    assert st["state"] == "failed"
+    assert "enospc" in st["error"].lower()
+    # the reason is durable beyond the journal: the results/ file holds it
+    with open(os.path.join(spool, "results", victim + ".json")) as f:
+        assert "enospc" in json.load(f)["error"].lower()
+    assert not os.path.exists(str(tmp_path / "victim.bam"))
+    with open(str(tmp_path / "healthy.bam"), "rb") as f:
+        assert f.read() == serve_ref
+
+
 def test_ingest_retry_is_bounded(sim, tmp_path):
     """More consecutive transient failures than the retry budget at one
     site must surface the error, not loop forever."""

@@ -44,7 +44,7 @@ class TestEngine:
         assert {
             "clock-discipline", "durability-protocol", "fault-registry",
             "phase-registry", "lock-discipline", "hook-guard",
-            "lease-discipline",
+            "lease-discipline", "deadline-discipline",
         } <= set(RULES)
         for rule in RULES.values():
             assert rule.title
@@ -625,6 +625,109 @@ class TestLeaseDiscipline:
             return entry["lease"]["owner"] == "d" and entry["token"] == token
         """})
         assert res.ok  # reads fence; only WRITES must persist
+
+
+class TestDeadlineDiscipline:
+    QUEUE_OK = """
+        import time
+        JOB_STATES = ("queued", "running", "done", "expired")
+        class Q:
+            def stamp(self, entry, deadline_s):
+                entry["deadline_m"] = time.monotonic() + deadline_s
+            def expire(self, entry):
+                entry["state"] = "expired"
+        """
+    TESTS_OK = """
+        def test_states():
+            run("queued"); run("running"); run("done"); run("expired")
+        """
+
+    def base(self, **over):
+        files = {
+            "pkg/serve/queue.py": self.QUEUE_OK,
+            "tests/test_serve.py": self.TESTS_OK,
+        }
+        files.update(over)
+        return lint(files, rules=["deadline-discipline"])
+
+    def test_passes_when_consistent(self):
+        assert self.base().ok
+
+    def test_fires_on_unsuffixed_stamp_key(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def note(entry, t):
+                entry["deadline"] = t
+            """})
+        assert rules_of(res) == [("deadline-discipline", "pkg/serve/svc.py")]
+        assert "'deadline'" in res.findings[0].message
+        assert "_m" in res.findings[0].hint
+
+    def test_duration_suffix_is_legal(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def note(cfg):
+                return cfg.get("deadline_s", 0)
+            """})
+        assert res.ok
+
+    def test_fires_on_wall_clock_stamp(self):
+        # a *_m key fed from anything but time.monotonic() in-scope
+        res = self.base(**{"pkg/serve/svc.py": """
+            def note(entry, wall):
+                entry["expires_m"] = wall + 30
+            """})
+        assert rules_of(res) == [("deadline-discipline", "pkg/serve/svc.py")]
+        assert "monotonic" in res.findings[0].message
+
+    def test_fires_on_unregistered_state_literal(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def zombify(entry):
+                entry["state"] = "zombified"
+            """})
+        assert rules_of(res) == [("deadline-discipline", "pkg/serve/svc.py")]
+        assert "zombified" in res.findings[0].message
+
+    def test_dict_literal_into_jobs_is_a_state_write(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def admit(self, jid):
+                self.jobs[jid] = {"state": "limbo", "seq": 0}
+            """})
+        assert [f.rule for f in res.findings] == ["deadline-discipline"]
+        assert "limbo" in res.findings[0].message
+
+    def test_temporary_dict_state_write_is_seen(self):
+        # the accept_one pattern: entry built as a temporary, THEN
+        # journaled — the state literal must not escape the registry
+        res = self.base(**{"pkg/serve/svc.py": """
+            def admit(self, jid):
+                entry = {"state": "zombified", "seq": 0}
+                self.jobs[jid] = entry
+            """})
+        assert [f.rule for f in res.findings] == ["deadline-discipline"]
+        assert "zombified" in res.findings[0].message
+
+    def test_fires_on_unexercised_registered_state(self):
+        res = self.base(**{"tests/test_serve.py": """
+            def test_states():
+                run("queued"); run("running"); run("done")
+            """})
+        assert [f.rule for f in res.findings] == ["deadline-discipline"]
+        assert "expired" in res.findings[0].message
+        assert res.findings[0].path == "tests/test_serve.py"
+
+    def test_missing_serving_suite_skips_exercise_check(self):
+        assert lint(
+            {"pkg/serve/queue.py": self.QUEUE_OK},
+            rules=["deadline-discipline"],
+        ).ok
+
+    def test_read_side_pseudo_states_are_out_of_scope(self):
+        # status rendering returns client-visible pseudo-states that are
+        # not journal writes — the rule must not chase them
+        res = self.base(**{"pkg/serve/svc.py": """
+            def status(jid):
+                return {"job_id": jid, "state": "submitted"}
+            """})
+        assert res.ok
 
 
 class TestHookGuard:

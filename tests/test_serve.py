@@ -62,6 +62,7 @@ CP = ConsensusParams(mode="duplex")
 SERVE_SITES = (
     "serve.accept", "serve.journal", "serve.preempt",
     "serve.lease", "serve.renew", "serve.expire", "serve.fence",
+    "serve.deadline", "serve.watchdog",
 )
 FLEET_SITES = ("serve.lease", "serve.renew", "serve.expire", "serve.fence")
 
@@ -728,6 +729,7 @@ class TestFleet:
         ("serve.renew", 1),   # dies at the first commit's renewal
         ("serve.fence", 2),   # dies at a later commit's fence check
         ("serve.expire", 1),  # dies in the startup takeover sweep
+        ("serve.deadline", 1),  # dies in the first deadline sweep
     ])
     def test_kill_at_fleet_site_then_restart_exactly_once(
         self, site, nth, sim, tmp_path
@@ -741,6 +743,39 @@ class TestFleet:
         faults.install(faults.FaultPlan.parse(f"{site}:{nth}:kill"))
         with pytest.raises(faults.InjectedKill):
             ConsensusService(spool, chunk_budget=1).run_until_idle()
+        faults.uninstall()
+        t2 = str(tmp_path / "svc2.jsonl")
+        snap = ConsensusService(spool, trace_path=t2).run_until_idle()
+        assert snap["jobs_done"] == 1 and snap["jobs_failed"] == 0
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        _, ev = _events(t2)
+        assert len([e for e in ev if e["name"] == "job_completed"]) == 1
+
+    def test_kill_on_watchdog_thread_takes_daemon_down_then_restart(
+        self, sim, tmp_path
+    ):
+        """serve.watchdog's kill coverage: an injected kill on the
+        watchdog thread's scan must take the DAEMON down whole (the
+        heartbeat-thread contract), leaving durable state a restart
+        completes exactly once. The slice is slowed so the run is
+        guaranteed to span the watchdog's first tick."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        faults.install(faults.FaultPlan.parse("serve.watchdog:1:kill"))
+        svc = ConsensusService(spool, chunk_budget=0, poll_s=0.05)
+        orig = svc.worker.run_slice
+
+        def slow_run_slice(spec, budget, should_yield, drain_event,
+                           lease=None):
+            time.sleep(0.6)  # outlive the watchdog's first 0.25s tick
+            return orig(spec, budget, should_yield, drain_event,
+                        lease=lease)
+
+        svc.worker.run_slice = slow_run_slice
+        with pytest.raises(faults.InjectedKill):
+            svc.run_until_idle()
         faults.uninstall()
         t2 = str(tmp_path / "svc2.jsonl")
         snap = ConsensusService(spool, trace_path=t2).run_until_idle()
@@ -893,6 +928,580 @@ class TestFleet:
         assert rep["n_takeovers"] == 1 and rep["n_done"] == 1
         assert rep["jobs"][jid]["takeovers"] == 1
         assert rep["jobs"][jid]["takeover_reason"] == "dead-owner"
+
+
+class TestDeadlines:
+    """Job deadlines: admission stamps a monotonic expiry, the
+    scheduler refuses expired picks, the sweep journals overdue queued
+    jobs terminal `expired` with a durable reason, and a running slice
+    aborts at its next checkpoint boundary preserving the committed
+    prefix byte-for-byte."""
+
+    def test_deadline_stamped_monotonic_and_swept(self, tmp_path):
+        q = SpoolQueue(str(tmp_path))
+        jid = client.submit(str(tmp_path), __file__,
+                            str(tmp_path / "o.bam"),
+                            config=dict(CONFIG), deadline_s=60.0)
+        assert q.accept_one(jid)[0] is not None
+        e = q.jobs[jid]
+        assert e["deadline_m"] == pytest.approx(
+            time.monotonic() + 60.0, abs=2.0
+        )
+        assert q.expire_deadlines() == []  # not due yet
+        # deadline-aware pick: refused once past, claimable before
+        assert FairScheduler.pick(q.jobs, now=e["deadline_m"] + 1) is None
+        assert FairScheduler.pick(q.jobs, now=e["deadline_m"] - 1) == jid
+        assert FairScheduler.pick(q.jobs) == jid  # no-now callers unchanged
+        # force it overdue; the sweep journals terminal expired durably
+        with q._txn():
+            q.jobs[jid]["deadline_m"] = round(time.monotonic() - 1, 3)
+            q.save()
+        exp = q.expire_deadlines()
+        assert [r["job_id"] for r in exp] == [jid]
+        st = SpoolQueue(str(tmp_path)).status(jid)  # fresh load: durable
+        assert st["state"] == "expired"
+        assert st["result"]["expired"] is True
+        assert "deadline passed" in st["error"]
+
+    def test_daemon_default_deadline_applies_at_admission(self, tmp_path):
+        q = SpoolQueue(str(tmp_path), default_deadline_s=30.0)
+        jid = client.submit(str(tmp_path), __file__,
+                            str(tmp_path / "a.bam"), config=dict(CONFIG))
+        q.accept_one(jid)
+        assert q.jobs[jid]["deadline_m"] == pytest.approx(
+            time.monotonic() + 30.0, abs=2.0
+        )
+        # a job's own deadline wins over the daemon default
+        jid2 = client.submit(str(tmp_path), __file__,
+                             str(tmp_path / "b.bam"),
+                             config=dict(CONFIG), deadline_s=300.0)
+        q.accept_one(jid2)
+        assert q.jobs[jid2]["deadline_m"] == pytest.approx(
+            time.monotonic() + 300.0, abs=2.0
+        )
+
+    def test_rejects_bad_deadline(self):
+        for bad in (0, -1, True, "soon"):
+            with pytest.raises(ValueError, match="deadline_s"):
+                validate_spec(_spec(deadline_s=bad))
+
+    def test_overdue_queued_job_expires_before_running(self, sim, tmp_path):
+        """A deadline that passes while the job waits in the queue:
+        the sweep journals it terminal expired — it is never claimed,
+        never started, and the client learns why."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        trace = str(tmp_path / "svc.jsonl")
+        # job A (no deadline) runs first — same priority, lower seq, so
+        # the single worker always claims it in the admission pass —
+        # and job B's 1ms deadline lapses while A holds the device
+        # (ANY A runtime exceeds it, warm runs included): by the next
+        # scheduler pass B is overdue and must be swept, never claimed
+        jid_a, out_a = _submit_n(spool, in_path, tmp_path, 1, prefix="a")[0]
+        jid_b = client.submit(spool, in_path, str(tmp_path / "b.bam"),
+                              config=dict(CONFIG), deadline_s=0.001)
+        svc = ConsensusService(spool, chunk_budget=0, trace_path=trace)
+        snap = svc.run_until_idle()
+        assert snap["jobs_done"] == 1 and snap["jobs_expired"] == 1
+        st = client.status(spool, jid_b)
+        assert st["state"] == "expired"
+        assert "before the job could run" in st["error"]
+        assert st["result"]["expired"] is True
+        assert not os.path.exists(str(tmp_path / "b.bam"))
+        with open(out_a, "rb") as f:
+            assert f.read() == ref_bytes
+        _, ev = _events(trace)
+        assert [e["job"] for e in ev if e["name"] == "job_expired"] == [jid_b]
+        assert all(
+            e["job"] != jid_b for e in ev if e["name"] == "job_started"
+        )
+        # expired is terminal: --wait returns immediately, not forever
+        assert client.wait(spool, jid_b, timeout_s=5)["state"] == "expired"
+
+    def test_running_job_aborts_at_chunk_boundary_and_resume_skips(
+        self, sim, tmp_path
+    ):
+        """A running slice whose deadline passes aborts at the NEXT
+        checkpoint boundary (the commit path's deadline check), the
+        job journals terminal expired, and the committed chunk prefix
+        survives byte-identical — a re-submitted job RESUMES it (the
+        manifest verifies every shard), it never splices or recomputes
+        the prefix."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        t1 = str(tmp_path / "svc1.jsonl")
+        svc = ConsensusService(spool, chunk_budget=0, trace_path=t1)
+        orig = svc.worker.run_slice
+
+        def expiring_run_slice(spec, budget, should_yield, drain_event,
+                               lease=None):
+            # deadline already passed when the slice starts: the first
+            # chunk commits (mark durable), then the boundary check
+            # aborts — deterministic, no timing games
+            lease.deadline_m = time.monotonic()
+            return orig(spec, budget, should_yield, drain_event,
+                        lease=lease)
+
+        svc.worker.run_slice = expiring_run_slice
+        snap = svc.run_until_idle()
+        assert snap["jobs_expired"] == 1 and snap["jobs_done"] == 0
+        assert snap["jobs_failed"] == 0  # expiry is a verdict, not a crash
+        st = client.status(spool, jid)
+        assert st["state"] == "expired"
+        assert "checkpoint preserved" in st["error"]
+        assert not os.path.exists(out)  # never finalised
+        # the committed prefix is preserved for a future resume
+        assert os.path.exists(out + ".ckpt")
+        with open(out + ".ckpt") as f:
+            n_committed = len(json.load(f)["done"])
+        assert n_committed >= 1
+        _, ev = _events(t1)
+        exp = [e for e in ev if e["name"] == "job_expired"]
+        assert len(exp) == 1 and exp[0]["chunks_done"] == n_committed
+        # re-submission resumes the preserved checkpoint
+        jid2 = client.submit(spool, in_path, out, config=dict(CONFIG))
+        snap2 = ConsensusService(spool, chunk_budget=0).run_until_idle()
+        assert snap2["jobs_done"] == 1
+        st2 = client.status(spool, jid2)
+        assert st2["result"]["n_chunks_skipped"] >= n_committed
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+
+
+class TestWatchdog:
+    """The stuck-run watchdog: a running job whose current chunk makes
+    no durable progress for watchdog_s is abort-requeued through the
+    lease/fence path — the one hang lease expiry cannot see, because a
+    wedged device step keeps the heartbeat renewing the lease."""
+
+    def test_reclaim_stalled_requeues_and_counts_crash(self, tmp_path):
+        q = SpoolQueue(str(tmp_path))
+        jid = client.submit(str(tmp_path), __file__,
+                            str(tmp_path / "o.bam"), config=dict(CONFIG))
+        q.accept_one(jid)
+        token = q.claim(jid, "d1", lease_s=3600.0)
+        assert q.reclaim_stalled(None) == []  # disabled: never fires
+        assert q.reclaim_stalled(60.0) == []  # fresh progress: healthy
+        with q._txn():
+            q.jobs[jid]["progress_m"] = round(time.monotonic() - 10, 3)
+            q.save()
+        rec = q.reclaim_stalled(5.0)
+        assert len(rec) == 1 and rec[0]["reason"] == "stalled"
+        assert rec[0]["stalled_s"] > 5.0
+        assert rec[0]["crash_count"] == 1 and "quarantined" not in rec[0]
+        e = q.jobs[jid]
+        assert e["state"] == "queued" and "lease" not in e
+        assert e["crash_count"] == 1 and e["token"] == token
+        # the next claim bumps the token: the wedged holder is fenced
+        token2 = q.claim(jid, "d2", lease_s=3600.0)
+        assert token2 == token + 1
+        with pytest.raises(JobFenced):
+            q.verify_lease(jid, "d1", token)
+
+    def test_wedged_slice_is_watchdog_requeued_and_finished_elsewhere(
+        self, sim, tmp_path
+    ):
+        """In-process acceptance: daemon A's slice wedges mid-chunk
+        (lease renewed by commits until the wedge, then nothing), A's
+        own watchdog abort-requeues the job, daemon B completes it
+        byte-identical, and A's wedged slice — woken later — is fenced
+        before it can commit a byte."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        t_a = str(tmp_path / "svcA.jsonl")
+        svc_a = ConsensusService(
+            spool, chunk_budget=1, trace_path=t_a, poll_s=0.05,
+            lease_s=3600.0,  # expiry can NEVER explain the takeover
+            # well above a healthy warm chunk's commit cadence (the
+            # fixture already compiled in this process), well below the
+            # test's patience: only the wedge can trip it
+            watchdog_s=1.5, daemon_id="wd-A",
+        )
+        wedged = threading.Event()
+        resume = threading.Event()
+        orig = svc_a.worker.run_slice
+
+        def wedging_run_slice(spec, budget, should_yield, drain_event,
+                              lease=None):
+            # the budget check consults should_yield right after the
+            # first fresh chunk commit: a deterministic wedge point
+            # with the lease held and durable progress stopped
+            def wedge_then_no_yield():
+                wedged.set()
+                resume.wait(timeout=120)
+                return False
+
+            return orig(spec, 1, wedge_then_no_yield, drain_event,
+                        lease=lease)
+
+        svc_a.worker.run_slice = wedging_run_slice
+        box = {}
+        th = threading.Thread(
+            target=lambda: box.setdefault("snap", svc_a.run_until_idle()),
+            daemon=True,
+        )
+        th.start()
+        assert wedged.wait(timeout=120), "daemon A never wedged"
+        # the watchdog must requeue the stalled job while A's worker is
+        # still wedged inside it
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            entry = SpoolQueue(spool).jobs.get(jid, {})
+            if entry.get("state") == "queued":
+                break
+            time.sleep(0.05)
+        assert entry.get("state") == "queued", "watchdog never fired"
+        assert entry.get("crash_count") == 1
+        # daemon B finishes the job (fresh claim bumps the token)
+        t_b = str(tmp_path / "svcB.jsonl")
+        snap_b = ConsensusService(
+            spool, trace_path=t_b, poll_s=0.05, watchdog_s=0,
+            daemon_id="wd-B",
+        ).run_until_idle()
+        assert snap_b["jobs_done"] == 1
+        # wake the wedged slice: its next commit must fence
+        resume.set()
+        th.join(timeout=120)
+        assert not th.is_alive() and "snap" in box
+        snap_a = box["snap"]
+        assert snap_a["watchdog_fired"] == 1
+        assert snap_a["jobs_fenced"] == 1 and snap_a["jobs_done"] == 0
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        entry = SpoolQueue(spool).jobs[jid]
+        assert entry["state"] == "done" and entry["token"] == 2
+        _, ev_a = _events(t_a)
+        wd = [e for e in ev_a if e["name"] == "watchdog_fired"]
+        assert len(wd) == 1 and wd[0]["job"] == jid
+        assert wd[0]["stalled_s"] > 1.5
+        assert any(e["name"] == "job_fenced" for e in ev_a)
+        _, ev_b = _events(t_b)
+        done = [e for e in ev_a + ev_b if e["name"] == "job_completed"]
+        assert len(done) == 1  # exactly once, by B
+
+    def test_sigstopped_worker_subprocess_is_watchdog_requeued(
+        self, sim, tmp_path
+    ):
+        """The real thing: daemon A (subprocess) claims the job and is
+        SIGSTOPped mid-slice — its pid stays alive and its lease
+        (3600s) never expires, so ONLY the watchdog path can free the
+        job. Daemon B runs with an explicit --watchdog and must
+        requeue + complete it byte-identical; A is fenced off by the
+        token bump whenever it wakes."""
+        import fcntl
+
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jid, out = _submit_n(spool, in_path, tmp_path, 1)[0]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "duplexumiconsensusreads_tpu.serve.daemon",
+             spool, "--poll", "0.05", "--heartbeat", "0.2",
+             "--lease", "3600", "--watchdog", "0",
+             "--daemon-id", "stop-A"],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+
+        def flock_free(timeout_s=2.0):
+            # a STOPPED process keeps any flock it holds — make sure A
+            # was not frozen inside a journal transaction before we let
+            # B (which must take that lock) anywhere near the spool
+            fd = os.open(os.path.join(spool, "journal.lock"),
+                         os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                t_end = time.monotonic() + timeout_s
+                while time.monotonic() < t_end:
+                    try:
+                        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        fcntl.flock(fd, fcntl.LOCK_UN)
+                        return True
+                    except OSError:
+                        time.sleep(0.02)
+                return False
+            finally:
+                os.close(fd)
+
+        try:
+            deadline = time.monotonic() + 120
+            claimed = False
+            while time.monotonic() < deadline:
+                st = client.status(spool, jid)
+                if st.get("state") == "running" and st.get("lease"):
+                    claimed = True
+                    break
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            assert claimed, (
+                proc.communicate()[1] if proc.poll() is not None
+                else "job never claimed"
+            )
+            for _ in range(20):
+                proc.send_signal(signal.SIGSTOP)
+                if flock_free():
+                    break
+                proc.send_signal(signal.SIGCONT)  # frozen mid-txn: retry
+                time.sleep(0.05)
+            else:
+                pytest.fail("could not stop daemon A outside a journal txn")
+            # daemon B: lease is live (A renews nothing but 3600s runs),
+            # pid alive (stopped != dead) — only --watchdog frees the
+            # job. A generous threshold + a high crash bound keep B's
+            # own (cold-start) chunks from self-tripping the watchdog
+            # into a quarantine on a slow CI host.
+            p2 = subprocess.run(
+                [sys.executable, "-m",
+                 "duplexumiconsensusreads_tpu.serve.daemon",
+                 spool, "--once", "--poll", "0.05", "--heartbeat", "0",
+                 "--watchdog", "4.0", "--max-crashes", "50",
+                 "--daemon-id", "stop-B"],
+                env=env, cwd=REPO, capture_output=True, text=True,
+                timeout=300,
+            )
+            assert p2.returncode == 0, p2.stderr
+        finally:
+            if proc.poll() is None:
+                proc.kill()  # SIGKILL terminates a stopped process
+                proc.communicate()
+        st = client.status(spool, jid)
+        assert st["state"] == "done" and st["token"] >= 2
+        assert st["crash_count"] >= 1
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        recs, ev = _events(os.path.join(spool, "service.trace.jsonl"))
+        assert trace_report.validate_service_trace(recs) == []
+        wd = [e for e in ev if e["name"] == "watchdog_fired"]
+        assert len(wd) >= 1 and wd[0]["job"] == jid
+        assert len([e for e in ev if e["name"] == "job_completed"]) == 1
+
+
+class TestPoisonQuarantine:
+    """Poison-job quarantine: a job that deterministically kills its
+    worker must stop re-entering the queue after max_crashes unclean
+    aborts — journaled terminal `quarantined` with a durable diagnosis
+    bundle, exactly-once semantics intact, zero re-runs afterward."""
+
+    def test_poison_job_quarantined_after_exactly_max_crashes(
+        self, sim, tmp_path
+    ):
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        poison_out = str(tmp_path / "poison.bam")
+        poison_trace = str(tmp_path / "poison.trace.jsonl")
+        # the poison: an injected hard kill at its first shard write,
+        # every time any daemon runs it (per-job plans are per-daemon)
+        poison = client.submit(
+            spool, in_path, poison_out, config=dict(CONFIG),
+            chaos="shard.write:1:kill", trace=poison_trace,
+        )
+        healthy, healthy_out = _submit_n(
+            spool, in_path, tmp_path, 1, prefix="healthy"
+        )[0]
+        deaths = 0
+        final_snap = None
+        final_trace = None
+        for i in range(8):  # bounded: must converge well before this
+            # daemons that will RUN the poison slice get no service
+            # capture: the job's own trace recorder must be the global
+            # hook while its slice runs, so the injected fault lands in
+            # the JOB capture — which is what the quarantine diagnosis
+            # bundle tails
+            t = str(tmp_path / f"svc{i}.jsonl") if deaths >= 3 else None
+            svc = ConsensusService(
+                spool, chunk_budget=0, poll_s=0.05, trace_path=t,
+                daemon_id=f"pd-{i}",
+            )
+            try:
+                final_snap = svc.run_until_idle()
+                final_trace = t
+                break
+            except faults.InjectedKill:
+                deaths += 1  # the poison killed this daemon; next picks up
+        else:
+            pytest.fail("fleet never converged past the poison job")
+        # exactly max_crashes (default 3) daemons died to the poison
+        assert deaths == 3
+        st = client.status(spool, poison)
+        assert st["state"] == "quarantined"
+        assert "quarantined after 3 crashed runs" in st["error"]
+        # the diagnosis bundle is durable and names the poison
+        diag = st["result"]["diagnosis"]
+        assert diag["crash_count"] == 3 and diag["max_crashes"] == 3
+        assert diag["last_abort"] == "dead-owner"
+        assert len(diag["lease_history"]) == 3
+        assert [h["owner"] for h in diag["lease_history"]] == [
+            "pd-0", "pd-1", "pd-2"
+        ]
+        assert diag["last_fault_site"] == "shard.write"
+        assert diag["trace_tail"]  # the capture tail rides along
+        # quarantined is terminal for clients too
+        assert client.wait(spool, poison, timeout_s=5)["state"] == "quarantined"
+        assert not os.path.exists(poison_out)
+        # the healthy job survived the carnage, byte-identical
+        assert final_snap["jobs_done"] == 1
+        assert final_snap["jobs_quarantined"] == 1
+        assert client.status(spool, healthy)["state"] == "done"
+        with open(healthy_out, "rb") as f:
+            assert f.read() == ref_bytes
+        # exactly-once accounting: the poison ran exactly max_crashes
+        # slices (the journal's slice counter is the fleet-wide truth),
+        # and the quarantining daemon recorded the verdict
+        assert SpoolQueue(spool).jobs[poison]["slices"] == 3
+        assert final_trace is not None
+        _, ev = _events(final_trace)
+        quarantined = [e for e in ev if e["name"] == "job_quarantined"]
+        assert len(quarantined) == 1 and quarantined[0]["job"] == poison
+        assert quarantined[0]["crash_count"] == 3
+        # zero re-runs afterward: a fresh daemon finds nothing to do
+        t_after = str(tmp_path / "after.jsonl")
+        snap = ConsensusService(
+            spool, trace_path=t_after, daemon_id="pd-after"
+        ).run_until_idle()
+        assert snap["jobs_quarantined"] == 1  # rebuilt from the journal
+        _, ev = _events(t_after)
+        assert [e for e in ev if e["name"] == "job_started"] == []
+
+    def test_clean_preemptions_never_count_toward_quarantine(
+        self, sim, tmp_path
+    ):
+        """Budget preemptions are the scheduler working as designed:
+        a job preempted many times must carry no crash_count at all."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jobs = _submit_n(spool, in_path, tmp_path, 2)
+        svc = ConsensusService(spool, chunk_budget=1)
+        snap = svc.run_until_idle()
+        assert snap["preemptions"] >= 2 and snap["jobs_quarantined"] == 0
+        for jid, out in jobs:
+            entry = SpoolQueue(spool).jobs[jid]
+            assert entry.get("crash_count", 0) == 0
+            with open(out, "rb") as f:
+                assert f.read() == ref_bytes
+
+
+class TestDiskPressure:
+    """Disk-pressure degradation: admission sheds below the low-water
+    mark with a journaled `shed: disk` reason, after a grace GC pass
+    over terminal jobs' shard/checkpoint litter."""
+
+    def _queued_terminal_with_litter(self, tmp_path):
+        q = SpoolQueue(str(tmp_path))
+        jid = client.submit(str(tmp_path), __file__,
+                            str(tmp_path / "t0.bam"), config=dict(CONFIG))
+        q.accept_one(jid)
+        q.mark_failed(jid, "boom")
+        out = q.jobs[jid]["spec"]["output"]
+        with open(out + ".ckpt", "w") as f:
+            f.write('{"done": {}}')
+        os.makedirs(out + ".shards", exist_ok=True)
+        with open(os.path.join(out + ".shards", "chunk000000.recs"),
+                  "wb") as f:
+            f.write(b"x" * 4096)
+        with open(out + ".tmp", "wb") as f:
+            f.write(b"y" * 2048)
+        return q, out
+
+    def test_low_water_sheds_with_disk_reason(self, tmp_path, monkeypatch):
+        from duplexumiconsensusreads_tpu.serve import queue as queue_mod
+
+        q = SpoolQueue(str(tmp_path), min_free_bytes=64 << 20)
+        jid = client.submit(str(tmp_path), __file__,
+                            str(tmp_path / "o.bam"), config=dict(CONFIG))
+        monkeypatch.setattr(queue_mod, "free_bytes", lambda p: 1 << 20)
+        spec, reason = q.accept_one(jid)
+        assert spec is None and reason.startswith("shed: disk")
+        st = q.status(jid)
+        assert st["state"] == "rejected" and st["shed"] is True
+        assert "low-water" in st["error"]
+
+    def test_grace_gc_frees_terminal_litter_then_admits(
+        self, tmp_path, monkeypatch
+    ):
+        from duplexumiconsensusreads_tpu.serve import queue as queue_mod
+
+        q, out = self._queued_terminal_with_litter(tmp_path)
+        q.min_free_bytes = 64 << 20
+        # first probe low, post-GC probe healthy: the job is ADMITTED
+        # and the terminal litter is gone
+        probes = iter([1 << 20, 1 << 30])
+        monkeypatch.setattr(
+            queue_mod, "free_bytes", lambda p: next(probes, 1 << 30)
+        )
+        jid = client.submit(str(tmp_path), __file__,
+                            str(tmp_path / "new.bam"), config=dict(CONFIG))
+        spec, reason = q.accept_one(jid)
+        assert spec is not None and reason is None
+        assert not os.path.exists(out + ".ckpt")
+        assert not os.path.exists(out + ".shards")
+        assert not os.path.exists(out + ".tmp")
+
+    def test_gc_only_touches_terminal_jobs_litter(self, tmp_path):
+        q, out = self._queued_terminal_with_litter(tmp_path)
+        # an OPEN job's checkpoint must survive any GC pass
+        live = client.submit(str(tmp_path), __file__,
+                             str(tmp_path / "live.bam"), config=dict(CONFIG))
+        q.accept_one(live)
+        live_out = q.jobs[live]["spec"]["output"]
+        with open(live_out + ".ckpt", "w") as f:
+            f.write('{"done": {}}')
+        # the terminal job's published output is never GC fodder either
+        with open(out, "wb") as f:
+            f.write(b"published bytes")
+        freed = q.gc_terminal_litter()
+        assert freed >= 4096 + 2048
+        assert not os.path.exists(out + ".ckpt")
+        assert os.path.exists(out)  # published output untouched
+        assert os.path.exists(live_out + ".ckpt")  # open job untouched
+
+    def test_probe_disabled_never_sheds(self, tmp_path, monkeypatch):
+        from duplexumiconsensusreads_tpu.serve import queue as queue_mod
+
+        q = SpoolQueue(str(tmp_path), min_free_bytes=0)
+        monkeypatch.setattr(queue_mod, "free_bytes", lambda p: 0)
+        jid = client.submit(str(tmp_path), __file__,
+                            str(tmp_path / "o.bam"), config=dict(CONFIG))
+        assert q.accept_one(jid)[0] is not None
+
+    def test_free_bytes_probe_answers_on_real_fs(self, tmp_path):
+        from duplexumiconsensusreads_tpu.io.durable import free_bytes
+
+        free = free_bytes(str(tmp_path))
+        assert isinstance(free, int) and free > 0
+        assert free_bytes(str(tmp_path / "nope" / "deeper")) is None
+
+
+class TestCounterRebuild:
+    def test_counters_rebuilt_from_journal_across_restart(
+        self, sim, tmp_path
+    ):
+        """The metrics-truth satellite: a restarted daemon's counters
+        (and therefore metrics.json) must reflect the journal it
+        inherited, not restart at zero while the spool says otherwise."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jobs = _submit_n(spool, in_path, tmp_path, 2)
+        bad = client.submit(spool, __file__, str(tmp_path / "bad.bam"),
+                            config=dict(CONFIG))  # not a BAM: fails
+        snap = ConsensusService(spool, chunk_budget=0).run_until_idle()
+        assert snap["jobs_done"] == 2 and snap["jobs_failed"] == 1
+        # a fresh instance on the same spool starts TRUTHFUL
+        svc2 = ConsensusService(spool, chunk_budget=0)
+        stats = svc2.stats()
+        assert stats["jobs_done"] == 2 and stats["jobs_failed"] == 1
+        assert stats["jobs_accepted"] == 3
+        # and its final snapshot (metrics.json) keeps the totals
+        snap2 = svc2.run_until_idle()
+        assert snap2["jobs_done"] == 2 and snap2["jobs_failed"] == 1
+        with open(os.path.join(spool, "metrics.json")) as f:
+            metrics = json.load(f)
+        assert metrics["jobs_done"] == 2 and metrics["jobs_failed"] == 1
+        for _, out in jobs:
+            with open(out, "rb") as f:
+                assert f.read() == ref_bytes
+        assert client.status(spool, bad)["state"] == "failed"
 
 
 class TestAdmissionControl:
@@ -1072,7 +1681,10 @@ class TestGracefulDrain:
         assert n_done_1 >= 1
         t2 = str(tmp_path / "svc2.jsonl")
         snap2 = ConsensusService(spool, trace_path=t2).run_until_idle()
-        assert snap2["jobs_done"] == 3 - n_done_1
+        # counters rebuild from the inherited journal, so the restarted
+        # daemon reports the spool's TOTAL (first daemon's completions
+        # included), not just its own session's work
+        assert snap2["jobs_done"] == 3
         for jid, out in jobs:
             assert client.status(spool, jid)["state"] == "done"
             with open(out, "rb") as f:
@@ -1243,3 +1855,52 @@ class TestCliVerbs:
         jid, _ = _submit_n(spool, in_path, tmp_path, 1)[0]
         st = client.wait(spool, jid, timeout_s=0.2, poll_s=0.05)
         assert st["timed_out"] is True and st["state"] == "submitted"
+
+    def test_wait_timeout_distinct_exit_code_and_state_line(
+        self, sim, tmp_path, capsys
+    ):
+        """--wait-timeout satellite: a timeout is 'still running', not
+        'dead' — distinct exit code 3, and the job's last journaled
+        state on stderr so the operator knows what they are waiting
+        on."""
+        from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+
+        in_path, _ = sim
+        spool = str(tmp_path / "spool")
+        jid, _ = _submit_n(spool, in_path, tmp_path, 1)[0]
+        rc = cli_main(["call", "--wait", jid, "--spool", spool,
+                       "--wait-timeout", "0.2"])
+        captured = capsys.readouterr()
+        assert rc == 3
+        st = json.loads(captured.out)
+        assert st["timed_out"] is True
+        assert "last journaled state" in captured.err
+        assert "submitted" in captured.err
+
+    def test_submit_deadline_flag_round_trips(self, sim, tmp_path, capsys):
+        from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+
+        in_path, _ = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "dl.bam")
+        rc = cli_main([
+            "call", in_path, "-o", out, "--submit", "--spool", spool,
+            "--grouping", "adjacency", "--mode", "duplex",
+            "--capacity", "128", "--chunk-reads", "90",
+            "--deadline", "120",
+        ])
+        assert rc == 0
+        jid = capsys.readouterr().out.strip()
+        q = SpoolQueue(spool)
+        assert q.accept_one(jid)[0] is not None
+        assert q.jobs[jid]["deadline_m"] == pytest.approx(
+            time.monotonic() + 120.0, abs=5.0
+        )
+        # --deadline outside --submit is refused, not silently ignored
+        with pytest.raises(SystemExit, match="deadline"):
+            cli_main(["call", in_path, "-o", out, "--chunk-reads", "90",
+                      "--deadline", "10"])
+        with pytest.raises(SystemExit, match="deadline"):
+            cli_main(["call", in_path, "-o", out, "--submit",
+                      "--spool", spool, "--chunk-reads", "90",
+                      "--deadline", "-1"])

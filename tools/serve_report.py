@@ -57,7 +57,8 @@ def summarize(records: list[dict]) -> dict:
         j = jobs.setdefault(
             job,
             {"state": "accepted", "slices": 0, "preemptions": 0,
-             "takeovers": 0, "fenced": 0, "wall_s": 0.0, "warm": None},
+             "takeovers": 0, "fenced": 0, "watchdogs": 0,
+             "wall_s": 0.0, "warm": None},
         )
         if name == "job_accepted":
             j["priority"] = rec.get("priority")
@@ -99,6 +100,18 @@ def summarize(records: list[dict]) -> dict:
         elif name == "job_failed":
             j["state"] = "failed"
             j["error"] = rec.get("error")
+        elif name == "job_expired":
+            # deadline verdict: terminal, with the durable reason
+            j["state"] = "expired"
+            j["error"] = rec.get("reason")
+        elif name == "job_quarantined":
+            # poison verdict: terminal after crash_count unclean aborts
+            j["state"] = "quarantined"
+            j["error"] = rec.get("reason")
+            j["crash_count"] = rec.get("crash_count")
+        elif name == "watchdog_fired":
+            j["watchdogs"] += 1
+            j["stalled_s"] = rec.get("stalled_s")
     last = records[-1] if records else {}
     summary = last if isinstance(last, dict) and last.get("type") == "summary" else {}
     counters = summary.get("counters") if isinstance(summary, dict) else None
@@ -111,6 +124,19 @@ def summarize(records: list[dict]) -> dict:
         "n_failed": failed,
         "n_rejected": sum(1 for j in jobs.values() if j["state"] == "rejected"),
         "n_shed": sum(1 for j in jobs.values() if j["state"] == "shed"),
+        # disk-pressure sheds carry a "shed: disk ..." reason — split
+        # out so overload-by-disk is legible apart from class/queue
+        # bounds
+        "n_disk_shed": sum(
+            1 for j in jobs.values()
+            if j["state"] == "shed"
+            and str(j.get("error", "")).startswith("shed: disk")
+        ),
+        "n_expired": sum(1 for j in jobs.values() if j["state"] == "expired"),
+        "n_quarantined": sum(
+            1 for j in jobs.values() if j["state"] == "quarantined"
+        ),
+        "n_watchdog_fired": sum(j["watchdogs"] for j in jobs.values()),
         "n_takeovers": sum(j["takeovers"] for j in jobs.values()),
         "n_fenced": sum(j["fenced"] for j in jobs.values()),
         "n_preemptions": sum(j["preemptions"] for j in jobs.values()),
@@ -170,6 +196,16 @@ def main(argv: list[str] | None = None) -> int:
             f"fleet: {s['n_takeovers']} lease takeovers, "
             f"{s['n_fenced']} fenced (zombie) slices"
         )
+    if (
+        s["n_expired"] or s["n_quarantined"] or s["n_watchdog_fired"]
+        or s["n_disk_shed"]
+    ):
+        print(
+            f"defense: {s['n_expired']} expired, "
+            f"{s['n_quarantined']} quarantined, "
+            f"{s['n_watchdog_fired']} watchdog fires, "
+            f"{s['n_disk_shed']} disk sheds"
+        )
     if s["queue_depth_max"]:
         print(
             f"queue depth over heartbeats: max {s['queue_depth_max']:.0f} "
@@ -180,9 +216,9 @@ def main(argv: list[str] | None = None) -> int:
             f"switchboard: {s['n_fault_events']} injected faults, "
             f"{s['n_retry_events']} retries"
         )
-    print(f"{'job':<18} {'state':<9} {'pri':>3} {'slices':>6} "
-          f"{'preempt':>7} {'wall_s':>8} {'warm':>5} {'h2d_mb':>8} "
-          f"{'d2h_mb':>8} {'B/read':>7}")
+    print(f"{'job':<18} {'state':<11} {'pri':>3} {'slices':>6} "
+          f"{'preempt':>7} {'wd':>3} {'wall_s':>8} {'warm':>5} "
+          f"{'h2d_mb':>8} {'d2h_mb':>8} {'B/read':>7}")
     def _mb(v):
         return f"{v / 1e6:.1f}" if isinstance(v, (int, float)) else "-"
 
@@ -190,8 +226,9 @@ def main(argv: list[str] | None = None) -> int:
         j = s["jobs"][job_id]
         bpr = j.get("bytes_per_read")
         print(
-            f"{job_id:<18} {j['state']:<9} {str(j.get('priority', '?')):>3} "
-            f"{j['slices']:>6} {j['preemptions']:>7} {j['wall_s']:>8.3f} "
+            f"{job_id:<18} {j['state']:<11} {str(j.get('priority', '?')):>3} "
+            f"{j['slices']:>6} {j['preemptions']:>7} "
+            f"{j.get('watchdogs', 0):>3} {j['wall_s']:>8.3f} "
             f"{str(j['warm']):>5} {_mb(j.get('h2d_bytes')):>8} "
             f"{_mb(j.get('d2h_bytes')):>8} "
             f"{f'{bpr:g}' if isinstance(bpr, (int, float)) else '-':>7}"
