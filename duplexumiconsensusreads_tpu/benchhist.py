@@ -54,6 +54,12 @@ CANONICAL_METRICS = (
     # never gated (they characterise defense policy, not throughput)
     ("serve_quarantine_after_crashes", False, False),
     ("serve_watchdog_detect_latency_s", False, False),
+    # scatter-gather sharding (serve/shard/): single-host fleets share
+    # one device, so the K=4/K=1 ratio characterises scheduling +
+    # pipeline-overlap headroom, not device scaling — informational,
+    # never gated
+    ("serve_shard_speedup", True, False),
+    ("serve_shard_merge_s", False, False),
 )
 
 _NUM = r"-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?"

@@ -79,6 +79,7 @@ COMPACT_KEYS = (
     "e2e_bytes_per_read", "e2e_packed_speedup", "e2e_vs_cpu_e2e",
     "serve_amortised_speedup", "serve_fleet_takeover_latency_s",
     "serve_quarantine_after_crashes", "serve_watchdog_detect_latency_s",
+    "serve_shard_speedup", "serve_shard_merge_s",
 )
 
 
@@ -807,6 +808,94 @@ def run_serve_defense_bench() -> dict:
     return out
 
 
+def run_serve_shard_bench(n_daemons: int) -> dict:
+    """The ``serve_shard`` leg: ONE large job through the fleet,
+    unsharded (K=1 — through the full split/merge pipeline, proving
+    the degenerate path costs only the merge copy) vs scattered at K=4
+    across ``n_daemons`` in-process daemons sharing the spool.
+
+    Emits (informational, non-gating — on a single host the daemons
+    share the device, so the speedup mostly measures scheduling +
+    pipeline-overlap headroom, not K-way device parallelism):
+
+      serve_shard_speedup   wall(K=1) / wall(K=4), same input/config
+      serve_shard_merge_s   the K=4 merge stage's wall (splice+index)
+    """
+    import shutil
+    import threading
+
+    from duplexumiconsensusreads_tpu.serve import ConsensusService, client
+    from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
+
+    cache = os.environ.get("DUT_BENCH_CACHE", ".bench_cache")
+    n_reads = int(os.environ.get("DUT_BENCH_SERVE_READS", 120_000))
+    in_path, _ = _e2e_input(n_reads)
+    config = dict(
+        grouping="adjacency", mode="duplex", error_model="cycle",
+        capacity=int(os.environ.get("DUT_BENCH_CAPACITY", 2048)),
+        chunk_reads=max(n_reads // 8, 10_000),
+    )
+    out: dict = {"serve_shard_daemons": n_daemons}
+    # warm the process's jit cache first: the K=1 leg runs before the
+    # K=4 leg, and without this it would pay the per-process XLA
+    # compile the K=4 leg then gets for free — inflating the "speedup"
+    # with compile amortisation the serve_n_jobs leg already measures
+    warm_spool = os.path.join(cache, "serve_shard_warmup_spool")
+    shutil.rmtree(warm_spool, ignore_errors=True)
+    warm_out = os.path.join(cache, "serve_shard_warmup.bam")
+    client.submit(warm_spool, in_path, warm_out, config=config)
+    _swallow(ConsensusService(warm_spool, poll_s=0.02).run_until_idle)
+    try:
+        os.remove(warm_out)
+    except OSError:
+        pass
+    walls: dict[int, float] = {}
+    merge_s = None
+    for k in (1, 4):
+        spool = os.path.join(cache, f"serve_shard_spool_k{k}")
+        shutil.rmtree(spool, ignore_errors=True)
+        out_bam = os.path.join(cache, f"serve_shard_out_k{k}.bam")
+        jid = client.submit(spool, in_path, out_bam, config=config, shards=k)
+        svcs = [
+            ConsensusService(spool, chunk_budget=0, poll_s=0.02,
+                             daemon_id=f"shard-bench-{k}-{i}")
+            for i in range(n_daemons)
+        ]
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(target=lambda s=s: _swallow(s.run_until_idle),
+                             daemon=True)
+            for s in svcs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1800)
+        walls[k] = time.monotonic() - t0
+        q = SpoolQueue(spool)
+        q.refresh()
+        entry = q.jobs.get(jid, {})
+        if entry.get("state") != "done":
+            return {**out, "serve_shard_error":
+                    f"K={k} parent finished {entry.get('state')!r}"}
+        st = q.status(jid)
+        sharded = (st.get("result") or {}).get("sharded") or {}
+        if k == 4:
+            merge_s = sharded.get("merge_s")
+        try:
+            os.remove(out_bam)
+        except OSError:
+            pass
+    out.update({
+        "serve_shard_k1_wall_s": round(walls[1], 2),
+        "serve_shard_k4_wall_s": round(walls[4], 2),
+        "serve_shard_speedup": round(walls[1] / max(walls[4], 1e-9), 2),
+    })
+    if merge_s is not None:
+        out["serve_shard_merge_s"] = merge_s
+    return out
+
+
 def _swallow(fn):
     try:
         fn()
@@ -1202,6 +1291,9 @@ def main() -> None:
             # defensive-serving sub-leg: poison-job quarantine depth +
             # watchdog detect latency (informational, non-gating)
             result.update(run_serve_defense_bench())
+            # scatter-gather sub-leg: one large job at K=1 vs K=4
+            # across the same fleet (informational, non-gating)
+            result.update(run_serve_shard_bench(n_fleet))
         # same pipeline end-to-end on XLA-CPU: the wall-clock >=50x
         # denominator (DUT_BENCH_CPU_E2E_READS=0 disables); runs after
         # every TPU leg so the 1-core box is never shared

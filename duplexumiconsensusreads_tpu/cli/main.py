@@ -93,6 +93,20 @@ def build_parser() -> argparse.ArgumentParser:
         "its next checkpoint boundary; the committed prefix survives "
         "for a re-submitted resume). Default: the daemon's --deadline",
     )
+    c.add_argument(
+        "--shards", type=int, default=None, metavar="K",
+        help="--submit scatter-gather: split the job into K genomic-"
+        "range sub-jobs fanned across the fleet's daemons, then merge "
+        "the shard outputs into one indexed BAM byte-identical to the "
+        "unsharded run. --status/--wait on the job id aggregate the "
+        "sub-jobs; the job is done when the merge publishes",
+    )
+    c.add_argument(
+        "--shard-bytes", type=int, default=None, metavar="BYTES",
+        help="--submit scatter-gather by size: like --shards, with K "
+        "derived from the compressed input size (one sub-job per this "
+        "many input bytes; mutually exclusive with --shards)",
+    )
     c.add_argument("--config", choices=sorted(CONFIG_PRESETS), help="benchmark preset")
     c.add_argument(
         "--config-file",
@@ -726,6 +740,15 @@ def _cmd_call(args) -> int:
             raise SystemExit(f"--priority must be >= 0 (got {args.priority})")
         if args.deadline is not None and args.deadline <= 0:
             raise SystemExit(f"--deadline must be > 0 (got {args.deadline})")
+        if args.shards is not None and args.shards < 1:
+            raise SystemExit(f"--shards must be >= 1 (got {args.shards})")
+        if args.shard_bytes is not None and args.shard_bytes < 1:
+            raise SystemExit(
+                f"--shard-bytes must be >= 1 (got {args.shard_bytes})"
+            )
+        if args.shards is not None and args.shard_bytes is not None:
+            raise SystemExit("--shards and --shard-bytes are mutually "
+                             "exclusive")
         if args.checkpoint or args.resume or args.report or args.profile:
             # the daemon owns checkpointing/resume (preemption + crash
             # recovery) and the result report (spool results/): these
@@ -782,6 +805,8 @@ def _cmd_call(args) -> int:
                 chaos=args.chaos,
                 trace=args.trace,
                 deadline_s=args.deadline,
+                shards=args.shards,
+                shard_bytes=args.shard_bytes,
             )
         except (ValueError, OSError) as e:
             raise SystemExit(f"--submit: {e}")
@@ -799,6 +824,13 @@ def _cmd_call(args) -> int:
         raise SystemExit(
             "--deadline applies to --submit jobs (daemon default: "
             "dut-serve --deadline)"
+        )
+    if args.shards is not None or args.shard_bytes is not None:
+        # sharding is a fleet contract (sub-job fan-out + lease-claimed
+        # merge); a direct run would silently ignore the flag
+        raise SystemExit(
+            "--shards/--shard-bytes apply to --submit jobs (the fleet "
+            "fans the sub-jobs out and merges the shards)"
         )
     if args.trace and chunk_reads <= 0:
         # only the streaming executor is span-instrumented; on the

@@ -150,10 +150,12 @@ def build_linear_index(path: str, every: int = 100_000) -> BamLinearIndex:
     )
 
 
-def _scan_blocks(path: str, read_size: int = 8 << 20):
+def _scan_blocks(path: str, read_size: int = 8 << 20, progress=None):
     """Streaming BGZF block table: (compressed offsets, cumulative
     decompressed offsets). Header-only scan in bounded memory — the
-    index targets files far larger than RAM."""
+    index targets files far larger than RAM. ``progress`` (optional
+    callable) fires once per ``read_size`` batch: long walks under a
+    lease (the shard planner) stamp liveness through it."""
     c_off, u_sizes = [], []
     base = 0
     buf = b""
@@ -164,6 +166,8 @@ def _scan_blocks(path: str, read_size: int = 8 << 20):
         f.seek(0)
         while True:
             data = f.read(read_size)
+            if progress is not None:
+                progress()
             if data:
                 buf += data
             off = 0

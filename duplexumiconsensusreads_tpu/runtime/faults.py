@@ -73,6 +73,12 @@ KNOWN_SITES = (
     # both chaos-targetable like every other lease-state transition
     "serve.deadline",  # deadline sweep + terminal `expired` commit
     "serve.watchdog",  # no-progress stall scan + abort-requeue commit
+    # scatter-gather sharding (serve/shard/): the two durable moves of
+    # the parent-job state machine — registering the planned sub-jobs
+    # (splitting -> fanned) and the merge path (parent advance sweep,
+    # shard splice commits, merged-output publish)
+    "serve.split",  # shard-plan journal txn: children registered + fanned
+    "serve.merge",  # parent advance sweep + shard splice/publish commits
 )
 
 _EXC_ERRNO = {

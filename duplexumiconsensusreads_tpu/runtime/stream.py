@@ -494,6 +494,7 @@ def iter_batch_chunks(
     key_lo=None,
     key_hi=None,
     warn_mixed: bool = True,
+    first_read: int | None = None,
 ):
     """Yield (header, ReadBatch, info) chunks with the family-integrity
     hold-back of iter_record_chunks, but parsed NATIVELY: record fields
@@ -511,6 +512,18 @@ def iter_batch_chunks(
     a BGZF virtual offset; only records with key_lo <= pos_key < key_hi
     are yielded (None = open end). Leading records below key_lo are
     skipped; iteration stops at the first record >= key_hi.
+
+    ``first_read`` (range mode, native path): record count of the FIRST
+    raw read, after which reads revert to ``chunk_reads`` — the shard
+    planner's chunk-grid realignment. Chunk boundaries are a pure
+    function of the sequence of raw-read end positions plus the
+    pos_keys, so a ranged stream whose first read ends exactly where
+    the whole-file stream's corresponding read would reproduces the
+    whole-file chunk boundaries from there on — the property the
+    scatter-gather byte-identity contract (serve/shard/) is built on.
+    The Python fallback ignores both ``start`` and ``first_read``: it
+    re-chunks the full stream and filters per chunk, so its boundaries
+    are whole-file-aligned by construction.
     """
     lib = None
     if not os.environ.get("DUT_NO_NATIVE"):
@@ -563,9 +576,16 @@ def iter_batch_chunks(
             ),
         )
 
+    # chunk-grid realignment: only the first read differs (see the
+    # docstring); a None/0 first_read keeps the uniform grid
+    n_next_read = (
+        first_read if first_read is not None and first_read > 0
+        else chunk_reads
+    )
     try:
         while True:
-            raw = reader.read_raw_records(chunk_reads)
+            raw = reader.read_raw_records(n_next_read)
+            n_next_read = chunk_reads
             if raw is None:
                 if carry:
                     data = np.frombuffer(shell + carry, np.uint8)
@@ -793,7 +813,7 @@ class Checkpoint:
 def _fingerprint(
     in_path: str, grouping, consensus, capacity, chunk_reads, input_range=None,
     mate_aware: str = "auto", max_reads: int = 0, per_base_tags: bool = False,
-    read_group: str = "A",
+    read_group: str = "A", chunk_base: int = 0, first_read: int | None = None,
 ) -> str:
     """The mate_aware SETTING (auto/on/off) joins the key rather than
     the resolved boolean: resolution is a deterministic function of the
@@ -839,7 +859,11 @@ def _fingerprint(
             # by the per-shard "codec" manifest field, which resume
             # verification checks against this same flavor
             "deflate:" + bgzf.deflate_flavor(),
-        ],
+        ]
+        # shard-mode chunk-grid parameters change every chunk boundary,
+        # so a manifest from one plan must never be resumed by another;
+        # appended only when set, keeping pre-shard fingerprints stable
+        + ([chunk_base, first_read] if (chunk_base or first_read) else []),
         sort_keys=True,
     )
     return hashlib.sha256(key.encode()).hexdigest()[:16]
@@ -903,6 +927,12 @@ def stream_call_consensus(
     # serving layer passes a canonical config-derived line so a job's
     # bytes are a pure function of (input, config), not of which daemon
     # process happened to finish it
+    chunk_base: int = 0,  # global index of this run's first chunk: a
+    # shard sub-job (serve/shard/) numbers its chunks — and therefore
+    # its consensus record names — on the parent's whole-file grid, so
+    # merged shard outputs are byte-identical to the unsharded run
+    first_read: int | None = None,  # record count of the first raw read
+    # (shard chunk-grid realignment; see iter_batch_chunks)
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -951,6 +981,7 @@ def stream_call_consensus(
             write_index=write_index, packed=packed,
             tr=tr, heartbeat_s=heartbeat_s, hb_box=hb_box,
             provenance_cl=provenance_cl,
+            chunk_base=chunk_base, first_read=first_read,
         )
     finally:
         for hb in hb_box:
@@ -991,6 +1022,8 @@ def _stream_call(
     heartbeat_s: float = 0.0,
     hb_box: list | None = None,
     provenance_cl: str | None = None,
+    chunk_base: int = 0,
+    first_read: int | None = None,
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -1060,6 +1093,7 @@ def _stream_call(
             in_path, grouping, consensus, capacity, chunk_reads, input_range,
             mate_aware=mate_aware, max_reads=max_reads,
             per_base_tags=per_base_tags, read_group=read_group,
+            chunk_base=chunk_base, first_read=first_read,
         )
         # resume=False discards `done` just below — skip the per-shard
         # CRC re-read (it would read ~ the whole prior output for
@@ -1088,6 +1122,7 @@ def _stream_call(
         in_path, chunk_reads, duplex,
         start=rng_start, key_lo=rng_lo, key_hi=rng_hi,
         warn_mixed=False,  # warning responsibility moves to the chunk loop
+        first_read=first_read,
     )
     first = next(chunk_iter, None)
     grouping = resolve_mate_aware(
@@ -1378,7 +1413,8 @@ def _stream_call(
     # (bounded: <= max_inflight entries, each a compressed shard).
     done_q: dict[int, tuple] = {}
     fin: dict = {"f": None}
-    frontier = 0
+    frontier = chunk_base  # chunk indices live on the (possibly
+    # shard-offset) global grid; the frontier starts at this run's first
     tmp_path = out_path + ".tmp"
 
     def _fin_open():
@@ -1495,7 +1531,7 @@ def _stream_call(
         _advance_frontier()
 
     def timed_chunks(it):
-        i = 0
+        i = chunk_base
         while True:
             t0 = time.monotonic()
             item = next(it, None)
@@ -1524,7 +1560,7 @@ def _stream_call(
                 retries = rep.n_retries
             return {
                 "elapsed_s": round(elapsed, 1),
-                "chunks_done": frontier,
+                "chunks_done": frontier - chunk_base,
                 "chunks_inflight": len(inflight),
                 "stall_frac": round(stall / elapsed, 3),
                 "retries": retries,
@@ -1539,7 +1575,9 @@ def _stream_call(
 
     n_skipped = 0
     try:
-        for k, (header, batch, info) in enumerate(timed_chunks(iter(chunk_iter))):
+        for k, (header, batch, info) in enumerate(
+            timed_chunks(iter(chunk_iter)), start=chunk_base
+        ):
             if header_out is None:
                 header_out = header
                 # collision-free consensus @RG, resolved once from the

@@ -51,13 +51,20 @@ def submit(
     chaos: str | None = None,
     trace: str | None = None,
     deadline_s: float | None = None,
+    shards: int | None = None,
+    shard_bytes: int | None = None,
 ) -> str:
     """Validate + durably spool one job; returns its id. Raises
     ValueError on a bad spec and FileNotFoundError on a missing input —
     submission-time failures belong to the submitter, not the daemon.
     ``deadline_s``: wall budget from admission; past it the job is
     journaled terminal "expired" instead of run (a running slice aborts
-    at its next checkpoint boundary, keeping the committed prefix)."""
+    at its next checkpoint boundary, keeping the committed prefix).
+    ``shards``/``shard_bytes`` (mutually exclusive): scatter-gather
+    sharding — split the job into K range sub-jobs fanned across the
+    fleet and merged into one output byte-identical to the unsharded
+    run (``--status``/``--wait`` on the returned id aggregate the
+    sub-jobs; the job is done only when the merge publishes)."""
     if not os.path.exists(input_path):
         raise FileNotFoundError(f"job input does not exist: {input_path}")
     fields = {
@@ -72,6 +79,10 @@ def submit(
         fields["trace"] = os.path.abspath(trace)
     if deadline_s is not None:
         fields["deadline_s"] = deadline_s
+    if shards is not None:
+        fields["shards"] = shards
+    if shard_bytes is not None:
+        fields["shard_bytes"] = shard_bytes
     spec = validate_spec({"job_id": make_job_id(fields), **fields})
     return SpoolQueue(spool_dir).submit(spec)
 

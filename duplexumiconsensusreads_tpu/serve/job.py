@@ -68,6 +68,19 @@ class JobSpec:
     # at its next checkpoint boundary — terminal state "expired", with
     # the partial checkpoint preserved so a re-submitted job resumes
     deadline_s: float | None = None
+    # scatter-gather sharding (serve/shard/): a PARENT job asks to be
+    # split into K range sub-jobs (`shards`), or into sub-jobs of
+    # roughly this many compressed input bytes each (`shard_bytes`);
+    # the planner fans the sub-jobs across the fleet and a merge stage
+    # splices their outputs into one BAM byte-identical to the same job
+    # run unsharded. Mutually exclusive with each other and with
+    # `shard` below.
+    shards: int | None = None
+    shard_bytes: int | None = None
+    # planner-written SUB-JOB metadata (never client-set): the child's
+    # half-open range on the parent's whole-file chunk grid — see
+    # serve/shard/plan.py for the field contract
+    shard: dict | None = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -80,7 +93,8 @@ def validate_spec(d: dict) -> JobSpec:
     if not isinstance(d, dict):
         raise ValueError("job spec must be a JSON object")
     allowed_top = {"job_id", "input", "output", "priority", "config",
-                   "chaos", "trace", "deadline_s"}
+                   "chaos", "trace", "deadline_s", "shards",
+                   "shard_bytes", "shard"}
     unknown = set(d) - allowed_top
     if unknown:
         raise ValueError(f"unknown job fields: {sorted(unknown)}")
@@ -136,6 +150,35 @@ def validate_spec(d: dict) -> JobSpec:
                 f"job deadline_s must be a number > 0 (got {deadline_s!r})"
             )
         deadline_s = float(deadline_s)
+    shards = d.get("shards")
+    if shards is not None and (
+        not isinstance(shards, int) or isinstance(shards, bool) or shards < 1
+    ):
+        raise ValueError(f"job shards must be an int >= 1 (got {shards!r})")
+    shard_bytes = d.get("shard_bytes")
+    if shard_bytes is not None and (
+        not isinstance(shard_bytes, int)
+        or isinstance(shard_bytes, bool)
+        or shard_bytes < 1
+    ):
+        raise ValueError(
+            f"job shard_bytes must be an int >= 1 (got {shard_bytes!r})"
+        )
+    if shards is not None and shard_bytes is not None:
+        raise ValueError("job shards and shard_bytes are mutually exclusive")
+    shard = d.get("shard")
+    if shard is not None:
+        if shards is not None or shard_bytes is not None:
+            raise ValueError(
+                "a shard sub-job cannot itself request sharding"
+            )
+        if not isinstance(shard, dict):
+            raise ValueError("job shard metadata must be an object")
+        missing = {"parent", "idx", "k", "chunk_base"} - set(shard)
+        if missing:
+            raise ValueError(
+                f"job shard metadata lacks required keys: {sorted(missing)}"
+            )
     return JobSpec(
         job_id=d["job_id"],
         input=d["input"],
@@ -145,6 +188,9 @@ def validate_spec(d: dict) -> JobSpec:
         chaos=chaos,
         trace=trace,
         deadline_s=deadline_s,
+        shards=shards,
+        shard_bytes=shard_bytes,
+        shard=shard,
     )
 
 

@@ -85,8 +85,27 @@ JOURNAL_VERSION = 1
 #                                 takeover/watchdog abort — the job is
 #                                 poison: it kills whatever runs it, so
 #                                 it must never re-enter the queue)
+# and, for a sharding PARENT (spec carries shards/shard_bytes —
+# serve/shard/):
+#   queued -> splitting -> fanned -> queued -> merging -> done | failed
+# "splitting"/"merging" are the parent's claimed states (a lease +
+# fencing token protect them exactly like "running"; the journal
+# phase field decides which literal a claim writes); "fanned" is the
+# parked aggregate state while sub-jobs run — no lease, not claimable,
+# advanced by the fleet's parent sweep when the last child lands.
 JOB_STATES = ("queued", "running", "done", "failed", "rejected",
-              "expired", "quarantined")
+              "expired", "quarantined", "splitting", "fanned", "merging")
+
+# states held under a lease + fencing token: the fence check, lease
+# renewal, takeover and watchdog sweeps all treat them alike — a
+# planner or merger slice is fenced/reclaimed exactly like a consensus
+# slice
+CLAIMED_STATES = ("running", "splitting", "merging")
+
+# states with scheduling work left: the fleet idle check and the
+# admission open-jobs bound count these (a fanned parent IS open work —
+# its merge hasn't happened)
+OPEN_STATES = ("queued", "fanned") + CLAIMED_STATES
 
 # states with nothing left to schedule: compaction may drop them (their
 # durable results/ file remains the record) and the idle check ignores
@@ -281,6 +300,8 @@ class SpoolQueue:
             return self._status_from_result(job_id)
         out = {"job_id": job_id, **{k: v for k, v in entry.items()
                                     if k != "spec"}}
+        if entry.get("children"):
+            out["shards"] = self._shard_rollup(entry)
         result_path = os.path.join(self.results_dir, job_id + ".json")
         if entry.get("state") in (
             "done", "failed", "expired", "quarantined"
@@ -387,11 +408,20 @@ class SpoolQueue:
         lease/token state must survive every save, or a restarted
         daemon would schedule (and fence) differently than the dead
         one would have."""
+        # a done sub-job of a still-open parent must survive compaction:
+        # the parent's advance sweep decides fanned -> merge by reading
+        # its children's journal states, and compacting one away would
+        # stall (or mis-fail) the merge forever
+        open_parents = {
+            jid for jid, e in self.jobs.items()
+            if e.get("state") not in TERMINAL_STATES
+        }
         terminal = sorted(
             (
                 (int(e.get("seq", 0)), jid)
                 for jid, e in self.jobs.items()
                 if e.get("state") in TERMINAL_STATES
+                and e.get("parent") not in open_parents
             ),
         )
         for _, jid in terminal[: max(len(terminal) - self.max_terminal_kept, 0)]:
@@ -496,7 +526,7 @@ class SpoolQueue:
             if reason is None:
                 n_open = sum(
                     1 for j in self.jobs.values()
-                    if j.get("state") in ("queued", "running")
+                    if j.get("state") in OPEN_STATES
                 )
                 if n_open >= self.max_queue:
                     reason = (
@@ -534,6 +564,16 @@ class SpoolQueue:
                 entry["deadline_m"] = round(
                     time.monotonic() + float(deadline_s), 3
                 )
+            if spec.shards is not None or spec.shard_bytes is not None:
+                # sharding parent: the phase field decides what a claim
+                # means — "split" until the planner fans the sub-jobs
+                # out, "merge" once the parent is requeued to splice
+                entry["phase"] = "split"
+            if spec.shard is not None:
+                # a directly-spooled sub-job (normally planner-internal):
+                # keep the lineage columns status/serve_report read
+                entry["parent"] = str(spec.shard.get("parent"))
+                entry["shard_idx"] = int(spec.shard.get("idx", 0))
             self.jobs[job_id] = entry
             self.seq += 1
             self.save()
@@ -557,7 +597,7 @@ class SpoolQueue:
         lease = (entry or {}).get("lease")
         if (
             entry is None
-            or entry.get("state") != "running"
+            or entry.get("state") not in CLAIMED_STATES
             or lease is None
             or lease.get("owner") != daemon_id
             or int(entry.get("token", 0)) != int(token)
@@ -582,7 +622,16 @@ class SpoolQueue:
                 return None
             token = int(entry.get("token", 0)) + 1
             entry["token"] = token
-            entry["state"] = "running"
+            # stage-aware claim: a sharding parent's claim means
+            # planning ("split" phase) or merging ("merge" phase), and
+            # the journal says which — all three literals are leased
+            # states under the same fence/takeover machinery
+            phase = entry.get("phase")
+            entry["state"] = (
+                "splitting" if phase == "split"
+                else "merging" if phase == "merge"
+                else "running"
+            )
             entry["slices"] = int(entry.get("slices", 0)) + 1
             entry["lease"] = {
                 "owner": daemon_id,
@@ -642,7 +691,7 @@ class SpoolQueue:
             for entry in self.jobs.values():
                 lease = entry.get("lease")
                 if (
-                    entry.get("state") == "running"
+                    entry.get("state") in CLAIMED_STATES
                     and lease is not None
                     and lease.get("owner") == daemon_id
                 ):
@@ -677,7 +726,7 @@ class SpoolQueue:
         with self._txn():
             reclaimed = []
             for job_id, entry in self.jobs.items():
-                if entry.get("state") != "running":
+                if entry.get("state") not in CLAIMED_STATES:
                     continue
                 lease = entry.get("lease")
                 reason = None
@@ -728,7 +777,7 @@ class SpoolQueue:
         with self._txn():
             reclaimed = []
             for job_id, entry in self.jobs.items():
-                if entry.get("state") != "running":
+                if entry.get("state") not in CLAIMED_STATES:
                     continue
                 progress_m = entry.get("progress_m")
                 if progress_m is None:
@@ -831,6 +880,181 @@ class SpoolQueue:
             json.dumps(payload, sort_keys=True).encode(),
             tmp=unique_tmp(path),
         )
+
+    # --------------------------------------------- scatter-gather sharding
+
+    def _shard_rollup(self, entry: dict) -> dict:
+        """A parent's aggregate view of its sub-jobs: K done/claimed/
+        queued plus the first failure's reason — what ``call --status
+        <parent>`` and ``--wait`` progress read. Children are protected
+        from compaction while the parent is open, so the journal always
+        answers."""
+        counts = {"n_shards": len(entry.get("children", ())),
+                  "done": 0, "running": 0, "queued": 0, "failed": 0}
+        compacted = 0
+        first_failure = None
+        for cid in entry.get("children", ()):
+            c = self.jobs.get(cid)
+            if c is None:
+                # child entry compacted away — only possible once the
+                # parent itself is terminal (open parents protect their
+                # children from compaction), so this is history, not a
+                # failure: the durable results/ file remains the record
+                compacted += 1
+                continue
+            state = c.get("state")
+            if state == "done":
+                counts["done"] += 1
+            elif state in CLAIMED_STATES:
+                counts["running"] += 1
+            elif state == "queued":
+                counts["queued"] += 1
+            else:
+                counts["failed"] += 1
+                if first_failure is None:
+                    first_failure = {
+                        "shard": cid,
+                        "state": state,
+                        "error": c.get("error"),
+                    }
+        if compacted:
+            counts["compacted"] = compacted
+        if first_failure is not None:
+            counts["first_failure"] = first_failure
+        return counts
+
+    def register_shards(
+        self, parent_id: str, daemon_id: str, token: int,
+        child_dicts: list[dict],
+    ) -> int:
+        """The split transaction (fault site ``serve.split`` at the
+        caller): register the planned sub-jobs as ordinary queued
+        entries and move the fenced parent splitting -> fanned, all in
+        one durable journal write. Idempotent under re-planning: child
+        ids derive from (parent_id, shard_idx), so a kill between plan
+        and save — or a takeover mid-split — re-registers the same ids
+        and dedupes exactly like inbox re-admission. Returns the number
+        of children newly registered."""
+        from duplexumiconsensusreads_tpu.serve.job import validate_spec
+
+        registered = 0
+        with self._txn():
+            parent = self._check_fence(parent_id, daemon_id, token)
+            children = []
+            for d in child_dicts:
+                spec = validate_spec(d)  # the daemon never trusts a dict
+                children.append(spec.job_id)
+                if spec.job_id in self.jobs:
+                    continue  # re-plan after a kill: already registered
+                entry = {
+                    "state": "queued",
+                    "seq": self.seq,
+                    "priority": spec.priority,
+                    "spec": spec.to_dict(),
+                    "slices": 0,
+                    "chunks_done": 0,
+                    "admitted_m": round(time.monotonic(), 3),
+                    "parent": parent_id,
+                    "shard_idx": int((spec.shard or {}).get("idx", 0)),
+                }
+                deadline_m = parent.get("deadline_m")
+                if deadline_m is not None:
+                    # children inherit the parent's admission-stamped
+                    # expiry: the deadline bounds the whole pipeline
+                    entry["deadline_m"] = deadline_m
+                self.jobs[spec.job_id] = entry
+                self.seq += 1
+                registered += 1
+            parent["children"] = children
+            parent["n_shards"] = len(children)
+            parent["state"] = "fanned"
+            parent.pop("lease", None)
+            self.save()
+            return registered
+
+    def advance_parents(self) -> list[dict]:
+        """One parent sweep (fault site ``serve.merge`` at the caller):
+        every ``fanned`` parent whose sub-jobs all published is requeued
+        as a merge task (phase "merge", ORIGINAL seq — the merge is the
+        oldest work its class has); a parent with a terminally-failed
+        sub-job goes terminal ``failed`` with a durable diagnosis
+        naming the shard, and its still-queued siblings are failed
+        alongside (running ones finish harmlessly — their outputs are
+        never read). Returns [{job_id, decision, ...}] for the
+        service's events/counters."""
+        with self._txn():
+            moved = []
+            for job_id, entry in list(self.jobs.items()):
+                if entry.get("state") != "fanned":
+                    continue
+                rollup = self._shard_rollup(entry)
+                if rollup["failed"]:
+                    first = rollup.get("first_failure", {})
+                    error = (
+                        f"shard {first.get('shard')} "
+                        f"{first.get('state')}: {first.get('error')}"
+                    )
+                    self._write_terminal_result(
+                        job_id,
+                        {"error": error[:2000], "shard_failure": first},
+                    )
+                    entry["state"] = "failed"
+                    entry["error"] = error[:500]
+                    for cid in entry.get("children", ()):
+                        c = self.jobs.get(cid)
+                        if c is not None and c.get("state") == "queued":
+                            reason = f"parent {job_id} failed: {error}"
+                            self._write_terminal_result(
+                                cid, {"error": reason[:2000]},
+                            )
+                            c["state"] = "failed"
+                            c["error"] = reason[:500]
+                    moved.append({
+                        "job_id": job_id, "decision": "failed",
+                        "shard_failure": first,
+                    })
+                elif rollup["done"] == rollup["n_shards"]:
+                    entry["state"] = "queued"
+                    entry["phase"] = "merge"
+                    moved.append({
+                        "job_id": job_id, "decision": "merge",
+                        "n_shards": rollup["n_shards"],
+                    })
+            # orphan reaping: a child that was RUNNING when its parent
+            # failed escapes the sibling cancellation above — it later
+            # preempts (or is takeover-requeued) back to "queued", and
+            # without this sweep the fleet would keep re-claiming and
+            # running it forever for a result nothing will ever read
+            for job_id, entry in self.jobs.items():
+                if entry.get("state") != "queued":
+                    continue
+                parent_id = entry.get("parent")
+                if parent_id is None:
+                    continue
+                parent = self.jobs.get(parent_id)
+                if parent is None:
+                    # no journal entry for the parent at all: this is a
+                    # DIRECTLY-spooled sub-job (debug/re-run — submit()
+                    # admits those on purpose), not an orphan; only a
+                    # journaled-terminal parent proves the merge is dead
+                    continue
+                if parent.get("state") not in TERMINAL_STATES:
+                    continue
+                reason = (
+                    f"parent {parent_id} is terminal "
+                    f"({parent.get('state')}): "
+                    f"orphaned shard will never be merged"
+                )
+                self._write_terminal_result(job_id, {"error": reason})
+                entry["state"] = "failed"
+                entry["error"] = reason[:500]
+                moved.append({
+                    "job_id": job_id, "decision": "orphaned",
+                    "parent": parent_id,
+                })
+            if moved:
+                self.save()
+            return moved
 
     # ---------------------------------------------------------- deadlines
 
@@ -1002,8 +1226,44 @@ class SpoolQueue:
             output = (entry.get("spec") or {}).get("output")
             if not output:
                 continue
+            parent_id = entry.get("parent")
+            if parent_id is not None:
+                parent = self.jobs.get(parent_id)
+                if parent is None or parent.get("state") in TERMINAL_STATES:
+                    # a shard sub-job's published output is intermediate:
+                    # once its parent is terminal (merged, failed or
+                    # gone) the merge will never read it again — unlike
+                    # user-facing outputs, it IS reclaimable litter
+                    freed += _remove_counting(output)
+            if entry.get("n_shards"):
+                # terminal PARENT: its shard outputs are derivable even
+                # after the child entries compact away (the one case
+                # the per-child branch above cannot see — e.g. a daemon
+                # killed between the merge publish and its cleanup)
+                from duplexumiconsensusreads_tpu.serve.shard.plan import (
+                    shard_output_path,
+                )
+
+                for i in range(int(entry["n_shards"])):
+                    freed += _remove_counting(
+                        shard_output_path(output, i)
+                    )
             for path in (output + ".ckpt", output + ".tmp"):
                 freed += _remove_counting(path)
+            # pid/tid-suffixed staging litter next to the output (an
+            # aborted merge's unique_tmp after a real SIGKILL): the
+            # names are never reused, so only this sweep reclaims them.
+            # Safe against a live zombie merger: its publish-by-rename
+            # of a removed tmp fails loudly and the fence absorbs it.
+            out_dir = os.path.dirname(output) or "."
+            stem = os.path.basename(output) + ".tmp."
+            try:
+                names = os.listdir(out_dir)
+            except OSError:
+                names = []
+            for n in names:
+                if n.startswith(stem):
+                    freed += _remove_counting(os.path.join(out_dir, n))
             shard_dir = output + ".shards"
             try:
                 names = os.listdir(shard_dir)

@@ -74,6 +74,7 @@ from duplexumiconsensusreads_tpu.serve.queue import (
     DISK_LOW_WATER_BYTES,
     LEASE_DEFAULT_S,
     MAX_CRASHES_DEFAULT,
+    OPEN_STATES,
     JobFenced,
     SpoolQueue,
 )
@@ -206,6 +207,10 @@ class ConsensusService:
             "jobs_done": 0, "jobs_failed": 0, "jobs_fenced": 0,
             "preemptions": 0, "jobs_recovered": 0,
             "jobs_expired": 0, "jobs_quarantined": 0, "watchdog_fired": 0,
+            # scatter-gather sharding: parents fanned out (split) and
+            # merged back by THIS daemon — any fleet member may do
+            # either half of a given parent
+            "jobs_split": 0, "jobs_merged": 0,
             # cumulative wire bytes across every slice this daemon
             # committed — rides the heartbeat line and metrics.json, so
             # a long-lived daemon's transfer pressure is live-readable
@@ -604,6 +609,48 @@ class ConsensusService:
                 )
         return n
 
+    def _advance_parents_locked(self) -> list[dict]:
+        """One sharding-parent sweep (caller holds the lock): requeue
+        every fanned parent whose sub-jobs all published as a merge
+        task, and fail parents with a terminally-failed shard. Rides
+        fault site ``serve.merge`` on every pass (like the takeover and
+        deadline sweeps), so chaos schedules can target the merge
+        step's scheduling edge even on passes that move nothing."""
+        tr = self._tr
+        moved = _io_retry(
+            "serve.merge", self.queue.advance_parents, "parent sweep",
+        )
+        for r in moved:
+            if r["decision"] == "failed":
+                self.counters["jobs_failed"] += 1
+                if tr is not None:
+                    first = r.get("shard_failure", {})
+                    tr.event(
+                        "job_failed", job=r["job_id"],
+                        lane=f"job-{r['job_id']}",
+                        error=f"shard {first.get('shard')} "
+                              f"{first.get('state')}: "
+                              f"{str(first.get('error'))[:120]}",
+                        shard=first.get("shard"),
+                    )
+            elif r["decision"] == "orphaned":
+                # a requeued child of an already-terminal parent was
+                # reaped instead of re-run
+                self.counters["jobs_failed"] += 1
+                if tr is not None:
+                    tr.event(
+                        "job_failed", job=r["job_id"],
+                        lane=f"job-{r['job_id']}",
+                        error=f"orphaned shard of terminal parent "
+                              f"{r.get('parent')}",
+                    )
+            elif tr is not None:
+                tr.event(
+                    "resume", job=r["job_id"], lane=f"job-{r['job_id']}",
+                    decision="requeued_merge",
+                )
+        return moved
+
     def _watchdog_sweep(self) -> list[dict]:
         """One stuck-run scan: abort-requeue every running job with no
         durable progress for the stall threshold (the lease/fence path
@@ -662,7 +709,7 @@ class ConsensusService:
             # while the journal holds any open job
             self.queue.refresh()
             open_jobs = any(
-                e.get("state") in ("queued", "running")
+                e.get("state") in OPEN_STATES
                 for e in self.queue.jobs.values()
             )
             return (
@@ -679,6 +726,7 @@ class ConsensusService:
                     self._accept_pending_locked()
                     self._reclaim_locked()
                     self._expire_deadlines_locked()
+                    self._advance_parents_locked()
                     # deadline-aware pick: never claim a job the sweep
                     # (or another daemon's sweep) is about to expire
                     job_id = self.sched.pick(
@@ -711,14 +759,25 @@ class ConsensusService:
                                     time.monotonic() - entry["admitted_m"],
                                 )
                             self._n_running += 1
-                            claimed = (spec, first_slice, token)
+                            # what the claim MEANT is in the journal:
+                            # a sharding parent claims as splitting or
+                            # merging, everything else as running
+                            claimed = (
+                                spec, first_slice, token, entry["state"]
+                            )
                 if claimed is None:
                     if self._idle_done(once):
                         return
                     self._drain.wait(self.poll_s)
                     continue
                 try:
-                    self._run_one(*claimed)
+                    spec, first_slice, token, stage = claimed
+                    if stage == "splitting":
+                        self._run_split(spec, token)
+                    elif stage == "merging":
+                        self._run_merge(spec, token)
+                    else:
+                        self._run_one(spec, first_slice, token)
                 finally:
                     with self._lock:
                         self._n_running -= 1
@@ -743,6 +802,209 @@ class ConsensusService:
             tr.event("job_fenced", job=job_id, lane=lane,
                      detail=detail[:200])
 
+    def _fenced_renew(self, job_id: str, token: int) -> None:
+        """Fence check + lease renewal in one flock'd txn — the planner
+        and merger's commit guard, THE SAME helper a consensus slice's
+        per-chunk guard runs (serve.worker.fenced_renew), so the two
+        stages cannot drift."""
+        from duplexumiconsensusreads_tpu.serve.worker import fenced_renew
+
+        fenced_renew(
+            self.queue, job_id, self.daemon_id, token, self.lease_s
+        )
+
+    def _fail_job(self, job_id: str, lane: str, e: Exception,
+                  token: int) -> None:
+        """Journal a job-scoped failure (fenced) — shared by the slice,
+        split and merge stages. ENOSPC gets the disk-pressure grace
+        pass: before journaling the failure (itself a durable write
+        that needs space), drop terminal jobs' shard/checkpoint litter
+        so the victim fails cleanly and the daemon lives on."""
+        tr = self._tr
+        enospc = isinstance(e, OSError) and e.errno == errno.ENOSPC
+        if enospc:
+            self.queue.gc_terminal_litter()
+        try:
+            with self._lock:
+                self.queue.mark_failed(job_id, repr(e), self.daemon_id, token)
+                self.counters["jobs_failed"] += 1
+        except JobFenced as f:
+            # the job died HERE but was already reclaimed: the new
+            # owner decides its fate; this daemon records nothing
+            self._fenced(job_id, lane, str(f))
+            return
+        if tr is not None:
+            tr.event("job_failed", job=job_id, lane=lane,
+                     error=repr(e)[:200], enospc=enospc)
+
+    def _run_split(self, spec, token: int) -> None:
+        """The parent's split stage: scan the input's chunk grid, plan
+        K range sub-jobs, register them + move the parent to ``fanned``
+        in one fenced journal transaction (fault site ``serve.split``).
+        The scan runs outside any lock or transaction — only the
+        registration is a journal move — and a kill anywhere re-plans
+        idempotently (derived child ids dedupe)."""
+        from duplexumiconsensusreads_tpu.serve.job import job_params
+        from duplexumiconsensusreads_tpu.serve.shard.plan import (
+            child_spec_dicts,
+            plan_shards,
+        )
+
+        tr = self._tr
+        job_id = spec.job_id
+        lane = f"job-{job_id}"
+        if tr is not None:
+            with self._lock:
+                n_slice = self.queue.jobs[job_id]["slices"]
+            tr.event("job_started", job=job_id, lane=lane, slice=n_slice,
+                     stage="split", token=token)
+        t0 = time.monotonic()
+        # the scan is pure host I/O with no chunk commits, so the
+        # watchdog's durable-progress clock would run dry on a large
+        # input: stamp progress (one fenced renewal) at most every
+        # half lease interval while scanning — a wedged scan still
+        # stops stamping and stays watchdog-visible
+        last_renew = [time.monotonic()]
+
+        def scan_progress():
+            now = time.monotonic()
+            if now - last_renew[0] >= self.lease_s / 2:
+                last_renew[0] = now
+                self._fenced_renew(job_id, token)
+
+        try:
+            _, cp, kwargs = job_params(spec)
+            plan = plan_shards(
+                spec.input, kwargs["chunk_reads"],
+                duplex=(cp.mode == "duplex"),
+                n_shards=spec.shards, shard_bytes=spec.shard_bytes,
+                mate_aware=kwargs["mate_aware"],
+                progress=scan_progress,
+                # one parent must not swamp the fleet's open-jobs
+                # bound: the fan-out is capped at the admission bound
+                # the parent itself was admitted under
+                max_shards=self.queue.max_queue,
+            )
+            dicts = child_spec_dicts(spec, plan)
+            # the scan can outlive a lease renewal interval: re-arm
+            # (and fence) before committing the plan
+            self._fenced_renew(job_id, token)
+            _io_retry(
+                "serve.split",
+                lambda: self.queue.register_shards(
+                    job_id, self.daemon_id, token, dicts
+                ),
+                f"job {job_id} shard registration",
+            )
+        except JobFenced as e:
+            self._fenced(job_id, lane, str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — job-scoped failure
+            self._fail_job(job_id, lane, e, token)
+            return
+        with self._lock:
+            self.counters["jobs_split"] += 1
+        if tr is not None:
+            tr.event(
+                "job_split", job=job_id, lane=lane,
+                n_shards=len(dicts), n_chunks=plan.n_chunks,
+                n_records=plan.n_records,
+                wall_s=round(time.monotonic() - t0, 3),
+            )
+
+    def _run_merge(self, spec, token: int) -> None:
+        """The parent's merge stage: splice the per-shard outputs (in
+        shard order) into the final BAM, rebuild its index, publish the
+        aggregate result and journal the parent done — every commit
+        fenced, every durable move on fault site ``serve.merge``. Pure
+        function of the shard files: a kill mid-merge re-runs it
+        whole on whichever daemon claims the parent next."""
+        from duplexumiconsensusreads_tpu.serve.job import job_params
+        from duplexumiconsensusreads_tpu.serve.shard.merge import (
+            splice_shards,
+        )
+        from duplexumiconsensusreads_tpu.serve.shard.plan import (
+            shard_output_path,
+        )
+
+        tr = self._tr
+        job_id = spec.job_id
+        lane = f"job-{job_id}"
+        with self._lock:
+            entry = self.queue.jobs.get(job_id, {})
+            children = list(entry.get("children", ()))
+            n_slice = entry.get("slices", 0)
+        if tr is not None:
+            tr.event("job_started", job=job_id, lane=lane, slice=n_slice,
+                     stage="merge", token=token)
+        t0 = time.monotonic()
+        shard_paths = [
+            shard_output_path(spec.output, i) for i in range(len(children))
+        ]
+        try:
+            _, _, kwargs = job_params(spec)
+            merged = splice_shards(
+                spec.output, shard_paths,
+                fence=lambda: self._fenced_renew(job_id, token),
+                write_index=bool(kwargs["write_index"]),
+            )
+            result = self._aggregate_shard_results(children)
+            result["output"] = os.path.abspath(spec.output)
+            result["sharded"] = {
+                **merged, "merge_s": round(time.monotonic() - t0, 3),
+            }
+            with self._lock:
+                self.queue.mark_done(job_id, result, self.daemon_id, token)
+                self.counters["jobs_done"] += 1
+                self.counters["jobs_merged"] += 1
+        except JobFenced as e:
+            self._fenced(job_id, lane, str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — job-scoped failure
+            self._fail_job(job_id, lane, e, token)
+            return
+        wall = round(time.monotonic() - t0, 3)
+        if tr is not None:
+            tr.event(
+                "job_merged", job=job_id, lane=lane,
+                n_shards=len(shard_paths), merge_s=wall,
+                output_bytes=result["sharded"]["output_bytes"],
+            )
+            tr.event(
+                "job_completed", job=job_id, lane=lane, wall_s=wall,
+                n_chunks=result.get("n_chunks", 0),
+                n_consensus=result.get("n_consensus", 0),
+                warm=False, seconds=result.get("seconds", {}),
+            )
+        # the published merge supersedes the intermediate shard
+        # outputs: reclaim their disk now, not at the next GC pass
+        for p in shard_paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def _aggregate_shard_results(self, children: list[str]) -> dict:
+        """The parent's result: sums of the sub-jobs' durable results —
+        read from results/ (the files outlive journal compaction), so
+        the rollup answers whichever daemon merges."""
+        import json
+
+        totals = {"n_chunks": 0, "n_consensus": 0, "n_records": 0,
+                  "n_consensus_pairs": 0}
+        for cid in children:
+            path = os.path.join(self.queue.results_dir, cid + ".json")
+            try:
+                with open(path) as f:
+                    r = json.load(f)
+            except (OSError, ValueError):
+                continue  # best-effort rollup; the merge bytes are the contract
+            for key in totals:
+                v = r.get(key)
+                if isinstance(v, (int, float)):
+                    totals[key] += int(v)
+        return totals
+
     def _run_one(self, spec, first_slice: bool, token: int) -> None:
         tr = self._tr
         job_id = spec.job_id
@@ -751,9 +1013,18 @@ class ConsensusService:
         if tr is not None:
             with self._lock:
                 n_slice = self.queue.jobs[job_id]["slices"]
+            lineage = {}
+            if spec.shard is not None:
+                # shard lineage on the wire: serve_report's parent
+                # rollup and per-job lineage column read these
+                lineage = {
+                    "parent": spec.shard.get("parent"),
+                    "shard_idx": spec.shard.get("idx"),
+                }
             tr.event(
                 "job_started", job=job_id, lane=lane, slice=n_slice,
                 warm=warm, resumed=not first_slice, token=token,
+                **lineage,
             )
 
         def should_yield() -> bool:
@@ -824,28 +1095,7 @@ class ConsensusService:
                          chunks_done=e.chunks_done)
             return
         except Exception as e:  # noqa: BLE001 — job-scoped failure
-            enospc = isinstance(e, OSError) and e.errno == errno.ENOSPC
-            if enospc:
-                # disk-pressure degradation: before journaling the
-                # failure (itself a durable write that needs space),
-                # drop terminal jobs' shard/checkpoint litter. The
-                # victim fails cleanly with a durable reason; the
-                # daemon — and every other job — lives on.
-                self.queue.gc_terminal_litter()
-            try:
-                with self._lock:
-                    self.queue.mark_failed(
-                        job_id, repr(e), self.daemon_id, token
-                    )
-                    self.counters["jobs_failed"] += 1
-            except JobFenced as f:
-                # the job died HERE but was already reclaimed: the new
-                # owner decides its fate; this daemon records nothing
-                self._fenced(job_id, lane, str(f))
-                return
-            if tr is not None:
-                tr.event("job_failed", job=job_id, lane=lane,
-                         error=repr(e)[:200], enospc=enospc)
+            self._fail_job(job_id, lane, e, token)
             return
         wall = round(time.monotonic() - t0, 3)
         if out[0] == "done":

@@ -92,6 +92,32 @@ class LeaseContext:
     deadline_m: float | None = None
 
 
+def fenced_renew(queue: SpoolQueue, job_id: str, daemon_id: str,
+                 token: int, lease_s: float) -> None:
+    """THE fenced-renewal guard, shared by every stage that commits
+    under a lease (the per-chunk commit guard here, the service's
+    split/merge stages): one flock'd transaction — renew_lease verifies
+    the token first (raising JobFenced through both ladders on a
+    mismatch) and pushes the lease deadline out in the same journal
+    write. The two nested retry ladders keep the fence check and the
+    renewal persist individually targetable by chaos schedules
+    (serve.fence / serve.renew) while transient faults at either site
+    are absorbed. One definition on purpose: two copies of a
+    fencing-critical idiom is how the chaos coverage and the behavior
+    drift apart."""
+    from duplexumiconsensusreads_tpu.runtime.stream import _io_retry
+
+    _io_retry(
+        "serve.fence",
+        lambda: _io_retry(
+            "serve.renew",
+            lambda: queue.renew_lease(job_id, daemon_id, token, lease_s),
+            f"job {job_id} lease renewal",
+        ),
+        f"job {job_id} fence check",
+    )
+
+
 def _ckpt_done_count(out_path: str) -> int:
     """Chunks already durably committed for this output (the auto
     checkpoint's ``done`` map — a gap-free prefix by the frontier
@@ -174,11 +200,30 @@ class WarmWorker:
         ``lease`` (fleet mode) wires the fencing/renewal commit guard —
         see :class:`LeaseContext`."""
         from duplexumiconsensusreads_tpu.runtime.stream import (
-            _io_retry,
             stream_call_consensus,
         )
 
         gp, cp, kwargs = job_params(spec)
+        if spec.shard is not None:
+            # shard sub-job (serve/shard/): run the planner's range on
+            # the parent's whole-file chunk grid. The overrides ride
+            # kwargs only — config (and so the @PG provenance header)
+            # stays the parent's verbatim, which the merge's
+            # header-identity invariant depends on.
+            sh = spec.shard
+            start = sh.get("start")
+            kwargs["input_range"] = (
+                tuple(start) if start is not None else None,
+                sh.get("key_lo"), sh.get("key_hi"),
+            )
+            kwargs["chunk_base"] = int(sh.get("chunk_base", 0))
+            kwargs["first_read"] = sh.get("first_read")
+            # the planner resolved mate_aware against the parent's
+            # first chunk; per-shard auto-resolution must not drift it
+            kwargs["mate_aware"] = sh.get("mate_aware", kwargs["mate_aware"])
+            # the merged output gets the one index; per-shard BAIs
+            # would be thrown away
+            kwargs["write_index"] = False
         n_resumed = _ckpt_done_count(spec.output)
         commits = [0]
         # wire bytes this slice moved, as of its last committed chunk:
@@ -198,27 +243,11 @@ class WarmWorker:
         if lease is not None:
 
             def commit_guard(_k):
-                # pre-commit, on the executor main thread: one fenced
-                # RENEWAL transaction — renew_lease verifies the token
-                # first (raising JobFenced through both ladders on a
-                # mismatch) and pushes the deadline out in the same
-                # flock'd journal write, so the guard costs a single
-                # transaction per chunk. The two nested retry ladders
-                # keep the fence check and the renewal persist
-                # individually targetable by chaos schedules
-                # (serve.fence / serve.renew) while transient faults at
-                # either site are absorbed.
-                _io_retry(
-                    "serve.fence",
-                    lambda: _io_retry(
-                        "serve.renew",
-                        lambda: lease.queue.renew_lease(
-                            spec.job_id, lease.daemon_id, lease.token,
-                            lease.lease_s,
-                        ),
-                        f"job {spec.job_id} lease renewal",
-                    ),
-                    f"job {spec.job_id} fence check",
+                # pre-commit, on the executor main thread: the shared
+                # fenced-renewal guard — one transaction per chunk
+                fenced_renew(
+                    lease.queue, spec.job_id, lease.daemon_id,
+                    lease.token, lease.lease_s,
                 )
 
         def progress(_k, _rep):

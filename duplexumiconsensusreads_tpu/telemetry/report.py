@@ -215,6 +215,9 @@ _JOB_EVENTS = (
     # watchdog aborts are per-job verdicts — anonymous ones cannot be
     # decomposed, same contract as every other job event
     "job_expired", "job_quarantined", "watchdog_fired",
+    # scatter-gather sharding: the parent's stage completions are
+    # job-scoped like every other lifecycle event
+    "job_split", "job_merged",
 )
 
 

@@ -100,6 +100,12 @@ KNOWN_EVENTS = (
     "job_expired",  # deadline passed: terminal, durable reason
     "job_quarantined",  # crash_count hit max_crashes: terminal + diagnosis
     "watchdog_fired",  # no durable progress for watchdog_s: abort-requeue
+    # scatter-gather sharding (serve/shard/): the parent's two stage
+    # completions — sub-jobs registered (attrs: n_shards, n_chunks) and
+    # shard outputs spliced into the final BAM (attrs: merge_s,
+    # output_bytes); the parent still gets the standard job_completed
+    "job_split",  # planner fanned the parent out into K sub-jobs
+    "job_merged",  # shard outputs spliced + indexed into one output
 )
 
 # Byte-ledger directions (the third record kind, ``xfer`` — see

@@ -133,8 +133,9 @@ def test_transient_fault_at_each_site_byte_identical(
     BAM byte-identical to the fault-free run. The serve.* sites live in
     the serving layer, so they are driven through a two-job service
     pass over the same input (equal priorities + chunk_budget=1 forces
-    the preempt path every slice); the stream sites keep the direct
-    streaming run."""
+    the preempt path every slice; the second job is SHARDED so the
+    scatter-gather sites serve.split/serve.merge fire in every pass);
+    the stream sites keep the direct streaming run."""
     path, ref_bytes = sim
     plan = faults.FaultPlan.seeded(
         zlib.crc32(site.encode()), sites=(site,), n_faults=1, max_nth=1
@@ -149,8 +150,8 @@ def test_transient_fault_at_each_site_byte_identical(
             capacity=KW["capacity"], chunk_reads=KW["chunk_reads"],
         )
         outs = [str(tmp_path / f"out{i}.bam") for i in (1, 2)]
-        for o in outs:
-            client.submit(spool, path, o, config=config)
+        client.submit(spool, path, outs[0], config=config)
+        client.submit(spool, path, outs[1], config=config, shards=2)
         ConsensusService(spool, chunk_budget=1).run_until_idle()
         assert plan.n_fired >= 1  # the schedule really injected
         for o in outs:

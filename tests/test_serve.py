@@ -63,6 +63,7 @@ SERVE_SITES = (
     "serve.accept", "serve.journal", "serve.preempt",
     "serve.lease", "serve.renew", "serve.expire", "serve.fence",
     "serve.deadline", "serve.watchdog",
+    "serve.split", "serve.merge",
 )
 FLEET_SITES = ("serve.lease", "serve.renew", "serve.expire", "serve.fence")
 
@@ -1904,3 +1905,696 @@ class TestCliVerbs:
             cli_main(["call", in_path, "-o", out, "--submit",
                       "--spool", spool, "--chunk-reads", "90",
                       "--deadline", "-1"])
+
+
+# --------------------------------------------------- scatter-gather shard
+
+class TestSharding:
+    """serve/shard/: scatter-gather job sharding. The headline contract
+    is A/B byte identity — a sharded job's merged output equals the
+    same job run unsharded, at any K and daemon count, and stays
+    identical under chaos kills at serve.split / serve.merge and a
+    mid-shard daemon death. The parent walks queued -> "splitting" ->
+    "fanned" -> queued -> "merging" -> done in the journal."""
+
+    def _submit_sharded(self, spool, in_path, out, shards, **kw):
+        return client.submit(
+            spool, in_path, out, config=dict(CONFIG), shards=shards, **kw
+        )
+
+    def _run_fleet(self, spool, traces, n=2, **svc_kw):
+        svcs = [
+            ConsensusService(
+                spool, chunk_budget=2, poll_s=0.02, trace_path=traces[i],
+                daemon_id=f"shard-fleet-{i}", **svc_kw,
+            )
+            for i in range(n)
+        ]
+        threads = [
+            threading.Thread(target=s.run_until_idle, daemon=True)
+            for s in svcs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+        return svcs
+
+    # ------------------------------------------------------- the planner
+
+    @pytest.fixture(scope="class")
+    def multi_contig(self, tmp_path_factory):
+        """Multi-contig, unevenly covered input: two contigs, one
+        position hammered with most of the families (uneven coverage),
+        plus an unmapped sentinel tail — the planner must tile it
+        exactly whatever K asks."""
+        import numpy as np
+
+        from duplexumiconsensusreads_tpu.io.bam import (
+            BamHeader,
+            FLAG_UNMAPPED,
+            write_bam,
+        )
+        from duplexumiconsensusreads_tpu.io.convert import readbatch_to_records
+        from duplexumiconsensusreads_tpu.simulate import simulate_batch
+
+        d = tmp_path_factory.mktemp("shard_plan")
+        cfg = SimConfig(n_molecules=90, n_positions=6, umi_error=0.02,
+                        seed=77)
+        batch, _ = simulate_batch(cfg)
+        order = np.argsort(np.asarray(batch.pos_key), kind="stable")
+        batch = batch.take(order)
+        recs = readbatch_to_records(batch, duplex=True)
+        pos = np.asarray(recs.pos)
+        # contig split: everything at/above the median position moves to
+        # contig 1 (order stays sorted: ref 0 block then ref 1 block);
+        # the tail of the file becomes unmapped records (sentinel keys)
+        cut = int(np.median(pos))
+        ref_id = np.asarray(recs.ref_id)
+        ref_id[pos >= cut] = 1
+        flags = np.asarray(recs.flags)
+        n = len(flags)
+        unm = slice(n - max(n // 12, 1), n)
+        ref_id[unm] = -1
+        flags[unm] |= FLAG_UNMAPPED
+        header = BamHeader.synthetic(
+            ref_names=("chr1", "chr2"), ref_lengths=(10_000_000,) * 2,
+            sort_order="coordinate",
+        )
+        path = str(d / "multi.bam")
+        write_bam(path, header, recs)
+        return path, n
+
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_planner_tiles_multi_contig_uneven_exactly(
+        self, multi_contig, k
+    ):
+        """Exact tiling: the shard ranges partition the whole-file
+        chunk grid, and streaming each range yields every record's
+        pos_key exactly once, in order — no read lost, none duplicated
+        at range boundaries (edge families land in exactly one shard),
+        the unmapped tail included."""
+        import numpy as np
+
+        from duplexumiconsensusreads_tpu.runtime.stream import (
+            iter_batch_chunks,
+        )
+        from duplexumiconsensusreads_tpu.serve.shard.plan import plan_shards
+
+        path, n_records = multi_contig
+        plan = plan_shards(path, 64, duplex=True, n_shards=k)
+        assert 1 <= len(plan.ranges) <= k
+        # the ranges partition the chunk grid
+        assert plan.ranges[0].chunk_base == 0
+        for a, b in zip(plan.ranges, plan.ranges[1:]):
+            assert b.chunk_base == a.chunk_base + a.n_chunks
+            assert a.key_hi == b.key_lo
+        last = plan.ranges[-1]
+        assert last.chunk_base + last.n_chunks == plan.n_chunks
+        assert last.key_hi is None
+        assert plan.n_records == n_records
+        assert sum(r.n_records for r in plan.ranges) == n_records
+        # whole-file pos_key sequence == concatenation of the shards'
+        whole = []
+        for _, batch, _info in iter_batch_chunks(path, 64, True,
+                                                 warn_mixed=False):
+            whole.append(np.asarray(batch.pos_key))
+        whole = np.concatenate(whole)
+        got = []
+        for r in plan.ranges:
+            n_chunks = 0
+            for _, batch, _info in iter_batch_chunks(
+                path, 64, True,
+                start=r.start, key_lo=r.key_lo, key_hi=r.key_hi,
+                first_read=r.first_read, warn_mixed=False,
+            ):
+                got.append(np.asarray(batch.pos_key))
+                n_chunks += 1
+            assert n_chunks == r.n_chunks
+        got = np.concatenate(got)
+        assert len(got) == n_records
+        assert (got == whole).all()
+
+    def test_planner_rejects_bad_requests(self, multi_contig):
+        from duplexumiconsensusreads_tpu.serve.shard.plan import plan_shards
+
+        path, _ = multi_contig
+        with pytest.raises(ValueError, match="exactly one"):
+            plan_shards(path, 64, duplex=True)
+        with pytest.raises(ValueError, match="exactly one"):
+            plan_shards(path, 64, duplex=True, n_shards=2, shard_bytes=1)
+
+    # ------------------------------------------ the state machine (unit)
+
+    def test_parent_stage_literals_and_status_rollup(self, tmp_path):
+        """The parent's journal walk, literal by literal: claim of a
+        phase="split" parent is "splitting", registration parks it
+        "fanned", the advance sweep requeues it for merge, and the
+        merge claim is "merging" — with --status aggregating the
+        sub-jobs throughout."""
+        spool = str(tmp_path / "spool")
+        q = SpoolQueue(spool)
+        q.submit(validate_spec(_spec("job-p", shards=2)))
+        spec, reason = q.accept_one("job-p")
+        assert reason is None and q.jobs["job-p"]["phase"] == "split"
+        token = q.claim("job-p", "d1")
+        assert q.jobs["job-p"]["state"] == "splitting"
+        children = [
+            {
+                "job_id": f"job-p.s{i:03d}", "input": "/i.bam",
+                "output": f"/o.bam.shard{i:03d}.bam",
+                "config": dict(CONFIG),
+                "shard": {"parent": "job-p", "idx": i, "k": 2,
+                          "chunk_base": i, "n_chunks": 1,
+                          "key_lo": None, "key_hi": None,
+                          "start": None, "first_read": None},
+            }
+            for i in range(2)
+        ]
+        assert q.register_shards("job-p", "d1", token, children) == 2
+        assert q.jobs["job-p"]["state"] == "fanned"
+        assert q.jobs["job-p"]["children"] == [
+            "job-p.s000", "job-p.s001"
+        ]
+        # registration is idempotent: a re-plan dedupes on derived ids
+        tok2 = None
+        st = q.status("job-p")
+        assert st["shards"] == {
+            "n_shards": 2, "done": 0, "running": 0, "queued": 2,
+            "failed": 0,
+        }
+        # children run the ordinary claimed path
+        for cid in ("job-p.s000", "job-p.s001"):
+            t = q.claim(cid, "d1")
+            assert q.jobs[cid]["state"] == "running"
+            q.mark_done(cid, {"n_consensus": 1}, "d1", t)
+        assert q.status("job-p")["shards"]["done"] == 2
+        moved = q.advance_parents()
+        assert moved == [
+            {"job_id": "job-p", "decision": "merge", "n_shards": 2}
+        ]
+        entry = q.jobs["job-p"]
+        assert entry["state"] == "queued" and entry["phase"] == "merge"
+        tok2 = q.claim("job-p", "d2")
+        assert q.jobs["job-p"]["state"] == "merging"
+        assert tok2 == token + 1  # the merge claim fences the planner
+        q.mark_done("job-p", {"n_consensus": 2}, "d2", tok2)
+        assert q.jobs["job-p"]["state"] == "done"
+
+    def test_failed_shard_fails_parent_with_diagnosis(self, tmp_path):
+        """A terminally-failed sub-job fails the parent with a durable
+        diagnosis naming the shard; queued siblings are failed
+        alongside instead of running for a dead parent."""
+        spool = str(tmp_path / "spool")
+        q = SpoolQueue(spool)
+        q.submit(validate_spec(_spec("job-p", shards=2)))
+        q.accept_one("job-p")
+        token = q.claim("job-p", "d1")
+        children = [
+            {
+                "job_id": f"job-p.s{i:03d}", "input": "/i.bam",
+                "output": f"/o.bam.shard{i:03d}.bam",
+                "config": dict(CONFIG),
+                "shard": {"parent": "job-p", "idx": i, "k": 2,
+                          "chunk_base": i, "n_chunks": 1,
+                          "key_lo": None, "key_hi": None,
+                          "start": None, "first_read": None},
+            }
+            for i in range(2)
+        ]
+        q.register_shards("job-p", "d1", token, children)
+        t = q.claim("job-p.s000", "d1")
+        q.mark_failed("job-p.s000", "boom: not a BAM", "d1", t)
+        moved = q.advance_parents()
+        assert moved[0]["decision"] == "failed"
+        entry = q.jobs["job-p"]
+        assert entry["state"] == "failed"
+        assert "job-p.s000" in entry["error"]
+        # the durable result names the shard (survives compaction)
+        st = q.status("job-p")
+        assert st["result"]["shard_failure"]["shard"] == "job-p.s000"
+        assert "boom" in st["result"]["shard_failure"]["error"]
+        # the queued sibling was failed alongside
+        assert q.jobs["job-p.s001"]["state"] == "failed"
+        assert "parent" in q.jobs["job-p.s001"]["error"]
+
+    def test_requeued_orphan_of_failed_parent_is_reaped_not_rerun(
+        self, tmp_path
+    ):
+        """A child that was RUNNING when its parent failed escapes the
+        sibling cancellation; when it later requeues (preempt or
+        takeover) the sweep must reap it instead of letting the fleet
+        re-run work nothing will ever merge."""
+        spool = str(tmp_path / "spool")
+        q = SpoolQueue(spool)
+        q.submit(validate_spec(_spec("job-p", shards=2)))
+        q.accept_one("job-p")
+        token = q.claim("job-p", "d1")
+        children = [
+            {
+                "job_id": f"job-p.s{i:03d}", "input": "/i.bam",
+                "output": f"/o.bam.shard{i:03d}.bam",
+                "config": dict(CONFIG),
+                "shard": {"parent": "job-p", "idx": i, "k": 2,
+                          "chunk_base": i, "n_chunks": 1,
+                          "key_lo": None, "key_hi": None,
+                          "start": None, "first_read": None},
+            }
+            for i in range(2)
+        ]
+        q.register_shards("job-p", "d1", token, children)
+        # shard 1 is mid-slice when shard 0 fails the parent
+        t1 = q.claim("job-p.s001", "d1")
+        t0 = q.claim("job-p.s000", "d1")
+        q.mark_failed("job-p.s000", "boom", "d1", t0)
+        assert q.advance_parents()[0]["decision"] == "failed"
+        assert q.jobs["job-p"]["state"] == "failed"
+        assert q.jobs["job-p.s001"]["state"] == "running"  # escaped
+        # ... then preempts back to the queue
+        q.requeue("job-p.s001", 1, back=False, daemon_id="d1", token=t1)
+        moved = q.advance_parents()
+        assert {"job_id": "job-p.s001", "decision": "orphaned",
+                "parent": "job-p"} in moved
+        assert q.jobs["job-p.s001"]["state"] == "failed"
+        assert "orphaned" in q.jobs["job-p.s001"]["error"]
+        # and the scheduler has nothing left to pick
+        assert FairScheduler.pick(q.jobs) is None
+        # a directly-spooled sub-job with NO journaled parent is a
+        # deliberate debug/re-run, not an orphan: the sweep leaves it
+        q.submit(validate_spec({
+            "job_id": "job-lone.s000", "input": "/i.bam",
+            "output": "/lone.shard000.bam", "config": dict(CONFIG),
+            "shard": {"parent": "job-lone", "idx": 0, "k": 1,
+                      "chunk_base": 0},
+        }))
+        q.accept_one("job-lone.s000")
+        assert q.advance_parents() == []
+        assert q.jobs["job-lone.s000"]["state"] == "queued"
+
+    def test_compaction_protects_children_of_open_parents(self, tmp_path):
+        """A done sub-job must survive journal compaction while its
+        parent is open: the advance sweep decides the merge from the
+        children's journal states."""
+        spool = str(tmp_path / "spool")
+        q = SpoolQueue(spool)
+        q.max_terminal_kept = 0  # compact every terminal entry away
+        q.submit(validate_spec(_spec("job-p", shards=1)))
+        q.accept_one("job-p")
+        token = q.claim("job-p", "d1")
+        q.register_shards("job-p", "d1", token, [{
+            "job_id": "job-p.s000", "input": "/i.bam",
+            "output": "/o.bam.shard000.bam", "config": dict(CONFIG),
+            "shard": {"parent": "job-p", "idx": 0, "k": 1,
+                      "chunk_base": 0, "n_chunks": 1, "key_lo": None,
+                      "key_hi": None, "start": None, "first_read": None},
+        }])
+        t = q.claim("job-p.s000", "d1")
+        q.mark_done("job-p.s000", {"n_consensus": 1}, "d1", t)
+        # the save inside mark_done ran compaction with
+        # max_terminal_kept=0 — the done child must still be there
+        assert q.jobs["job-p.s000"]["state"] == "done"
+        assert q.advance_parents()[0]["decision"] == "merge"
+
+    # ----------------------------------------------- the A/B acceptance
+
+    def test_sharded_fleet_byte_identical_and_observable(
+        self, sim, tmp_path
+    ):
+        """THE acceptance A/B: one job scattered at K=4 across 2
+        daemons merges byte-identical to the unsharded reference, with
+        the lifecycle observable end to end (rollup, events, lineage,
+        serve_report)."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "sharded.bam")
+        traces = [str(tmp_path / f"svc{i}.jsonl") for i in (0, 1)]
+        jid = self._submit_sharded(spool, in_path, out, shards=4)
+        svcs = self._run_fleet(spool, traces)
+        st = client.status(spool, jid)
+        assert st["state"] == "done"
+        assert st["shards"] == {
+            "n_shards": 4, "done": 4, "running": 0, "queued": 0,
+            "failed": 0,
+        }
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        assert st["result"]["n_consensus"] > 0
+        assert st["result"]["sharded"]["n_shards"] == 4
+        # split and merge each happened exactly once, fleet-wide
+        assert sum(s.counters["jobs_split"] for s in svcs) == 1
+        assert sum(s.counters["jobs_merged"] for s in svcs) == 1
+        events = []
+        for tp in traces:
+            recs, ev = _events(tp)
+            assert trace_report.validate_service_trace(recs) == []
+            events += ev
+        assert len([e for e in events if e["name"] == "job_split"]) == 1
+        assert len([e for e in events if e["name"] == "job_merged"]) == 1
+        completed = [e for e in events if e["name"] == "job_completed"]
+        # 4 children + 1 parent, each exactly once across the fleet
+        assert sorted(e["job"] for e in completed) == sorted(
+            [jid] + [f"{jid}.s{i:03d}" for i in range(4)]
+        )
+        # lineage attrs ride the child job_started events
+        child_starts = [
+            e for e in events
+            if e["name"] == "job_started" and e.get("parent") == jid
+        ]
+        assert {e["shard_idx"] for e in child_starts} == {0, 1, 2, 3}
+        # intermediate shard outputs are reclaimed after the merge
+        assert not [
+            p for p in os.listdir(tmp_path) if ".shard" in p
+        ]
+        # serve_report rolls the parent up with its shard states
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_report.py"),
+             traces[0], "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0, p.stderr
+        rep = json.loads(p.stdout)
+        assert jid in rep.get("parents", {})
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_report.py"),
+             traces[0]],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0 and "sharding:" in p.stdout
+
+    def test_k1_degenerates_byte_identical_with_index(
+        self, sim, tmp_path
+    ):
+        """K=1 still runs the full split/fan/merge pipeline and must
+        degenerate to the unsharded path byte-for-byte — merged BAM
+        and rebuilt BAI alike."""
+        from duplexumiconsensusreads_tpu.serve.job import serve_provenance
+
+        in_path, _ = sim
+        config = dict(CONFIG, write_index=True)
+        ref = str(tmp_path / "ref.bam")
+        stream_call_consensus(
+            in_path, ref, GP, CP, capacity=128, chunk_reads=90,
+            provenance_cl=serve_provenance(config), write_index=True,
+        )
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "k1.bam")
+        jid = client.submit(spool, in_path, out, config=config, shards=1)
+        snap = ConsensusService(spool, poll_s=0.02).run_until_idle()
+        assert snap["jobs_split"] == 1 and snap["jobs_merged"] == 1
+        assert client.status(spool, jid)["state"] == "done"
+        with open(out, "rb") as f, open(ref, "rb") as r:
+            assert f.read() == r.read()
+        with open(out + ".bai", "rb") as f, open(ref + ".bai", "rb") as r:
+            assert f.read() == r.read()
+
+    # ------------------------------------------------------------- chaos
+
+    @pytest.mark.parametrize("site,nth", [
+        ("serve.split", 1),  # dies committing the shard plan
+        ("serve.merge", 1),  # dies in the first parent advance sweep
+    ])
+    def test_kill_at_shard_site_then_restart_byte_identical(
+        self, site, nth, sim, tmp_path
+    ):
+        """The shard sites join the kill matrix: wherever the daemon
+        dies, a successor converges to the identical merged bytes with
+        children registered (and the merge published) exactly once."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "out.bam")
+        jid = self._submit_sharded(spool, in_path, out, shards=3)
+        faults.install(faults.FaultPlan.parse(f"{site}:{nth}:kill"))
+        with pytest.raises(faults.InjectedKill):
+            ConsensusService(spool, poll_s=0.02).run_until_idle()
+        faults.uninstall()
+        if site == "serve.split":
+            # the kill landed inside the split txn: the journal must
+            # show the parent claimed for splitting under a lease the
+            # successor can reclaim
+            entry = SpoolQueue(spool).jobs[jid]
+            assert entry["state"] == "splitting"
+        t2 = str(tmp_path / "svc2.jsonl")
+        ConsensusService(spool, poll_s=0.02, trace_path=t2).run_until_idle()
+        st = client.status(spool, jid)
+        assert st["state"] == "done"
+        assert st["shards"]["done"] == st["shards"]["n_shards"] == 3
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        _, ev = _events(t2)
+        assert len([
+            e for e in ev
+            if e["name"] == "job_completed" and e["job"] == jid
+        ]) == 1
+
+    def test_kill_mid_splice_then_takeover_remerges_exactly_once(
+        self, sim, tmp_path
+    ):
+        """Daemon A dies between shard splices (merge half-written to
+        its staging file); daemon B reclaims the merging parent and
+        re-merges from scratch — exactly one completion, identical
+        bytes, A's token fenced."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "out.bam")
+        jid = self._submit_sharded(spool, in_path, out, shards=3)
+        t_a = str(tmp_path / "svcA.jsonl")
+        svc_a = ConsensusService(
+            spool, poll_s=0.02, trace_path=t_a, daemon_id="merge-victim",
+        )
+        orig = svc_a._fenced_renew
+        fences = [0]
+
+        def dying_fence(job_id, token):
+            # fence 1 = the split stage's pre-registration renewal;
+            # fences 2.. = the merge splice guards. Die on the SECOND
+            # merge fence: the staging file already holds shard 0
+            if job_id == jid:
+                fences[0] += 1
+                if fences[0] == 3:
+                    raise faults.InjectedKill("die mid-splice")
+            orig(job_id, token)
+
+        svc_a._fenced_renew = dying_fence
+        with pytest.raises(faults.InjectedKill):
+            svc_a.run_until_idle()
+        entry = SpoolQueue(spool).jobs[jid]
+        assert entry["state"] == "merging"  # died holding the merge lease
+        t_b = str(tmp_path / "svcB.jsonl")
+        snap_b = ConsensusService(
+            spool, poll_s=0.02, trace_path=t_b, daemon_id="merge-b",
+        ).run_until_idle()
+        assert snap_b["jobs_merged"] == 1
+        st = client.status(spool, jid)
+        assert st["state"] == "done"
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        completed = []
+        for tp in (t_a, t_b):
+            _, ev = _events(tp)
+            completed += [
+                e for e in ev
+                if e["name"] == "job_completed" and e["job"] == jid
+            ]
+        assert len(completed) == 1
+
+    def test_mid_shard_sigkill_takeover_byte_identical(
+        self, sim, tmp_path
+    ):
+        """Daemon A dies mid-CHILD-slice (the modelled SIGKILL, lease
+        still journaled); daemon B takes the sub-job over, resumes its
+        checkpoint, finishes the remaining shards AND the merge —
+        byte-identical, exactly once."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "out.bam")
+        jid = self._submit_sharded(spool, in_path, out, shards=3)
+        t_a = str(tmp_path / "svcA.jsonl")
+        svc_a = ConsensusService(
+            spool, chunk_budget=0, poll_s=0.02, trace_path=t_a,
+            daemon_id="shard-victim",
+        )
+        orig = svc_a.worker.run_slice
+
+        def dying_run_slice(spec, budget, should_yield, drain_event,
+                            lease=None):
+            if spec.shard is None:
+                return orig(spec, budget, should_yield, drain_event,
+                            lease=lease)
+
+            def die():
+                raise faults.InjectedKill("mid-shard daemon death")
+
+            # budget=1: the first fresh chunk commits durably, then the
+            # yield check kills the daemon with the lease still held
+            return orig(spec, 1, die, drain_event, lease=lease)
+
+        svc_a.worker.run_slice = dying_run_slice
+        with pytest.raises(faults.InjectedKill):
+            svc_a.run_until_idle()
+        t_b = str(tmp_path / "svcB.jsonl")
+        snap_b = ConsensusService(
+            spool, poll_s=0.02, trace_path=t_b, daemon_id="shard-b",
+        ).run_until_idle()
+        assert snap_b["jobs_recovered"] >= 1  # the dead child takeover
+        assert snap_b["jobs_merged"] == 1
+        st = client.status(spool, jid)
+        assert st["state"] == "done"
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        completed = []
+        for tp in (t_a, t_b):
+            _, ev = _events(tp)
+            completed += [
+                e["job"] for e in ev if e["name"] == "job_completed"
+            ]
+        assert sorted(completed) == sorted(
+            [jid] + [f"{jid}.s{i:03d}" for i in range(3)]
+        )
+
+    # --------------------------------------------------------- CLI verbs
+
+    def test_cli_submit_shards_flag_round_trips(self, sim, tmp_path,
+                                                capsys):
+        from duplexumiconsensusreads_tpu.cli.main import main as cli_main
+
+        in_path, _ = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "cli.bam")
+        rc = cli_main([
+            "call", in_path, "-o", out, "--submit", "--spool", spool,
+            "--grouping", "adjacency", "--mode", "duplex",
+            "--capacity", "128", "--chunk-reads", "90", "--shards", "4",
+        ])
+        assert rc == 0
+        jid = capsys.readouterr().out.strip()
+        q = SpoolQueue(spool)
+        spec, reason = q.accept_one(jid)
+        assert reason is None and spec.shards == 4
+        assert q.jobs[jid]["phase"] == "split"
+        # sharding flags are a --submit contract, refused elsewhere
+        with pytest.raises(SystemExit, match="shards"):
+            cli_main(["call", in_path, "-o", out, "--chunk-reads", "90",
+                      "--shards", "2"])
+        with pytest.raises(SystemExit, match="mutually"):
+            cli_main(["call", in_path, "-o", out, "--submit",
+                      "--spool", spool, "--chunk-reads", "90",
+                      "--shards", "2", "--shard-bytes", "1000"])
+        with pytest.raises(SystemExit, match="shards"):
+            cli_main(["call", in_path, "-o", out, "--submit",
+                      "--spool", spool, "--chunk-reads", "90",
+                      "--shards", "0"])
+
+    def test_aborted_merge_leaks_no_staging_file(self, sim, tmp_path):
+        """A merge that fails (or is fenced/killed in-process) must not
+        leave its output-sized staging tmp behind — the pid/tid-unique
+        name is never reused, so nothing else would reclaim it."""
+        from duplexumiconsensusreads_tpu.serve.shard.merge import (
+            splice_shards,
+        )
+
+        out = str(tmp_path / "merged.bam")
+        with pytest.raises(ValueError, match="finalised"):
+            # a shard that is not a finalised BAM fails the span scan
+            bad = tmp_path / "bad.shard000.bam"
+            bad.write_bytes(b"not a bam at all")
+            splice_shards(out, [str(bad)])
+        # and a failure mid-splice (second shard vanishes) cleans up too
+        in_path, _ = sim
+        good = str(tmp_path / "good.bam")
+        stream_call_consensus(in_path, good, GP, CP, capacity=128,
+                              chunk_reads=90)
+        with pytest.raises(FileNotFoundError):
+            splice_shards(out, [good, str(tmp_path / "gone.bam")])
+        litter = [n for n in os.listdir(tmp_path) if ".tmp" in n]
+        assert litter == []
+
+    def test_rollup_counts_compacted_children_as_history_not_failed(
+        self, tmp_path
+    ):
+        """Once the parent is terminal its children may compact away;
+        --status must report them as compacted history, never as
+        failures with a bogus first_failure."""
+        spool = str(tmp_path / "spool")
+        q = SpoolQueue(spool)
+        q.submit(validate_spec(_spec("job-p", shards=1)))
+        q.accept_one("job-p")
+        token = q.claim("job-p", "d1")
+        q.register_shards("job-p", "d1", token, [{
+            "job_id": "job-p.s000", "input": "/i.bam",
+            "output": "/o.bam.shard000.bam", "config": dict(CONFIG),
+            "shard": {"parent": "job-p", "idx": 0, "k": 1,
+                      "chunk_base": 0, "n_chunks": 1, "key_lo": None,
+                      "key_hi": None, "start": None, "first_read": None},
+        }])
+        t = q.claim("job-p.s000", "d1")
+        q.mark_done("job-p.s000", {"n_consensus": 1}, "d1", t)
+        q.advance_parents()
+        tok2 = q.claim("job-p", "d1")
+        q.mark_done("job-p", {"n_consensus": 1}, "d1", tok2)
+        del q.jobs["job-p.s000"]  # the compacted-child shape
+        q.save()  # status() reloads the journal, so persist the shape
+        sh = q.status("job-p")["shards"]
+        assert sh["failed"] == 0 and "first_failure" not in sh
+        assert sh["compacted"] == 1
+
+    def test_fanout_capped_at_queue_bound(self, multi_contig):
+        """One parent must not swamp the fleet's open-jobs bound: K is
+        clamped by the caller-supplied cap (the service passes its
+        max_queue)."""
+        from duplexumiconsensusreads_tpu.serve.shard.plan import plan_shards
+
+        path, _ = multi_contig
+        plan = plan_shards(path, 64, duplex=True, n_shards=500,
+                           max_shards=3)
+        assert len(plan.ranges) == 3
+        plan = plan_shards(path, 64, duplex=True, shard_bytes=1,
+                           max_shards=2)
+        assert len(plan.ranges) == 2
+
+    def test_children_inherit_chaos_and_per_shard_trace(self, tmp_path):
+        """--chaos/--trace on a sharded submit must not be silently
+        dropped: the schedule installs per sub-job (the workers), and
+        each child gets its own capture path (K recorders on one file
+        would interleave)."""
+        from duplexumiconsensusreads_tpu.serve.shard.plan import (
+            ShardPlan,
+            ShardRange,
+            child_spec_dicts,
+        )
+
+        parent = validate_spec(_spec(
+            "job-p", shards=2, chaos="shard.write:1:oserror",
+            trace="/t/cap.jsonl", deadline_s=60.0,
+        ))
+        plan = ShardPlan(
+            input="/i.bam", chunk_reads=90, n_chunks=2, n_records=10,
+            mate_aware="off",
+            ranges=(
+                ShardRange(0, 0, 1, None, None, 5, None, 5, 100),
+                ShardRange(1, 1, 1, (0, 9), 5, None, 7, 5, 100),
+            ),
+        )
+        dicts = child_spec_dicts(parent, plan)
+        for i, d in enumerate(dicts):
+            child = validate_spec(d)
+            assert child.chaos == "shard.write:1:oserror"
+            assert child.trace == f"/t/cap.jsonl.s{i:03d}"
+            assert child.deadline_s == 60.0
+            assert child.shard["mate_aware"] == "off"
+            assert child.config == parent.config  # provenance identity
+
+    def test_spec_validation_rejects_bad_shard_fields(self):
+        with pytest.raises(ValueError, match="shards"):
+            validate_spec(_spec(shards=0))
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            validate_spec(_spec(shards=2, shard_bytes=100))
+        with pytest.raises(ValueError, match="shard_bytes"):
+            validate_spec(_spec(shard_bytes=True))
+        with pytest.raises(ValueError, match="cannot itself"):
+            validate_spec(_spec(
+                shards=2,
+                shard={"parent": "p", "idx": 0, "k": 2, "chunk_base": 0},
+            ))
+        with pytest.raises(ValueError, match="required keys"):
+            validate_spec(_spec(shard={"parent": "p"}))

@@ -80,6 +80,20 @@ def summarize(records: list[dict]) -> dict:
             j["slices"] += 1
             if j["warm"] is None:
                 j["warm"] = bool(rec.get("warm"))
+            # shard lineage (serve/shard/): sub-job slices carry their
+            # parent id + shard index; parent slices carry the stage
+            if isinstance(rec.get("parent"), str):
+                j["parent"] = rec["parent"]
+                j["shard_idx"] = rec.get("shard_idx")
+        elif name == "job_split":
+            # the parent fanned out: sub-jobs registered, merge pending
+            j["state"] = "fanned"
+            j["n_shards"] = rec.get("n_shards")
+            j["n_plan_chunks"] = rec.get("n_chunks")
+        elif name == "job_merged":
+            j["n_shards"] = rec.get("n_shards", j.get("n_shards"))
+            j["merge_s"] = rec.get("merge_s")
+            j["merged_bytes"] = rec.get("output_bytes")
         elif name == "job_preempted":
             j["preemptions"] += 1
             j["wall_s"] = round(j["wall_s"] + float(rec.get("wall_s") or 0), 3)
@@ -151,6 +165,32 @@ def summarize(records: list[dict]) -> dict:
         "clean_shutdown": bool(summary),
         "jobs": jobs,
     }
+    # scatter-gather rollup: every job that fanned out (or that shard
+    # sub-jobs point at) gets a parent row aggregating its shards
+    parents: dict[str, dict] = {}
+    for job_id, j in jobs.items():
+        if "n_shards" in j:
+            parents.setdefault(job_id, {}).update({
+                "n_shards": j.get("n_shards"),
+                "state": j["state"],
+                "merge_s": j.get("merge_s"),
+            })
+    for job_id, j in jobs.items():
+        parent = j.get("parent")
+        if not isinstance(parent, str):
+            continue
+        p = parents.setdefault(parent, {})
+        p["n_shard_jobs"] = p.get("n_shard_jobs", 0) + 1
+        p.setdefault("shard_states", {})
+        p["shard_states"][j["state"]] = (
+            p["shard_states"].get(j["state"], 0) + 1
+        )
+    if parents:
+        out["parents"] = parents
+        out["n_split"] = len(parents)
+        out["n_merged"] = sum(
+            1 for p in parents.values() if p.get("merge_s") is not None
+        )
     if isinstance(counters, dict):
         out["service_counters"] = counters
     return out
@@ -216,22 +256,46 @@ def main(argv: list[str] | None = None) -> int:
             f"switchboard: {s['n_fault_events']} injected faults, "
             f"{s['n_retry_events']} retries"
         )
+    if s.get("parents"):
+        # scatter-gather rollup: one line per parent, shard states
+        # aggregated — the fleet-wide progress view of a sharded job
+        print(f"sharding: {s['n_split']} parents fanned out, "
+              f"{s['n_merged']} merged")
+        for pid in sorted(s["parents"]):
+            p = s["parents"][pid]
+            states = ", ".join(
+                f"{n} {st}" for st, n in
+                sorted(p.get("shard_states", {}).items())
+            ) or "no shard slices in capture"
+            merge = (
+                f", merge {p['merge_s']:.3f}s"
+                if isinstance(p.get("merge_s"), (int, float)) else ""
+            )
+            print(f"  {pid}: {p.get('n_shards', '?')} shards "
+                  f"({states}){merge}")
     print(f"{'job':<18} {'state':<11} {'pri':>3} {'slices':>6} "
           f"{'preempt':>7} {'wd':>3} {'wall_s':>8} {'warm':>5} "
-          f"{'h2d_mb':>8} {'d2h_mb':>8} {'B/read':>7}")
+          f"{'h2d_mb':>8} {'d2h_mb':>8} {'B/read':>7} {'lineage':>12}")
     def _mb(v):
         return f"{v / 1e6:.1f}" if isinstance(v, (int, float)) else "-"
 
     for job_id in sorted(s["jobs"]):
         j = s["jobs"][job_id]
         bpr = j.get("bytes_per_read")
+        if isinstance(j.get("parent"), str):
+            lineage = f"{j['parent'][-8:]}#{j.get('shard_idx')}"
+        elif "n_shards" in j:
+            lineage = f"parent/{j.get('n_shards')}"
+        else:
+            lineage = "-"
         print(
             f"{job_id:<18} {j['state']:<11} {str(j.get('priority', '?')):>3} "
             f"{j['slices']:>6} {j['preemptions']:>7} "
             f"{j.get('watchdogs', 0):>3} {j['wall_s']:>8.3f} "
             f"{str(j['warm']):>5} {_mb(j.get('h2d_bytes')):>8} "
             f"{_mb(j.get('d2h_bytes')):>8} "
-            f"{f'{bpr:g}' if isinstance(bpr, (int, float)) else '-':>7}"
+            f"{f'{bpr:g}' if isinstance(bpr, (int, float)) else '-':>7} "
+            f"{lineage:>12}"
         )
         sec = j.get("seconds")
         if isinstance(sec, dict):
