@@ -46,6 +46,14 @@ CANONICAL_METRICS = (
     ("e2e_wire_floor_frac_measured", False, False),
     ("e2e_bytes_per_read", False, False),
     ("e2e_packed_speedup", True, False),
+    # wire diet v2 (PR 11): what the packed consensus-only return path
+    # buys on its own, the H2D rung the canonical leg actually ran
+    # (16/8/7/5 bits per cycle), and the bounded prefetch window —
+    # informational, never gated (rung choice follows the input's qual
+    # alphabet; depth is a config echo)
+    ("e2e_d2h_packed_speedup", True, False),
+    ("e2e_h2d_bits_per_cycle", False, False),
+    ("e2e_prefetch_depth", False, False),
     ("e2e_vs_cpu_e2e", True, False),
     ("serve_amortised_speedup", True, False),
     # defensive serving (PR 9): quarantine depth should sit AT the

@@ -76,7 +76,8 @@ COMPACT_KEYS = (
     "e2e_reads_per_sec", "e2e_wall_s",
     "e2e_wire_floor_frac", "e2e_wire_floor_frac_measured",
     "e2e_wire_h2d_mb_s_measured", "e2e_wire_d2h_mb_s_measured",
-    "e2e_bytes_per_read", "e2e_packed_speedup", "e2e_vs_cpu_e2e",
+    "e2e_bytes_per_read", "e2e_packed_speedup", "e2e_d2h_packed_speedup",
+    "e2e_h2d_bits_per_cycle", "e2e_prefetch_depth", "e2e_vs_cpu_e2e",
     "serve_amortised_speedup", "serve_fleet_takeover_latency_s",
     "serve_quarantine_after_crashes", "serve_watchdog_detect_latency_s",
     "serve_shard_speedup", "serve_shard_merge_s",
@@ -206,10 +207,14 @@ def _e2e_input(n_target: int) -> tuple[str, float]:
     return in_path, sim_s
 
 
-def run_e2e(n_target: int, packed: str = "auto", prefix: str = "e2e") -> dict:
+def run_e2e(
+    n_target: int, packed: str = "auto", prefix: str = "e2e",
+    d2h_packed: str = "auto",
+) -> dict:
     """Stream a cached large simulated BAM through the full pipeline;
     return wall-clock metrics including ingest and write. packed="off"
-    disables the wire packing — the same-run A/B pair the driver
+    disables the H2D wire packing and d2h_packed="off" the packed
+    consensus-only return path — the same-run A/B legs the driver
     captures (VERDICT r3 item 5: a README-only A/B is not evidence).
 
     Every leg records a span capture (DUT_BENCH_TRACE=0 disables) and
@@ -226,6 +231,7 @@ def run_e2e(n_target: int, packed: str = "auto", prefix: str = "e2e") -> dict:
     if int(os.environ.get("DUT_BENCH_TRACE", 1)):
         trace_path = os.path.join(cache, f"{prefix}_trace.jsonl")
     gp, cp = _e2e_params()
+    prefetch_depth = int(os.environ.get("DUT_BENCH_PREFETCH_DEPTH", 2))
     t0 = time.monotonic()
     rep = stream_call_consensus(
         in_path,
@@ -237,6 +243,8 @@ def run_e2e(n_target: int, packed: str = "auto", prefix: str = "e2e") -> dict:
         max_inflight=E2E_MAX_INFLIGHT,
         drain_workers=int(os.environ.get("DUT_BENCH_DRAIN_WORKERS", 2)),
         packed=packed,
+        d2h_packed=d2h_packed,
+        prefetch_depth=prefetch_depth,
         trace_path=trace_path,
     )
     wall = time.monotonic() - t0
@@ -276,6 +284,21 @@ def run_e2e(n_target: int, packed: str = "auto", prefix: str = "e2e") -> dict:
                 extra[f"{prefix}_wire_d2h_mb_s_measured"] = (
                     bw["d2h"]["effective_mb_s"]
                 )
+            # the H2D rung the run actually used, from the ledger's
+            # per-dispatch bpc attrs (modal across fresh chunks): 16 =
+            # unpacked, 8 = byte, 7/5 = the sub-byte qual-dictionary
+            # rungs
+            bpcs = [
+                r["bpc"] for r in trace_ledger.xfer_records(records)
+                if r.get("dir") == "h2d" and "bpc" in r
+            ]
+            if bpcs:
+                extra[f"{prefix}_h2d_bits_per_cycle"] = max(
+                    set(bpcs), key=bpcs.count
+                )
+            pk = trace_ledger.packing_stats(records)
+            if "d2h_packing_ratio" in pk:
+                extra[f"{prefix}_d2h_packing_ratio"] = pk["d2h_packing_ratio"]
         except (OSError, ValueError) as e:
             # telemetry must never sink the bench capture itself
             extra = {f"{prefix}_trace_error": str(e)[:200]}
@@ -327,6 +350,7 @@ def run_e2e(n_target: int, packed: str = "auto", prefix: str = "e2e") -> dict:
         # bounds the wall it cost the run)
         f"{prefix}_phases": {k: v for k, v in rep.seconds.items() if k != "total"},
         f"{prefix}_drain_workers": rep.n_drain_workers,
+        f"{prefix}_prefetch_depth": prefetch_depth,
     }
 
 
@@ -1267,11 +1291,28 @@ def main() -> None:
                 result["e2e_ab_shrunk_to"] = n_ab
             packed_leg = run_e2e(n_ab, packed="auto", prefix="e2e_ab_packed")
             result.update(packed_leg)
-            unpacked = run_e2e(n_ab, packed="off", prefix="e2e_unpacked")
+            unpacked = run_e2e(
+                n_ab, packed="off", d2h_packed="off", prefix="e2e_unpacked"
+            )
             result.update(unpacked)
+            # same fully-unpacked baseline as r1-r5, so the trajectory
+            # stays readable: the speedup now also carries the sub-byte
+            # H2D rung and the packed return path
             result["e2e_packed_speedup"] = round(
                 packed_leg["e2e_ab_packed_reads_per_sec"]
                 / unpacked["e2e_unpacked_reads_per_sec"],
+                3,
+            )
+            # d2h A/B: same H2D rung, packed vs unpacked return path —
+            # isolates what the consensus-only compaction buys
+            d2h_off = run_e2e(
+                n_ab, packed="auto", d2h_packed="off",
+                prefix="e2e_d2h_unpacked",
+            )
+            result.update(d2h_off)
+            result["e2e_d2h_packed_speedup"] = round(
+                packed_leg["e2e_ab_packed_reads_per_sec"]
+                / d2h_off["e2e_d2h_unpacked_reads_per_sec"],
                 3,
             )
         # serve_n_jobs: small jobs through the in-process daemon vs a

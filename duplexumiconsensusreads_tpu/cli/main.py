@@ -209,6 +209,27 @@ def build_parser() -> argparse.ArgumentParser:
         "and the incremental finalise commit in chunk order)",
     )
     c.add_argument(
+        "--packed",
+        choices=["auto", "byte", "off"],
+        default=None,
+        help="streaming wire-packing ladder: auto picks the best "
+        "lossless H2D rung per chunk (sub-byte qual-dictionary where "
+        "the alphabet fits, else base|qual byte); byte caps H2D at "
+        "the byte rung; both pack the consensus-only return path; "
+        "off disables all wire packing. Output bytes are identical at "
+        "every setting (default auto; requires --chunk-reads)",
+    )
+    c.add_argument(
+        "--prefetch-depth",
+        type=int,
+        default=None,
+        help="bounded H2D prefetch window: chunks dispatched (packed + "
+        "device_put) ahead of the drain's materialisation, so host "
+        "packing and H2D of chunk k+1 overlap device compute of chunk "
+        "k (default 2; output bytes identical at any depth; requires "
+        "--chunk-reads)",
+    )
+    c.add_argument(
         "--read-group-id",
         default=None,
         help="output consensus read group id (fgbio-style single @RG on "
@@ -520,7 +541,8 @@ def _load_config_file(path: str) -> dict:
         "backend", "grouping", "mode", "error_model", "max_hamming",
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
         "min_input_qual", "capacity", "devices", "cycle_shards",
-        "chunk_reads", "max_inflight", "drain_workers", "config",
+        "chunk_reads", "max_inflight", "drain_workers", "packed",
+        "prefetch_depth", "config",
         "mate_aware", "max_reads",
         "per_base_tags", "read_group_id", "write_index", "count_ratio",
         "ref_projected", "umi_whitelist", "umi_max_mismatches",
@@ -658,6 +680,17 @@ def _cmd_call(args) -> int:
     drain_workers = opt("drain_workers", 2)
     if drain_workers < 1:
         raise SystemExit(f"--drain-workers must be >= 1 (got {drain_workers})")
+    packed = opt("packed", "auto")
+    prefetch_depth = opt("prefetch_depth", 2)
+    if packed not in ("auto", "byte", "off"):
+        raise SystemExit(
+            f"invalid packed value {packed!r} (allowed: ['auto', 'byte', "
+            f"'off'])"
+        )
+    if prefetch_depth < 1:
+        raise SystemExit(
+            f"--prefetch-depth must be >= 1 (got {prefetch_depth})"
+        )
     mate_aware = opt("mate_aware", "auto")
     max_reads = opt("max_reads", 0)
     if max_reads < 0:
@@ -789,6 +822,8 @@ def _cmd_call(args) -> int:
             "chunk_reads": chunk_reads if chunk_reads > 0 else 500_000,
             "max_inflight": max_inflight,
             "drain_workers": drain_workers,
+            "packed": packed,
+            "prefetch_depth": prefetch_depth,
             "mate_aware": mate_aware,
             "max_reads": max_reads,
             "per_base_tags": per_base_tags,
@@ -837,6 +872,20 @@ def _cmd_call(args) -> int:
         # whole-file path the flag would silently record nothing
         raise SystemExit(
             "--trace requires the streaming executor (--chunk-reads N)"
+        )
+    if chunk_reads <= 0 and (
+        args.packed is not None or args.prefetch_depth is not None
+        or packed != "auto" or prefetch_depth != 2
+    ):
+        # only the streaming executor carries the wire-diet knobs; on
+        # the whole-file path they would be silently inert (a --submit
+        # job always streams, so the keys rode into its config above).
+        # The resolved values are checked too: a config-file
+        # packed/prefetch_depth must be refused exactly like the flag,
+        # not silently dropped
+        raise SystemExit(
+            "--packed/--prefetch-depth require the streaming executor "
+            "(--chunk-reads N)"
         )
     if args.heartbeat:
         if args.heartbeat < 0:
@@ -941,6 +990,8 @@ def _cmd_call(args) -> int:
             n_devices=devices,
             max_inflight=max_inflight,
             drain_workers=drain_workers,
+            packed=packed,
+            prefetch_depth=prefetch_depth,
             checkpoint_path=host_ckpt,
             resume=args.resume,
             report_path=host_report,
@@ -973,6 +1024,8 @@ def _cmd_call(args) -> int:
             n_devices=devices,
             max_inflight=max_inflight,
             drain_workers=drain_workers,
+            packed=packed,
+            prefetch_depth=prefetch_depth,
             checkpoint_path=args.checkpoint,
             resume=args.resume,
             report_path=args.report,

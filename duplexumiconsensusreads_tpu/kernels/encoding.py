@@ -32,3 +32,36 @@ def pack_umi_words(umi_codes: jnp.ndarray) -> jnp.ndarray:
 def one_hot_bases(codes: jnp.ndarray, n: int = 4, dtype=jnp.float32) -> jnp.ndarray:
     """(...,) codes -> (..., n) one-hot; codes >= n produce all-zero rows."""
     return (codes[..., None] == jnp.arange(n, dtype=codes.dtype)).astype(dtype)
+
+
+def unpack_bitplanes(packed: jnp.ndarray, l: int, nbits: int) -> jnp.ndarray:
+    """(..., nbits*ceil(l/8)) u8 bit-planes -> (..., l) u8 codes.
+
+    The wire layout of the sub-byte H2D rung (ops/pipeline.pack_stacked):
+    ``nbits`` separate little-endian bit-planes, each ceil(l/8) bytes,
+    concatenated along the last axis — plane b holds bit b of every
+    cycle's code. Pure VPU shifts/reshapes, so the decode fuses into the
+    first consumers exactly like the byte rung's."""
+    l8 = packed.shape[-1] // nbits
+    planes = packed.reshape(*packed.shape[:-1], nbits, l8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (planes[..., None] >> shifts) & jnp.uint8(1)  # (..., nbits, l8, 8)
+    bits = bits.reshape(*packed.shape[:-1], nbits, l8 * 8)[..., :l]
+    plane_shifts = jnp.arange(nbits, dtype=jnp.uint8)
+    return (bits << plane_shifts[..., :, None]).sum(
+        axis=-2, dtype=jnp.uint8
+    )
+
+
+def pack_2bit(codes: jnp.ndarray) -> jnp.ndarray:
+    """(..., l) u8 codes in {0..3} -> (..., ceil(l/4)) u8, four per byte
+    (little-endian pairs — the device side of the packed-D2H base lane;
+    runtime/executor unpacks with the mirrored NumPy shifts)."""
+    l = codes.shape[-1]
+    pad = (-l) % 4
+    if pad:
+        codes = jnp.pad(codes, [(0, 0)] * (codes.ndim - 1) + [(0, pad)])
+    c4 = codes.reshape(*codes.shape[:-1], -1, 4)
+    return (
+        c4[..., 0] | (c4[..., 1] << 2) | (c4[..., 2] << 4) | (c4[..., 3] << 6)
+    ).astype(jnp.uint8)

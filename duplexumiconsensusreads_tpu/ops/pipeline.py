@@ -72,6 +72,26 @@ class PipelineSpec:
     # true UMI code count, required to un-pack the 2-bit umi bytes
     # (static — the packed width ceil(U/4)*4 over-covers)
     umi_len: int | None = None
+    # Sub-byte H2D rung (the next SURVEY-ladder rung past one byte per
+    # cycle): qual-DICTIONARY packing. The host scans the chunk's real
+    # input-qual alphabet; when it fits 2**packed_qbits - 1 entries the
+    # per-cycle code is base (2 bits) | dictionary index (packed_qbits),
+    # bit-plane packed to 2 + packed_qbits bits/cycle (5 at qbits=3 for
+    # RTA-binned instruments, 7 at qbits=5), with the all-ones index
+    # reserved as the non-evidence marker. Lossless for ANY qual values
+    # (the dictionary carries them verbatim — no 6-bit clip), so this
+    # rung needs no max_input_qual gate; alphabet overflow falls back
+    # to the byte rung per chunk (the packed_io_ok gate generalised to
+    # a per-chunk decision, recorded in the byte ledger).
+    # None = byte rung (packed_io semantics unchanged).
+    packed_qbits: int | None = None
+    # the dictionary itself: sorted tuple of the distinct real-cycle
+    # input quals (static — alphabets are stable per instrument, so the
+    # jit cache absorbs it like any other spec field)
+    qual_lut: tuple | None = None
+    # true cycle count L, required to slice the bit-plane decode (the
+    # packed width nbits*ceil(L/8)*8 over-covers)
+    cycles_len: int | None = None
     # True: also compute per-base disagreement counts (the ce tag) —
     # widens the ssc reduction by 4L count columns, so opt-in
     # (--per-base-tags runs only).
@@ -106,6 +126,37 @@ def _pow2(n: int) -> int:
 PACKED_QUAL_MAX = 62
 PACKED_NONE = 255
 
+# sub-byte rung dictionary widths, smallest first: 3 index bits cover
+# the <= 7-value alphabets RTA-binned instruments emit (5 bits/cycle);
+# 5 bits cover <= 31 values (7 bits/cycle) for wider real-world
+# alphabets. One index pattern (all ones) is reserved per width as the
+# non-evidence marker, hence capacity 2**qbits - 1.
+SUBBYTE_QBITS = (3, 5)
+
+
+def subbyte_qbits_for(alphabet_size: int) -> int | None:
+    """Smallest dictionary width whose capacity covers the alphabet, or
+    None (overflow -> byte-rung fallback)."""
+    for qbits in SUBBYTE_QBITS:
+        if alphabet_size <= (1 << qbits) - 1:
+            return qbits
+    return None
+
+
+def qual_alphabet(buckets) -> tuple:
+    """Sorted distinct input quals at REAL base cycles of valid reads
+    across ``buckets`` — the chunk's qual alphabet, scanned once per
+    chunk and shared by every dispatch class. Non-evidence cycles
+    (N/PAD bases, invalid rows) are excluded: they pack as the NONE
+    marker and must not burn dictionary slots."""
+    import numpy as np
+
+    seen = np.zeros(256, bool)
+    for bk in buckets:
+        sel = (np.asarray(bk.bases) < 4) & np.asarray(bk.valid, bool)[:, None]
+        seen[np.asarray(bk.quals)[sel]] = True
+    return tuple(int(q) for q in np.nonzero(seen)[0])
+
 
 def pack_base_qual(bases: "np.ndarray", quals: "np.ndarray"):
     """Host-side pack of (.., L) u8 base codes + quals into one byte per
@@ -120,22 +171,52 @@ def pack_base_qual(bases: "np.ndarray", quals: "np.ndarray"):
     ).astype(np.uint8)
 
 
-def pack_stacked(stacked: dict) -> dict:
+def pack_stacked(stacked: dict, spec: "PipelineSpec | None" = None) -> dict:
     """Apply the packed-io convention to a stacked bucket dict IN PLACE
     (the host side of spec.packed_io — fused_pipeline decodes):
 
-      bases      base|qual, one byte per cycle (pack_base_qual)
+      bases      byte rung: base|qual, one byte per cycle
+                 (pack_base_qual); sub-byte rung (spec.packed_qbits):
+                 base (2 bits) | qual-dictionary index (qbits),
+                 bit-plane packed to 2+qbits bits per cycle
       umi        2-bit codes, four per byte
-      pos        u16 (bucket-local dense ids < capacity, asserted)
+      pos        u16 (bucket-local dense ids < capacity — the
+                 executors gate oversized classes at partition time,
+                 so the check here is a defensive backstop)
       strand_ab  strand | frag_end<<1 | valid<<2 flag byte
       quals/frag_end/valid  zero-width dummies
 
     Shared by the whole-file and streaming executors so the convention
-    can never desync. Everything is lossless (quals clip at
-    PACKED_QUAL_MAX, gated by the executors' packed_io_ok check)."""
+    can never desync. Everything is lossless: the byte rung clips quals
+    at PACKED_QUAL_MAX (gated by the executors' packed_io_ok check);
+    the sub-byte rung carries the exact quals in spec.qual_lut.
+    ``spec=None`` keeps the original byte-rung-only behaviour."""
     import numpy as np
 
-    stacked["bases"] = pack_base_qual(stacked["bases"], stacked["quals"])
+    if spec is not None and spec.packed_qbits:
+        qbits = spec.packed_qbits
+        lut = np.asarray(spec.qual_lut, np.uint8)
+        nbits = 2 + qbits
+        none_code = np.uint8((((1 << qbits) - 1) << 2) | 3)
+        bases = np.asarray(stacked["bases"])
+        # invalid rows' cycles pack as NONE too: their (possibly
+        # off-dictionary) quals never reach the kernels, which mask on
+        # red/valid everywhere — same dead-distinction argument as the
+        # byte rung's N-vs-PAD collapse
+        real = (bases < 4) & np.asarray(stacked["valid"], bool)[:, :, None]
+        qidx = np.minimum(
+            np.searchsorted(lut, np.asarray(stacked["quals"])), len(lut) - 1
+        ).astype(np.uint8)
+        code = np.where(real, (qidx << 2) | bases, none_code)
+        stacked["bases"] = np.concatenate(
+            [
+                np.packbits((code >> b) & 1, axis=-1, bitorder="little")
+                for b in range(nbits)
+            ],
+            axis=-1,
+        )
+    else:
+        stacked["bases"] = pack_base_qual(stacked["bases"], stacked["quals"])
     stacked["quals"] = np.zeros(stacked["quals"].shape[:2] + (0,), np.uint8)
     u = np.asarray(stacked["umi"])
     b_, r_, w_ = u.shape
@@ -168,6 +249,8 @@ def spec_for_buckets(
     ssc_method: str = "matmul",
     packed_io: bool = False,
     per_base_counts: bool = False,
+    packed_qbits: int | None = None,
+    qual_lut: tuple | None = None,
 ) -> PipelineSpec:
     """Size the static axes from bucket statistics.
 
@@ -191,6 +274,7 @@ def spec_for_buckets(
             per_base_counts=per_base_counts, fit_impl=fit_impl,
         )
     umi_len = int(buckets[0].umi.shape[1]) if packed_io else None
+    cycles_len = int(buckets[0].bases.shape[1]) if packed_qbits else None
     r = buckets[0].capacity
     max_u = max(b.n_unique_umi for b in buckets)
     u_max = min(_pow2(max_u), r)
@@ -208,6 +292,9 @@ def spec_for_buckets(
         presorted=True,  # bucketing's output contract
         packed_io=packed_io,
         umi_len=umi_len,
+        packed_qbits=packed_qbits,
+        qual_lut=qual_lut,
+        cycles_len=cycles_len,
         per_base_counts=per_base_counts,
         fit_impl=fit_impl,
     )
@@ -284,9 +371,30 @@ def fused_pipeline(
         # distinction is dead
         from duplexumiconsensusreads_tpu.constants import BASE_N as _BN
 
-        real_b = bases != PACKED_NONE
-        quals = jnp.where(real_b, bases >> 2, 0).astype(jnp.uint8)
-        bases = jnp.where(real_b, bases & 3, _BN).astype(jnp.uint8)
+        if spec.packed_qbits:
+            # sub-byte rung: bit-plane codes -> (base, dictionary qual)
+            from duplexumiconsensusreads_tpu.kernels.encoding import (
+                unpack_bitplanes,
+            )
+
+            qbits = spec.packed_qbits
+            none_idx = (1 << qbits) - 1
+            code = unpack_bitplanes(bases, spec.cycles_len, 2 + qbits)
+            qidx = (code >> 2) & none_idx
+            none = qidx == none_idx
+            # lut padded to the full index range so the take never
+            # reads out of bounds (the NONE index lands on the pad)
+            lut = jnp.asarray(
+                tuple(spec.qual_lut)
+                + (0,) * (none_idx + 1 - len(spec.qual_lut)),
+                dtype=jnp.uint8,
+            )
+            quals = jnp.where(none, 0, lut[qidx]).astype(jnp.uint8)
+            bases = jnp.where(none, _BN, code & 3).astype(jnp.uint8)
+        else:
+            real_b = bases != PACKED_NONE
+            quals = jnp.where(real_b, bases >> 2, 0).astype(jnp.uint8)
+            bases = jnp.where(real_b, bases & 3, _BN).astype(jnp.uint8)
         # flag byte -> the three bool vectors (frag_end/valid arrive as
         # zero-width dummies)
         flags8 = strand_ab.astype(jnp.uint8)

@@ -21,6 +21,15 @@ from duplexumiconsensusreads_tpu.ops.pipeline import PipelineSpec, fused_pipelin
 _ARRAY_KEYS = ("pos", "umi", "strand_ab", "frag_end", "valid", "bases", "quals")
 
 
+def stacked_nbytes(stacked: dict) -> int:
+    """Bytes of the stacked arrays that actually cross the wire (the
+    _ARRAY_KEYS device_put set). The stacked dict also carries host-only
+    bookkeeping (read_index, n_real_buckets) that shard_stacked never
+    transfers — summing the whole dict would overstate the H2D ledger
+    by ~5% (8 bytes of i64 read_index per read slot)."""
+    return sum(stacked[k].nbytes for k in _ARRAY_KEYS)
+
+
 def shard_stacked(stacked: dict, mesh: Mesh, axis: str = "data") -> dict:
     """Device-put the stacked bucket arrays with bucket-axis sharding.
 

@@ -119,9 +119,12 @@ def write_report(rep: "RunReport", path: str) -> None:
 XFER_WORKERS = 4
 DRAIN_PHASES = ("device_wait_fetch", "scatter", "deflate", "shard_write")
 # rep.seconds entries that are not per-stage busy seconds
-# (main_loop_stall is main-thread blocked-on-back-pressure wall, shown
-# via its dedicated summary line, not a stage row)
-_NON_STAGE_KEYS = ("total", "drain_utilization", "main_loop_stall")
+# (main_loop_stall / prefetch_stall are main-thread blocked wall —
+# back-pressure and the bounded H2D prefetch window respectively —
+# shown via dedicated summary lines, not stage rows)
+_NON_STAGE_KEYS = (
+    "total", "drain_utilization", "main_loop_stall", "prefetch_stall",
+)
 
 
 def busy_wall_table(
@@ -183,6 +186,12 @@ def busy_wall_table(
         lines.append(
             f"main loop stalled on drain back-pressure "
             f"{stall / wall:.0%} of the wall"
+        )
+    pstall = _num(seconds.get("prefetch_stall"))
+    if pstall is not None and wall:
+        lines.append(
+            f"main loop stalled on the H2D prefetch window "
+            f"{pstall / wall:.0%} of the wall"
         )
     return lines, bugs
 
@@ -346,12 +355,12 @@ FETCH_KEYS = (
 )
 
 
-def start_fetch(out: dict, extra: tuple = ()) -> dict:
-    """Select FETCH_KEYS (+ extra, e.g. cons_depth for per-base tags)
+def start_fetch(out: dict, extra: tuple = (), keys: tuple = FETCH_KEYS) -> dict:
+    """Select ``keys`` (+ extra, e.g. cons_depth for per-base tags)
     and start their device->host copies NOW, so every transfer is in
     flight before any is awaited (per-fetch tunnel latency would
     otherwise serialise)."""
-    sel = {k: out[k] for k in (*FETCH_KEYS, *extra)}
+    sel = {k: out[k] for k in (*keys, *extra)}
     for v in sel.values():
         try:
             v.copy_to_host_async()
@@ -367,6 +376,245 @@ def fetch_outputs(out: dict) -> dict:
     # executor's materialize() retry/isolation ladder
     fault_point("fetch.result")
     return {k: np.asarray(v) for k, v in out.items()}
+
+
+# ------------------------------------------------------- packed D2H rung
+#
+# The return path's wire diet (the gap stream.py's d2h ledger records
+# used to label "nothing packs the return path yet"): a device-side
+# epilogue jitted SEPARATELY from the fused pipeline (its static
+# k_pad would otherwise recompile the whole pipeline per chunk) that
+# (1) COMPACTS the (B, F)-padded consensus-row tensors to the valid
+# prefix rows j < n_out[b] via an on-device count + prefix-gather —
+# k_pad is a HOST-side bound from the same grouping invariant that
+# sizes f_max (adjacency can only MERGE exact families, so output
+# units per bucket <= mult * n_unique) — and (2) packs base|qual
+# exploiting the kernels' output coupling (cons_base == BASE_N iff
+# cons_qual == NO_CALL_QUAL, and called quals are clipped >= 2): the
+# qual byte carries 0 as the N marker and bases ride 2-bit, four per
+# byte, so base+qual cost 1.25 bytes/cycle at ANY max_qual instead of
+# 2. Depth stats and the read->id map fit u16 (gated on capacity <
+# 2**16, the same bound as the H2D pos lane), and only the id array
+# the scatter actually consumes is fetched. Unpack (runtime/stream's
+# drain workers, chaos site fetch.unpack) reconstructs the exact
+# unpacked FETCH_KEYS arrays at every position the scatter reads, so
+# output bytes are bit-identical with the rung on or off.
+
+PACKED_FETCH_KEYS = (
+    "n_families",
+    "n_molecules",
+    "ids16",
+    "cons_q",
+    "cons_b2",
+    "cons_flags",
+    "cons_dstats",
+    "cons_pair",
+)
+
+class D2hCompactionOverflow(RuntimeError):
+    """The packed-D2H row bound was violated: the device produced more
+    output units than the grouping invariant allows. Deterministic —
+    a retry re-derives the identical overflow — so the streaming
+    executor's retry/isolation ladder re-raises it immediately instead
+    of burning re-dispatches on it."""
+
+
+_PACK_D2H = None
+
+
+def _pack_d2h_fn():
+    global _PACK_D2H
+    if _PACK_D2H is None:
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+
+        from duplexumiconsensusreads_tpu.constants import N_REAL_BASES
+        from duplexumiconsensusreads_tpu.kernels.encoding import pack_2bit
+
+        @partial(jax.jit, static_argnames=("duplex", "k_pad"))
+        def _pack(out, duplex, k_pad):
+            n_b, f = out["cons_valid"].shape
+            n_out = jnp.clip(
+                out["n_molecules" if duplex else "n_families"], 0, f
+            )
+            offs = jnp.cumsum(n_out)
+            starts = offs - n_out
+            k = jnp.arange(k_pad, dtype=jnp.int32)
+            b = jnp.minimum(
+                jnp.searchsorted(offs, k, side="right"), n_b - 1
+            ).astype(jnp.int32)
+            j = jnp.clip(k - starts[b], 0, f - 1)
+            live = k < offs[-1]
+
+            def g(a):
+                mask = live.reshape((-1,) + (1,) * (a.ndim - 2))
+                return jnp.where(mask, a[b, j], 0)
+
+            base = g(out["cons_base"])  # (K, L) u8
+            qual = g(out["cons_qual"])  # (K, L) u8
+            # the N marker: called quals are >= 2 by the kernels' clip,
+            # so 0 is free — and BASE_N rows always carry NO_CALL_QUAL,
+            # so dropping their qual loses nothing
+            qb = jnp.where(base >= N_REAL_BASES, 0, qual).astype(jnp.uint8)
+            flags = (
+                g(out["cons_valid"].astype(jnp.uint8))
+                | (g(out["cons_mate"]) << 1)
+                | (g(out["cons_end"]) << 2)
+            ).astype(jnp.uint8)
+            ids = out["molecule_id" if duplex else "family_id"]
+            return {
+                "n_families": out["n_families"],
+                "n_molecules": out["n_molecules"],
+                # dense ids live in [-1, F) and F <= capacity < 2**16:
+                # bias by one into u16
+                "ids16": (ids + 1).astype(jnp.uint16),
+                "cons_q": qb,
+                "cons_b2": pack_2bit(base & 3),
+                "cons_flags": flags,
+                "cons_dstats": jnp.stack(
+                    [g(out["depth_max"]), g(out["depth_min_pos"])], axis=1
+                ).astype(jnp.uint16),
+                "cons_pair": g(out["cons_pair"]),
+            }
+
+        _PACK_D2H = _pack
+    return _PACK_D2H
+
+
+def d2h_pack_ok(capacity: int, per_base_tags: bool) -> bool:
+    """Gate for the packed return path: ids/depths must fit u16
+    (capacity bounds both), and per-base-tag runs fetch the full
+    (F, L) depth/err matrices the compact layout does not carry."""
+    return capacity < (1 << 16) and not per_base_tags
+
+
+def d2h_k_pad(cbuckets, spec) -> int:
+    """Static row bound of the compacted consensus transfer: per
+    bucket, output units are bounded by mult * n_unique (the invariant
+    spec_for_buckets' f_max/m_max sizing already rests on), summed over
+    the class and rounded to a power of two so the epilogue's compile
+    count stays bounded. The host-side unpack re-checks the fetched
+    counts against this bound and fails loudly on violation."""
+    from duplexumiconsensusreads_tpu.ops.pipeline import _pow2
+
+    g, duplex = spec.grouping, spec.consensus.mode == "duplex"
+    if duplex:
+        mult = 2 if (g.mate_aware and g.paired) else 1
+        f = spec.m_max or cbuckets[0].capacity
+    else:
+        mult = (2 if g.paired else 1) * (2 if g.mate_aware else 1)
+        f = spec.f_max or cbuckets[0].capacity
+    bound = sum(min(mult * bk.n_unique_umi, f) for bk in cbuckets)
+    # the B*f cap is compile-churn-free even though it isn't a power of
+    # two: the vmapped pipeline's jit is already keyed on the class's
+    # (B, f) shapes, so a k_pad equal to B*f introduces no compile key
+    # the dispatch didn't pay for anyway
+    return min(_pow2(max(bound, 1)), len(cbuckets) * f)
+
+
+def pack_fetch_outputs(out: dict, spec, k_pad: int) -> dict:
+    """Apply the packed-D2H epilogue to a sharded pipeline output dict;
+    returns the compact device dict (PACKED_FETCH_KEYS)."""
+    return _pack_d2h_fn()(out, spec.consensus.mode == "duplex", k_pad)
+
+
+def _unpack_2bit_np(packed: np.ndarray, l: int) -> np.ndarray:
+    """Host mirror of kernels.encoding.pack_2bit."""
+    shifts = np.arange(4, dtype=np.uint8) * 2
+    codes = (packed[..., None] >> shifts) & 3
+    return codes.reshape(*packed.shape[:-1], -1)[..., :l].astype(np.uint8)
+
+
+def unpack_fetch_outputs(fetched: dict, cbuckets, spec) -> dict:
+    """Host-side reconstruction of the exact unpacked FETCH_KEYS arrays
+    from a packed-D2H fetch (dtypes included — byte identity of the
+    final output rests on the scatter seeing indistinguishable inputs).
+    Rows past each bucket's n_out reconstruct as zeros/invalid; the
+    scatter's keep mask never reads them. A dict without the packed
+    marker key passes through untouched."""
+    from duplexumiconsensusreads_tpu.constants import BASE_N, NO_CALL_QUAL
+
+    if "cons_q" not in fetched:
+        return fetched
+    duplex = spec.consensus.mode == "duplex"
+    f = (spec.m_max if duplex else spec.f_max) or cbuckets[0].capacity
+    nf = np.asarray(fetched["n_families"])
+    nm = np.asarray(fetched["n_molecules"])
+    n_b = nf.shape[0]
+    k_pad, l = fetched["cons_q"].shape
+    n_out = np.clip(nm if duplex else nf, 0, f)
+    offs = np.concatenate([[0], np.cumsum(n_out)])
+    total = int(offs[-1])
+    if total > k_pad:
+        # the grouping invariant the bound rests on was violated —
+        # rows were dropped on device; this must never be silent
+        raise D2hCompactionOverflow(
+            f"packed d2h compaction overflow: {total} output rows > "
+            f"bound {k_pad} (grouping invariant violated)"
+        )
+    q = np.asarray(fetched["cons_q"])[:total]
+    b2 = _unpack_2bit_np(np.asarray(fetched["cons_b2"])[:total], l)
+    none = q == 0
+    base_rows = np.where(none, np.uint8(BASE_N), b2)
+    qual_rows = np.where(none, np.uint8(NO_CALL_QUAL), q)
+    flags = np.asarray(fetched["cons_flags"])[:total]
+    dstats = np.asarray(fetched["cons_dstats"])[:total].astype(np.int32)
+    pair_rows = np.asarray(fetched["cons_pair"])[:total]
+
+    b_of = np.repeat(np.arange(n_b), n_out)
+    j_of = np.arange(total) - offs[b_of]
+    cons_base = np.zeros((n_b, f, l), np.uint8)
+    cons_qual = np.zeros((n_b, f, l), np.uint8)
+    cons_valid = np.zeros((n_b, f), bool)
+    depth_max = np.zeros((n_b, f), np.int32)
+    depth_min_pos = np.zeros((n_b, f), np.int32)
+    cons_mate = np.zeros((n_b, f), np.uint8)
+    cons_end = np.zeros((n_b, f), np.uint8)
+    cons_pair = np.zeros((n_b, f), np.int32)
+    cons_base[b_of, j_of] = base_rows
+    cons_qual[b_of, j_of] = qual_rows
+    cons_valid[b_of, j_of] = (flags & 1).astype(bool)
+    cons_mate[b_of, j_of] = (flags >> 1) & 1
+    cons_end[b_of, j_of] = (flags >> 2) & 1
+    depth_max[b_of, j_of] = dstats[:, 0]
+    depth_min_pos[b_of, j_of] = dstats[:, 1]
+    cons_pair[b_of, j_of] = pair_rows
+    return {
+        "n_families": nf,
+        "n_molecules": nm,
+        ("molecule_id" if duplex else "family_id"): (
+            np.asarray(fetched["ids16"]).astype(np.int32) - 1
+        ),
+        "cons_valid": cons_valid,
+        "cons_base": cons_base,
+        "cons_qual": cons_qual,
+        "depth_max": depth_max,
+        "depth_min_pos": depth_min_pos,
+        "cons_mate": cons_mate,
+        "cons_pair": cons_pair,
+        "cons_end": cons_end,
+    }
+
+
+def d2h_logical_nbytes(fetched: dict, cbuckets, spec) -> int:
+    """Bytes the UNPACKED fetch of the same chunk class would have
+    moved — the packed-D2H ledger records' ``logical`` side. Exact
+    integer arithmetic over the FETCH_KEYS shapes/dtypes (both (B, R)
+    i32 id arrays, two (B,) i32 count vectors, and the (B, F[, L])
+    consensus-row tensors)."""
+    if "cons_q" not in fetched:
+        return sum(v.nbytes for v in fetched.values() if hasattr(v, "nbytes"))
+    duplex = spec.consensus.mode == "duplex"
+    f = (spec.m_max if duplex else spec.f_max) or cbuckets[0].capacity
+    n_b = np.asarray(fetched["n_families"]).shape[0]
+    r = np.asarray(fetched["ids16"]).shape[1]
+    _, l = fetched["cons_q"].shape
+    # family_id + molecule_id (i32) + n_families + n_molecules (i32) +
+    # cons_valid (bool) + cons_base/cons_qual (u8) + depth_max/
+    # depth_min_pos (i32) + cons_mate/cons_end (u8) + cons_pair (i32)
+    return 2 * n_b * r * 4 + 2 * n_b * 4 + n_b * f * (1 + 2 * l + 8 + 2 + 4)
 
 
 # In-pipeline measurements on v5e (BENCH_r02/r03 stderr journals, full
@@ -409,6 +657,7 @@ def partition_buckets(
     ssc_method: str | None = None,
     packed_io: bool = False,
     per_base_counts: bool = False,
+    qual_alphabet: tuple | None = None,
 ):
     """Split buckets into dispatch classes of identical geometry+strategy.
 
@@ -421,10 +670,28 @@ def partition_buckets(
     cluster seed by the host (bucketing/buckets.py), so re-clustering
     on device could over-merge seeds whose aggregated counts now
     satisfy the directional edge condition.
+
+    ``packed_io=True`` requests the H2D wire packing; the rung is a
+    PER-CLASS decision made here (never a mid-dispatch failure):
+
+      sub-byte  ``qual_alphabet`` provided and it fits a dictionary
+                (ops.pipeline.subbyte_qbits_for) — 5 or 7 bits/cycle,
+                lossless at any qual cap (the dictionary is exact)
+      byte      alphabet absent/overflowing but the 6-bit payload is
+                lossless (packed_io_ok)
+      off       bucket-local pos ids would overflow the u16 lane
+                (capacity >= 2**16), or no lossless rung exists —
+                the class runs unpacked with a ledgered
+                ``packed_fallback`` event instead of poisoning the
+                bucket through the retry/isolation ladder
     """
     import dataclasses as _dc
 
-    from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
+    from duplexumiconsensusreads_tpu.ops.pipeline import (
+        spec_for_buckets,
+        subbyte_qbits_for,
+    )
+    from duplexumiconsensusreads_tpu.telemetry import trace as _telemetry
 
     if ssc_method is None:
         ssc_method = default_ssc_method()
@@ -432,16 +699,43 @@ def partition_buckets(
     for bk in buckets:
         ucls = 1 << max(bk.n_unique_umi - 1, 0).bit_length()
         classes.setdefault((bk.capacity, bk.preclustered, ucls), []).append(bk)
+    byte_ok = packed_io_ok(consensus)
     out = []
     for key in sorted(classes):
         cbuckets = classes[key]
         g = _dc.replace(grouping, strategy="exact") if key[1] else grouping
+        packed, qbits, lut = packed_io, None, None
+        if packed_io:
+            if key[0] > (1 << 16):
+                # the u16 pos lane can't carry this class's dense ids
+                # (ids < capacity, so capacity 2**16 still fits): run
+                # it unpacked (capacity check at partition time — the
+                # old pack_stacked ValueError surfaced inside the
+                # retry ladder and poisoned the bucket)
+                packed = False
+                _telemetry.emit_event(
+                    "packed_fallback", scope="h2d",
+                    reason="pos-ids-overflow-u16", capacity=key[0],
+                )
+            elif qual_alphabet is not None and subbyte_qbits_for(
+                len(qual_alphabet)
+            ):
+                qbits = subbyte_qbits_for(len(qual_alphabet))
+                lut = tuple(qual_alphabet)
+            elif not byte_ok:
+                packed = False
+                _telemetry.emit_event(
+                    "packed_fallback", scope="h2d",
+                    reason="input-qual-cap-overflows-6-bit",
+                    max_input_qual=consensus.max_input_qual,
+                )
         out.append(
             (
                 cbuckets,
                 spec_for_buckets(
-                    cbuckets, g, consensus, ssc_method, packed_io=packed_io,
+                    cbuckets, g, consensus, ssc_method, packed_io=packed,
                     per_base_counts=per_base_counts,
+                    packed_qbits=qbits, qual_lut=lut,
                 ),
             )
         )
@@ -540,7 +834,7 @@ def call_batch_tpu(
         if cspec.packed_io:
             from duplexumiconsensusreads_tpu.ops.pipeline import pack_stacked
 
-            pack_stacked(stacked)
+            pack_stacked(stacked, cspec)
         pending.append(
             (
                 cbuckets,

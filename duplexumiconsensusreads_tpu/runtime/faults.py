@@ -52,7 +52,9 @@ KNOWN_SITES = (
     "ingest.read",  # file read feeding the rolling BGZF buffer
     "bgzf.inflate",  # block-batch decompression (native or Python)
     "dispatch.device_put",  # stack/pack/device dispatch (xfer worker)
+    "dispatch.pack",  # host-side wire packing of the stacked chunk
     "fetch.result",  # device->host materialisation of outputs
+    "fetch.unpack",  # host-side unpack of packed d2h fetch (drain worker)
     "drain.scatter",  # scatter-back of device outputs (drain worker)
     "shard.write",  # per-chunk shard serialize+deflate+durable rename
     "ckpt.save",  # checkpoint manifest persist

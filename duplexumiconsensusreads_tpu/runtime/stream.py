@@ -5,14 +5,16 @@ this module processes a coordinate-sorted BAM as a pipeline of chunks:
 
   BGZF blocks → rolling decompress → record chunks (holding back the
   trailing pos_key group so no family straddles a boundary) → buckets →
-  ASYNC device dispatch (several chunks in flight — on a tunneled chip
-  each dispatch costs ~100ms fixed latency, so overlap is what turns
-  per-chunk latency into pipeline throughput) → PIPELINED drain (a
-  bounded worker pool runs fetch → scatter → serialize → BGZF deflate →
-  durable shard write off the main loop) → ordered-completion frontier
-  (checkpoint marks and incremental finalise appends commit strictly in
-  chunk order, whatever order drain workers finish in) → final atomic
-  fsync+rename of the single consensus BAM.
+  ASYNC device dispatch (wire-packed per the per-chunk packing ladder;
+  several chunks in flight under the bounded --prefetch-depth window —
+  on a tunneled chip each dispatch costs ~100ms fixed latency, so
+  overlap is what turns per-chunk latency into pipeline throughput) →
+  PIPELINED drain (a bounded worker pool runs packed fetch → unpack →
+  scatter → serialize → BGZF deflate → durable shard write off the
+  main loop) → ordered-completion frontier (checkpoint marks and
+  incremental finalise appends commit strictly in chunk order,
+  whatever order drain workers finish in) → final atomic fsync+rename
+  of the single consensus BAM.
 
 Checkpoint/resume: a JSON manifest records finished chunk shards keyed
 by a parameter fingerprint; re-running with --resume skips completed
@@ -58,16 +60,31 @@ from duplexumiconsensusreads_tpu.io.convert import (
 
 # chunk-boundary key MUST be the grouping key: one shared implementation
 from duplexumiconsensusreads_tpu.io.convert import records_pos_keys as _rec_pos_keys
-from duplexumiconsensusreads_tpu.ops.pipeline import pack_stacked
+from duplexumiconsensusreads_tpu.ops.pipeline import (
+    SUBBYTE_QBITS,
+    pack_stacked,
+    qual_alphabet,
+)
+
+# largest qual alphabet any sub-byte dictionary width can hold; past
+# this the run-level union can never fit again and the per-chunk
+# alphabet scan becomes pure waste (the chunk loop stops scanning)
+_ALPHA_CAP = (1 << max(SUBBYTE_QBITS)) - 1
 from duplexumiconsensusreads_tpu.runtime.executor import (
     DRAIN_PHASES,
+    PACKED_FETCH_KEYS,
+    D2hCompactionOverflow,
     RunReport,
+    d2h_k_pad,
+    d2h_logical_nbytes,
+    d2h_pack_ok,
     fetch_outputs,
-    packed_io_ok,
+    pack_fetch_outputs,
     partition_buckets,
     scatter_bucket_outputs,
     sort_consensus_outputs,
     start_fetch,
+    unpack_fetch_outputs,
 )
 from duplexumiconsensusreads_tpu.runtime.faults import (
     fault_point,
@@ -914,8 +931,22 @@ def stream_call_consensus(
     # output read group); joins the checkpoint fingerprint — it changes
     # record bytes
     write_index: bool = False,  # write the standard .bai after finalise
-    packed: str = "auto",  # wire packing: "auto" (packed_io_ok gate) or
-    # "off" — the bench A/B measures both on the same input
+    packed: str = "auto",  # H2D wire packing rung: "auto" picks the
+    # best lossless rung per chunk class (sub-byte qual-dictionary
+    # where the alphabet fits, else the base|qual byte), "byte" caps at
+    # the byte rung, "off" disables — the bench A/B measures the rungs
+    # on the same input. Output bytes are identical at any setting.
+    d2h_packed: str = "auto",  # packed consensus-only return path:
+    # "auto" compacts + packs the fetch (executor.pack_fetch_outputs)
+    # whenever the u16 lanes fit and per-base tags are off; "off"
+    # fetches the full padded FETCH_KEYS arrays. Byte-identical output
+    # either way (the drain-side unpack reconstructs exact arrays).
+    prefetch_depth: int = 2,  # bounded H2D prefetch window: at most
+    # this many chunks may be dispatched (host pack + device_put +
+    # device compute started) ahead of the drain's materialisation —
+    # host packing + H2D of chunk k+1 overlaps device compute of chunk
+    # k without unbounded device-buffer pileup. Output bytes are
+    # identical at any depth.
     trace_path: str | None = None,  # per-chunk span capture (JSONL;
     # telemetry/trace.py). None = tracing off, and every hook in the
     # hot path is a single None check — the zero-cost contract
@@ -979,6 +1010,7 @@ def stream_call_consensus(
             mate_aware=mate_aware, max_reads=max_reads,
             per_base_tags=per_base_tags, read_group=read_group,
             write_index=write_index, packed=packed,
+            d2h_packed=d2h_packed, prefetch_depth=prefetch_depth,
             tr=tr, heartbeat_s=heartbeat_s, hb_box=hb_box,
             provenance_cl=provenance_cl,
             chunk_base=chunk_base, first_read=first_read,
@@ -1018,6 +1050,8 @@ def _stream_call(
     read_group: str = "A",
     write_index: bool = False,
     packed: str = "auto",
+    d2h_packed: str = "auto",
+    prefetch_depth: int = 2,
     tr: TraceRecorder | None = None,
     heartbeat_s: float = 0.0,
     hb_box: list | None = None,
@@ -1068,6 +1102,12 @@ def _stream_call(
 
     if drain_workers < 1:
         raise ValueError(f"drain_workers must be >= 1 (got {drain_workers})")
+    if prefetch_depth < 1:
+        raise ValueError(f"prefetch_depth must be >= 1 (got {prefetch_depth})")
+    if packed not in ("auto", "byte", "off"):
+        raise ValueError(f"packed must be auto/byte/off, got {packed!r}")
+    if d2h_packed not in ("auto", "off"):
+        raise ValueError(f"d2h_packed must be auto/off, got {d2h_packed!r}")
     rep = RunReport(backend="tpu-stream")
     rep.n_drain_workers = drain_workers
     duplex = consensus.mode == "duplex"
@@ -1185,7 +1225,7 @@ def _stream_call(
         "ingest": 0.0, "bucketing": 0.0, "dispatch": 0.0,
         "device_wait_fetch": 0.0, "scatter": 0.0, "deflate": 0.0,
         "shard_write": 0.0, "ckpt": 0.0, "finalise": 0.0,
-        "main_loop_stall": 0.0,
+        "main_loop_stall": 0.0, "prefetch_stall": 0.0,
     }
     # byte-ledger running totals (telemetry/ledger.py), maintained only
     # while tracing: every `led[...] +=` below pairs with a tr.xfer()
@@ -1194,9 +1234,45 @@ def _stream_call(
     # (integer equality, the byte analogue of the span sum-check).
     # Guarded by phase_lock wherever workers touch it.
     led = {
-        "h2d_logical": 0, "h2d_wire": 0, "d2h_wire": 0,
+        "h2d_logical": 0, "h2d_wire": 0, "d2h_logical": 0, "d2h_wire": 0,
         "shard_logical": 0, "shard_wire": 0, "output_overhead_bytes": 0,
     }
+
+    # packed consensus-only return path (runtime/executor packed-D2H
+    # rung): one run-level decision — the per-chunk epilogue bound
+    # (d2h_k_pad) is per class, but the gate (u16 lanes, per-base
+    # tags) is a pure function of run params, so a downgrade is
+    # ledgered ONCE here, not per chunk
+    d2h_on = (
+        packed != "off" and d2h_packed != "off"
+        and d2h_pack_ok(capacity, per_base_tags)
+    )
+    if (
+        packed != "off" and d2h_packed != "off"
+        and not d2h_pack_ok(capacity, per_base_tags)
+    ):
+        telemetry.emit_event(
+            "packed_fallback", scope="d2h",
+            reason=(
+                "per-base-tags-fetch-full-matrices" if per_base_tags
+                else "ids-overflow-u16"
+            ),
+            capacity=capacity,
+        )
+    from duplexumiconsensusreads_tpu.parallel.sharded import stacked_nbytes
+
+    # bounded H2D prefetch window: the main loop takes one permit per
+    # dispatched chunk BEFORE submitting its transfers; the drain
+    # worker returns it once the chunk's device results are
+    # materialised (finally-backstopped, so a failing chunk can never
+    # leak its permit and wedge the loop). Every permit's release site
+    # runs unconditionally, so a plain blocking acquire cannot
+    # deadlock.
+    prefetch_sem = threading.Semaphore(prefetch_depth)
+    # run-level qual-alphabet union for the sub-byte rung (see the
+    # chunk loop; None = overflowed past every dictionary width,
+    # scanning stopped for the rest of the run)
+    alpha_seen: set | None = set()
 
     def dispatch(buckets, spec, chunk=None):
         t0 = time.monotonic()
@@ -1206,28 +1282,50 @@ def _stream_call(
         stacked = stack_buckets(buckets, multiple_of=n_data)
         logical = 0
         if tr is not None:
-            # byte ledger: the PRE-packing payload — against the wire
-            # bytes below it measures what packing actually bought this
-            # chunk (pure observation; nbytes is an attribute read)
-            logical = sum(
-                v.nbytes for v in stacked.values() if hasattr(v, "nbytes")
-            )
+            # byte ledger: the PRE-packing payload of the arrays that
+            # actually cross the wire — against the wire bytes below it
+            # measures what packing bought this chunk (host-only
+            # bookkeeping like read_index is excluded on both sides)
+            logical = stacked_nbytes(stacked)
+        # chaos site: the host-side wire-packing step (the pack step
+        # runs — and can fail — whichever rung is active)
+        fault_point("dispatch.pack")
         if spec.packed_io:
-            # one byte per cycle instead of two: base|qual packed on the
-            # host, decoded on device — the host->device transfer is the
-            # dominant streaming phase on a tunneled chip (see the
-            # per-phase breakdown in RunReport.seconds)
-            pack_stacked(stacked)
-        h2d = sum(
-            v.nbytes for v in stacked.values() if hasattr(v, "nbytes")
-        )
-        # start the device->host copies of the consumed keys right at
-        # dispatch: by drain time the results are already on the host,
-        # so the tunnel's per-fetch latency overlaps with compute
-        out = start_fetch(
-            sharded_pipeline(stacked, spec, mesh),
-            extra=("cons_depth", "cons_err") if per_base_tags else (),
-        )
+            # sub-byte (qual-dictionary bit-planes) or byte (base|qual)
+            # rung, decided per class at partition time: the
+            # host->device transfer is the dominant streaming phase on
+            # a tunneled chip (see the per-phase breakdown)
+            pack_stacked(stacked, spec)
+        h2d = stacked_nbytes(stacked)
+        out = sharded_pipeline(stacked, spec, mesh)
+        # the run-level d2h decision re-checked against the CLASS
+        # capacity: jumbo buckets carry a next-pow2 capacity up to 64x
+        # the run's (bucketing/buckets.py), and the packed layout's u16
+        # depth/id lanes are only lossless below 2**16 rows
+        use_d2h = d2h_on and d2h_pack_ok(buckets[0].capacity, per_base_tags)
+        if d2h_on and not use_d2h:
+            telemetry.emit_event(
+                "packed_fallback", scope="d2h",
+                reason="jumbo-class-capacity-overflows-u16",
+                capacity=buckets[0].capacity,
+            )
+        if use_d2h:
+            # packed consensus-only return path: compact + pack the
+            # output rows ON DEVICE before any copy starts (still at
+            # dispatch time, so the async overlap is intact), then
+            # start the d2h copies of the compact set
+            out = start_fetch(
+                pack_fetch_outputs(out, spec, d2h_k_pad(buckets, spec)),
+                keys=PACKED_FETCH_KEYS,
+            )
+        else:
+            # start the device->host copies of the consumed keys right
+            # at dispatch: by drain time the results are already on the
+            # host, so the tunnel's per-fetch latency overlaps compute
+            out = start_fetch(
+                out,
+                extra=("cons_depth", "cons_err") if per_base_tags else (),
+            )
         dt = time.monotonic() - t0
         with phase_lock:  # dict += from concurrent workers would race
             phase["dispatch"] += dt
@@ -1238,14 +1336,38 @@ def _stream_call(
         if tr is not None:
             tr.span("dispatch", t0, dt, chunk=chunk, n_buckets=len(buckets))
             # retried dispatches emit again on purpose: the ledger
-            # counts wire traffic, and a retry really crossed the wire
-            tr.xfer("h2d", logical, h2d, t0, dt, chunk=chunk)
+            # counts wire traffic, and a retry really crossed the wire.
+            # bpc = wire bits per base/qual cycle of this class's rung
+            # (16 unpacked, 8 byte, 7/5 sub-byte) — the per-chunk
+            # packing decision, recorded in the ledger
+            bpc = (
+                2 + spec.packed_qbits if spec.packed_qbits
+                else 8 if spec.packed_io else 16
+            )
+            tr.xfer("h2d", logical, h2d, t0, dt, chunk=chunk, bpc=bpc)
         return out
+
+    def unpack(raw, cbuckets, cspec):
+        """Host-side unpack of one fetched dict: reconstruct the exact
+        unpacked FETCH_KEYS arrays from a packed-D2H fetch (identity
+        when the rung is off). Returns (full dict, wire bytes moved,
+        logical bytes the unpacked fetch would have moved). Chaos site
+        fetch.unpack rides the bounded host-I/O ladder — the unpack is
+        pure compute, so a retry is trivially idempotent."""
+        wire = sum(v.nbytes for v in raw.values() if hasattr(v, "nbytes"))
+        full = _io_retry(
+            "fetch.unpack",
+            lambda: unpack_fetch_outputs(raw, cbuckets, cspec),
+            "packed d2h unpack",
+        )
+        return full, wire, d2h_logical_nbytes(raw, cbuckets, cspec)
 
     def materialize(out, cbuckets, cspec, k):
         """Device results -> host arrays, with failure recovery:
         bounded exponential-backoff class retries, then bucket-by-bucket
-        re-dispatch to isolate a poisoned bucket."""
+        re-dispatch to isolate a poisoned bucket. Returns
+        (outputs, wire_bytes, logical_bytes) — the d2h ledger pair of
+        the fetch that finally succeeded."""
         err: Exception | None = None
         if out is not None and hasattr(out, "result"):
             try:
@@ -1256,7 +1378,9 @@ def _stream_call(
             err = err or RuntimeError("device dispatch failed at submit")
         else:
             try:
-                return fetch_outputs(out)
+                return unpack(fetch_outputs(out), cbuckets, cspec)
+            except D2hCompactionOverflow:
+                raise  # deterministic invariant violation: no retry
             except Exception as e:
                 err = e
         for attempt in range(max_retries):
@@ -1278,7 +1402,12 @@ def _stream_call(
             )
             time.sleep(delay)
             try:
-                return fetch_outputs(dispatch(cbuckets, cspec, chunk=k))
+                return unpack(
+                    fetch_outputs(dispatch(cbuckets, cspec, chunk=k)),
+                    cbuckets, cspec,
+                )
+            except D2hCompactionOverflow:
+                raise
             except Exception as e:
                 err = e
         # class keeps failing: isolate per bucket so one bad bucket
@@ -1291,6 +1420,7 @@ def _stream_call(
             file=sys.stderr,
         )
         rows: dict[str, list] = {}
+        wire_total = logical_total = 0
         for bi, bk in enumerate(cbuckets):
             last = None
             for attempt in range(max_retries):
@@ -1299,9 +1429,12 @@ def _stream_call(
                         f"chunk {k} bucket {bi}: run aborting"
                     ) from (last or err)
                 try:
-                    single = dispatch([bk], cspec, chunk=k)
+                    raw = fetch_outputs(dispatch([bk], cspec, chunk=k))
+                    single, w1, l1 = unpack(raw, [bk], cspec)
                     single = {key: np.asarray(v)[0] for key, v in single.items()}
                     break
+                except D2hCompactionOverflow:
+                    raise
                 except Exception as e:
                     last = e
                     with phase_lock:
@@ -1319,9 +1452,14 @@ def _stream_call(
                     f"chunk {k} bucket {bi} failed {max_retries} "
                     f"re-dispatches; giving up"
                 ) from last
+            wire_total += w1
+            logical_total += l1
             for key, v in single.items():
                 rows.setdefault(key, []).append(v)
-        return {key: np.stack(v) for key, v in rows.items()}
+        return (
+            {key: np.stack(v) for key, v in rows.items()},
+            wire_total, logical_total,
+        )
 
     def drain_chunk(k, entries, batch):
         """Consumer side of the pipeline for ONE chunk, on a drain
@@ -1331,7 +1469,17 @@ def _stream_call(
         incremental finalise append) stays on the MAIN thread so marks
         and appends land in chunk order whatever order workers finish
         in. A fault/kill raised here surfaces through the future into
-        the main loop unchanged."""
+        the main loop unchanged. Releases the chunk's H2D prefetch
+        permit once every entry's device results are materialised
+        (finally-backstopped: a failing chunk must not wedge the main
+        loop's prefetch window)."""
+        released = [False]
+
+        def release_prefetch():
+            if not released[0]:
+                released[0] = True
+                prefetch_sem.release()
+
         def on_stage(stage, t0, dt):
             # _finish_chunk's accounting callback: one pair of phase +=
             # and span per sub-stage (deflate vs serialize/write), so
@@ -1341,28 +1489,41 @@ def _stream_call(
             if tr is not None:
                 tr.span(stage, t0, dt, chunk=k)
 
+        try:
+            return _drain_chunk_body(
+                k, entries, batch, on_stage, release_prefetch
+            )
+        finally:
+            release_prefetch()
+
+    def _drain_chunk_body(k, entries, batch, on_stage, release_prefetch):
         parts = []
         pair_base = 0
-        for out, cbuckets, cspec in entries:
+        for i, (out, cbuckets, cspec) in enumerate(entries):
             t0 = time.monotonic()
-            out = materialize(out, cbuckets, cspec, k)
+            out, d2h_wire, d2h_logical = materialize(out, cbuckets, cspec, k)
+            if i == len(entries) - 1:
+                # every class's device work for this chunk is done:
+                # open the prefetch window before the (host-heavy)
+                # scatter/serialize tail
+                release_prefetch()
             dt = time.monotonic() - t0
-            d2h = sum(
-                v.nbytes for v in out.values() if hasattr(v, "nbytes")
-            )
             with phase_lock:
                 phase["device_wait_fetch"] += dt
-                rep.bytes_d2h += d2h
+                rep.bytes_d2h += d2h_wire
                 rep.n_families += int(out["n_families"].sum())
                 rep.n_molecules += int(out["n_molecules"].sum())
                 if tr is not None:
-                    led["d2h_wire"] += d2h
+                    led["d2h_wire"] += d2h_wire
+                    led["d2h_logical"] += d2h_logical
             if tr is not None:
                 tr.span("device_wait_fetch", t0, dt, chunk=k)
-                # nothing packs the return path (yet): logical == wire,
-                # and the gap between this and a packed d2h is exactly
-                # the ROADMAP item the ledger quantifies
-                tr.xfer("d2h", d2h, d2h, t0, dt, chunk=k)
+                # the packed return path: wire is what the compact
+                # consensus-only fetch moved, logical what the full
+                # padded FETCH_KEYS arrays would have — the d2h
+                # logical-vs-wire gap the ROADMAP's wire item asked the
+                # ledger to close (equal when the rung is off)
+                tr.xfer("d2h", d2h_logical, d2h_wire, t0, dt, chunk=k)
             t0 = time.monotonic()
             # chaos site drain.scatter rides the same bounded-retry
             # ladder as the host I/O steps (scatter is pure compute, so
@@ -1650,6 +1811,25 @@ def _stream_call(
             buckets = build_buckets(
                 batch, capacity=capacity, grouping=grouping, counters=fb
             )
+            # the run's real-cycle qual alphabet feeds the sub-byte
+            # rung decision: one scan per chunk, accumulated into a
+            # MONOTONE-GROWING run-level union so a rare qual bin
+            # absent from some chunks cannot flip the lut back and
+            # forth and recompile the pipeline per chunk — the lut only
+            # ever grows (bounded by the dictionary capacity, after
+            # which the class falls back to the byte rung). A superset
+            # lut stays exact for every chunk: searchsorted is an exact
+            # index for any member. ("byte" caps the ladder.)
+            alpha = None
+            if packed == "auto" and buckets and alpha_seen is not None:
+                alpha_seen.update(qual_alphabet(buckets))
+                if len(alpha_seen) > _ALPHA_CAP:
+                    # every dictionary width has overflowed for good
+                    # (the union only grows): stop paying the per-chunk
+                    # scan — the byte rung owns the rest of the run
+                    alpha_seen = None
+                else:
+                    alpha = tuple(sorted(alpha_seen))
             dt = time.monotonic() - t0
             phase["bucketing"] += dt
             if tr is not None:
@@ -1677,11 +1857,25 @@ def _stream_call(
                 )
                 _advance_frontier()
                 continue
+            # bounded H2D prefetch: take the chunk's permit BEFORE its
+            # dispatches are submitted — at most prefetch_depth chunks
+            # may be in the dispatched-but-not-materialised window, so
+            # packing + H2D of chunk k+1 overlaps device compute of
+            # chunk k without unbounded device-buffer pileup. The drain
+            # worker returns the permit (finally-backstopped), so the
+            # blocking acquire cannot deadlock.
+            t0 = time.monotonic()
+            prefetch_sem.acquire()
+            dt = time.monotonic() - t0
+            phase["prefetch_stall"] += dt
+            if tr is not None:
+                tr.span("prefetch_stall", t0, dt, chunk=k)
             entries = []
             for cbuckets, cspec in partition_buckets(
                 buckets, grouping, consensus,
-                packed_io=(packed != "off" and packed_io_ok(consensus)),
+                packed_io=(packed != "off"),
                 per_base_counts=per_base_tags,
+                qual_alphabet=alpha,
             ):
                 spec_cache[cspec] = True
                 # transfer workers: host->device copies ride the tunnel

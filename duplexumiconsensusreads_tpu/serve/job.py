@@ -36,6 +36,8 @@ CONFIG_DEFAULTS = {
     "chunk_reads": 500_000,
     "max_inflight": 4,
     "drain_workers": 2,
+    "packed": "auto",
+    "prefetch_depth": 2,
     "mate_aware": "auto",
     "max_reads": 0,
     "per_base_tags": False,
@@ -48,6 +50,7 @@ _CHOICES = {
     "mode": {"ss", "duplex"},
     "error_model": {"none", "cycle"},
     "mate_aware": {"auto", "on", "off"},
+    "packed": {"auto", "byte", "off"},
 }
 
 
@@ -126,7 +129,8 @@ def validate_spec(d: dict) -> JobSpec:
             "jobs run on the streaming executor: config chunk_reads "
             f"must be an int >= 1 (got {merged['chunk_reads']!r})"
         )
-    for key in ("capacity", "drain_workers", "max_inflight"):
+    for key in ("capacity", "drain_workers", "max_inflight",
+                "prefetch_depth"):
         if not isinstance(merged[key], int) or merged[key] < 1:
             raise ValueError(f"config {key} must be an int >= 1")
     chaos = d.get("chaos")
@@ -218,6 +222,8 @@ def job_params(spec: JobSpec):
         chunk_reads=c["chunk_reads"],
         max_inflight=c["max_inflight"],
         drain_workers=c["drain_workers"],
+        packed=c["packed"],
+        prefetch_depth=c["prefetch_depth"],
         mate_aware=c["mate_aware"],
         max_reads=c["max_reads"],
         per_base_tags=bool(c["per_base_tags"]),

@@ -23,12 +23,15 @@ Directions (``KNOWN_XFER_DIRS`` — the registry dutlint pins, the byte
 analogue of ``trace.KNOWN_STAGES``):
 
   h2d    device dispatch: logical = stacked input tensors before wire
-         packing, wire = bytes actually device_put (after packing).
+         packing, wire = bytes actually device_put (after packing —
+         records carry a ``bpc`` attr naming the rung's bits/cycle).
          Retried dispatches emit again — the ledger counts wire
          traffic, not input size.
-  d2h    device fetch: consensus outputs materialised to host
-         (logical == wire — nothing packs the return path yet, which
-         is itself a ROADMAP item the ledger now quantifies).
+  d2h    device fetch: logical = bytes the full padded FETCH_KEYS
+         arrays would have moved, wire = bytes the packed
+         consensus-only return path actually fetched (equal when the
+         d2h rung is off — the pre-PR-11 state this ledger was built
+         to quantify).
   shard  the chunk's durable shard: logical = raw record-stream
          bytes, wire = BGZF-deflated bytes on disk. Resume-reused
          chunks emit ``resumed: true`` with wire only (their raw size
@@ -63,9 +66,12 @@ __all__ = [
 ]
 
 # summary["bytes"] keys the executor embeds (all integers; *_logical
-# and *_wire are running totals of the matching xfer records)
+# and *_wire are running totals of the matching xfer records).
+# d2h_logical joined with the packed-D2H rung; captures from before it
+# simply lack the key and the sum-check skips that row.
 SUMMARY_BYTE_KEYS = (
-    "h2d_logical", "h2d_wire", "d2h_wire", "shard_logical", "shard_wire",
+    "h2d_logical", "h2d_wire", "d2h_logical", "d2h_wire",
+    "shard_logical", "shard_wire",
     "output_bytes", "output_overhead_bytes",
 )
 
@@ -214,6 +220,11 @@ def packing_stats(records: list[dict], totals: dict | None = None) -> dict:
     h2d = totals.get("h2d", {})
     if h2d.get("wire"):
         out["h2d_packing_ratio"] = round(h2d["logical"] / h2d["wire"], 3)
+    d2h = totals.get("d2h", {})
+    if d2h.get("wire") and d2h.get("logical"):
+        # the return path's diet (packed consensus-only fetch): 1.0
+        # exactly when the d2h rung is off or the capture predates it
+        out["d2h_packing_ratio"] = round(d2h["logical"] / d2h["wire"], 3)
     shard = totals.get("shard", {})
     if shard.get("logical") and shard.get("wire"):
         # reused shards carry no logical: ratio over fresh records only
@@ -275,8 +286,11 @@ def sum_check_bytes(
     double-emitted, or the capture was edited. A capture truncated by
     the bounded recorder (summary n_dropped > 0) can only under-count:
     the check degrades to one-sided (records <= summary), mirroring the
-    time sum-check's truncation contract. Returns (rows, ok); no
-    summary bytes -> ([], True) (nothing to check against)."""
+    time sum-check's truncation contract. A total key the summary does
+    not carry at all is skipped — captures from before that key joined
+    the executor (d2h_logical predates the packed-D2H rung) must not
+    read as drift. Returns (rows, ok); no summary bytes -> ([], True)
+    (nothing to check against)."""
     want = summary_bytes(records)
     if want is None:
         return [], True
@@ -286,6 +300,7 @@ def sum_check_bytes(
     got = {
         "h2d_logical": totals.get("h2d", {}).get("logical", 0),
         "h2d_wire": totals.get("h2d", {}).get("wire", 0),
+        "d2h_logical": totals.get("d2h", {}).get("logical", 0),
         "d2h_wire": totals.get("d2h", {}).get("wire", 0),
         "shard_logical": totals.get("shard", {}).get("logical", 0),
         "shard_wire": totals.get("shard", {}).get("wire", 0),
@@ -293,6 +308,8 @@ def sum_check_bytes(
     rows = []
     ok_all = True
     for key, rec_total in got.items():
+        if key not in want:
+            continue  # pre-<key> capture: nothing recorded to check
         sv = want.get(key)
         expect = int(sv) if _is_num(sv) else 0
         ok = rec_total <= expect if dropped else rec_total == expect

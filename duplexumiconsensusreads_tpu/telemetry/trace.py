@@ -66,6 +66,9 @@ KNOWN_STAGES = (
     "ckpt",  # per-chunk checkpoint manifest mark (main)
     "finalise",  # incremental tmp appends + terminal EOF/fsync/rename (main)
     "main_loop_stall",  # main loop blocked on drain back-pressure (main)
+    "prefetch_stall",  # main loop blocked on the bounded H2D prefetch
+    # window (--prefetch-depth): dispatch of chunk k+depth may not start
+    # until chunk k's device results are materialised (main)
 )
 
 # Structured point events. Attrs are per-name (see the emitting sites);
@@ -80,6 +83,10 @@ KNOWN_EVENTS = (
     "durable_write",  # io/durable.py: a tmp+fsync+rename completed
     "heartbeat",  # periodic liveness sample (also printed to stderr)
     "truncated",  # the bounded recorder hit max_events; tail dropped
+    "packed_fallback",  # wire packing downgraded a rung (pos ids past
+    # u16, qual cap past the 6-bit payload, per-base tags forcing an
+    # unpacked d2h): the per-chunk packing decision the ledger records
+    # instead of a mid-dispatch job failure (attrs: reason, scope)
     # serving layer (serve/service.py): the job lifecycle in a
     # kind="service" capture. Every job_* event carries a "job" attr and
     # a "job-<id>" lane, so one capture decomposes per job the way a run

@@ -196,6 +196,12 @@ BOUNDARY_KILLS = [
     ("ckpt.save", 2),
     ("finalise.write", 1),
     ("finalise.write", 2),
+    # wire-diet v2 sites: killed inside the host-side H2D pack on an
+    # xfer worker (surfaces through the dispatch future) and inside the
+    # packed-D2H unpack on a drain worker — both before anything of the
+    # chunk is durable, so resume recomputes it
+    ("dispatch.pack", 2),
+    ("fetch.unpack", 2),
 ]
 
 

@@ -257,6 +257,149 @@ class TestPackedIO:
         assert packed_io_ok(ConsensusParams(max_input_qual=50))
         assert not packed_io_ok(ConsensusParams(max_input_qual=80))
 
+    def test_bitplane_roundtrip(self):
+        """Host bit-plane pack (pack_stacked's sub-byte layout) vs the
+        device unpack: codes survive exactly at both dictionary widths
+        and at non-multiple-of-8 cycle counts."""
+        from duplexumiconsensusreads_tpu.kernels.encoding import (
+            unpack_bitplanes,
+        )
+
+        rng = np.random.default_rng(11)
+        for nbits, l in ((5, 150), (7, 30), (5, 8), (7, 13)):
+            codes = rng.integers(0, 1 << nbits, size=(3, 17, l)).astype(
+                np.uint8
+            )
+            planes = np.concatenate(
+                [
+                    np.packbits((codes >> b) & 1, axis=-1, bitorder="little")
+                    for b in range(nbits)
+                ],
+                axis=-1,
+            )
+            assert planes.shape[-1] == nbits * (-(-l // 8))
+            back = np.asarray(unpack_bitplanes(planes, l, nbits))
+            np.testing.assert_array_equal(back, codes)
+
+    def test_subbyte_rung_selection(self):
+        from duplexumiconsensusreads_tpu.ops.pipeline import subbyte_qbits_for
+
+        assert subbyte_qbits_for(1) == 3
+        assert subbyte_qbits_for(7) == 3
+        assert subbyte_qbits_for(8) == 5
+        assert subbyte_qbits_for(31) == 5
+        assert subbyte_qbits_for(32) is None
+
+    def test_subbyte_packed_pipeline_bit_equal(self):
+        """The sub-byte qual-dictionary rung must reproduce the
+        unpacked pipeline outputs bit-for-bit — including at an input
+        qual cap past the byte rung's 6-bit gate, where only the
+        dictionary keeps the transfer exact."""
+        import dataclasses as dc
+
+        from duplexumiconsensusreads_tpu.ops.pipeline import (
+            pack_stacked,
+            qual_alphabet,
+            spec_for_buckets,
+        )
+
+        cfg = SimConfig(n_molecules=120, duplex=True, umi_error=0.02, seed=13)
+        batch, _ = simulate_batch(cfg)
+        gp = GroupingParams(strategy="adjacency", paired=True)
+        cp = ConsensusParams(mode="duplex", error_model="cycle",
+                             max_input_qual=80)
+        buckets = build_buckets(batch, capacity=512, grouping=gp)
+        spec_raw = spec_for_buckets(buckets, gp, cp)
+        alpha = qual_alphabet(buckets)
+        assert 7 < len(alpha) <= 31  # default sim: the 5-bit-index rung
+        spec_pk = spec_for_buckets(
+            buckets, gp, cp, packed_io=True, packed_qbits=5, qual_lut=alpha,
+        )
+        assert spec_pk.cycles_len == buckets[0].bases.shape[1]
+        from duplexumiconsensusreads_tpu.ops import fused_pipeline
+
+        for bk in buckets:
+            a = run_bucket(bk, spec_raw)
+            stacked = {
+                "bases": bk.bases[None], "quals": bk.quals[None],
+                "umi": bk.umi[None], "pos": bk.pos[None],
+                "strand_ab": bk.strand_ab[None],
+                "frag_end": bk.frag_end[None], "valid": bk.valid[None],
+            }
+            pack_stacked(stacked, spec_pk)
+            # 7 bits/cycle: 7 * ceil(L/8) wire bytes per read
+            l = bk.bases.shape[1]
+            assert stacked["bases"].shape[2] == 7 * (-(-l // 8))
+            b = fused_pipeline(
+                stacked["pos"][0], stacked["umi"][0], stacked["strand_ab"][0],
+                stacked["frag_end"][0], stacked["valid"][0],
+                stacked["bases"][0], stacked["quals"][0], spec_pk,
+            )
+            for key in ("family_id", "cons_base", "cons_qual", "cons_depth",
+                        "cons_valid", "cons_mate", "cons_pair"):
+                np.testing.assert_array_equal(
+                    np.asarray(a[key]), np.asarray(b[key]), err_msg=key
+                )
+
+    def test_d2h_pack_roundtrip(self):
+        """Device packed-D2H epilogue -> host unpack reproduces the
+        unpacked FETCH_KEYS arrays exactly at every position the
+        scatter reads (rows below each bucket's n_out)."""
+        from duplexumiconsensusreads_tpu.bucketing import stack_buckets
+        from duplexumiconsensusreads_tpu.ops.pipeline import spec_for_buckets
+        from duplexumiconsensusreads_tpu.parallel import make_mesh
+        from duplexumiconsensusreads_tpu.parallel.sharded import (
+            sharded_pipeline,
+        )
+        from duplexumiconsensusreads_tpu.runtime.executor import (
+            FETCH_KEYS,
+            d2h_k_pad,
+            d2h_logical_nbytes,
+            fetch_outputs,
+            pack_fetch_outputs,
+            start_fetch,
+            unpack_fetch_outputs,
+        )
+
+        cfg = SimConfig(n_molecules=150, duplex=True, umi_error=0.02, seed=21)
+        batch, _ = simulate_batch(cfg)
+        gp = GroupingParams(strategy="adjacency", paired=True)
+        cp = ConsensusParams(mode="duplex")  # default max_qual=90: the
+        # pack must be exact far past any 6-bit payload
+        buckets = build_buckets(batch, capacity=256, grouping=gp)
+        spec = spec_for_buckets(buckets, gp, cp)
+        mesh = make_mesh(1)
+        stacked = stack_buckets(buckets)
+        out = sharded_pipeline(stacked, spec, mesh)
+        plain = fetch_outputs(start_fetch(out))
+        k_pad = d2h_k_pad(buckets, spec)
+        packed = fetch_outputs(
+            start_fetch(
+                pack_fetch_outputs(out, spec, k_pad),
+                keys=tuple(pack_fetch_outputs(out, spec, k_pad)),
+            )
+        )
+        # the compact transfer must actually be smaller than the padded
+        # one, and the ledger's logical side must equal the unpacked sum
+        wire = sum(v.nbytes for v in packed.values())
+        logical = d2h_logical_nbytes(packed, buckets, spec)
+        assert wire < logical
+        assert logical == sum(v.nbytes for v in plain.values())
+        full = unpack_fetch_outputs(packed, buckets, spec)
+        n_out = np.clip(np.asarray(plain["n_molecules"]), 0,
+                        np.asarray(plain["cons_valid"]).shape[1])
+        assert set(full) == (set(FETCH_KEYS) - {"family_id"})
+        for key in full:
+            got, want = np.asarray(full[key]), np.asarray(plain[key])
+            assert got.dtype == want.dtype, key
+            if got.ndim >= 2 and key not in ("molecule_id",):
+                for bi, n in enumerate(n_out):
+                    np.testing.assert_array_equal(
+                        got[bi, :n], want[bi, :n], err_msg=key
+                    )
+            else:
+                np.testing.assert_array_equal(got, want, err_msg=key)
+
 
 class TestPallasSegmentGemm:
     def _ref(self, big, fid, f):

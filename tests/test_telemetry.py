@@ -336,12 +336,16 @@ class TestByteLedger:
         assert xf, "a traced streaming run must carry ledger records"
         # golden envelopes per direction — a new field is a schema
         # change and must be made here (and in ARCHITECTURE.md) on
-        # purpose, not by drift
+        # purpose, not by drift. h2d records carry the rung's bits per
+        # cycle (bpc) since the wire-diet-v2 packing ladder.
         for r in xf:
             assert r["dir"] in trace.KNOWN_XFER_DIRS
             base = {"type", "dir", "t", "dur", "wire", "lane", "chunk"}
             if r.get("resumed"):
                 assert set(r) == base | {"resumed"}
+            elif r["dir"] == "h2d":
+                assert set(r) == base | {"logical", "bpc"}
+                assert r["bpc"] in (16, 8, 7, 5)
             else:
                 assert set(r) == base | {"logical"}
             assert isinstance(r["wire"], int) and r["wire"] >= 0
@@ -351,12 +355,15 @@ class TestByteLedger:
         assert sorted(per) == list(range(rep["n_chunks"]))
         for row in per.values():
             assert {"h2d", "d2h", "shard"} <= set(row)
-        # packing can only shrink the h2d wire; nothing packs d2h (yet)
+        # packing can only shrink the wire, in BOTH directions now —
+        # the packed consensus-only return path gives d2h records a
+        # real logical-vs-wire gap (the default sim input engages it)
         for r in xf:
-            if r["dir"] == "h2d":
+            if r["dir"] in ("h2d", "d2h"):
                 assert r["logical"] >= r["wire"] > 0
-            elif r["dir"] == "d2h":
-                assert r["logical"] == r["wire"] > 0
+        assert any(
+            r["logical"] > r["wire"] for r in xf if r["dir"] == "d2h"
+        ), "packed d2h must engage on the default traced run"
 
     def test_totals_sum_check_and_on_disk_output(self, traced):
         records, rep, paths = traced
@@ -391,6 +398,8 @@ class TestByteLedger:
             assert row["p95_mb_s"] >= row["p50_mb_s"] >= 0
         pack = ledger.packing_stats(records)
         assert pack["h2d_packing_ratio"] >= 1.0
+        # the return path is packed too now: a real d2h ratio > 1
+        assert pack["d2h_packing_ratio"] > 1.0
         assert pack["bytes_per_read"] > 0
 
     def test_validator_rejects_malformed_xfer(self):
@@ -446,6 +455,28 @@ class TestByteLedger:
         assert done
         r = subprocess.run(
             [sys.executable, wirestat, tampered],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert r.returncode == 1
+        assert "DRIFT" in r.stderr
+        # tamper a d2h record's LOGICAL bytes: the packed return path's
+        # logical-vs-wire gap is sum-checked too (a corrupted logical
+        # total must not pass just because the wire side still adds up)
+        tampered2 = str(tmp_path / "tampered_d2h.jsonl")
+        with open(paths["trace"]) as f, open(tampered2, "w") as g:
+            done = False
+            for line in f:
+                rec = json.loads(line)
+                if (
+                    not done and rec.get("type") == "xfer"
+                    and rec.get("dir") == "d2h"
+                ):
+                    rec["logical"] += 4096
+                    done = True
+                g.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        assert done
+        r = subprocess.run(
+            [sys.executable, wirestat, tampered2],
             capture_output=True, text=True, env=env, cwd=REPO,
         )
         assert r.returncode == 1
@@ -807,7 +838,8 @@ class TestReportShape:
         assert set(rep["seconds"]) == {
             "ingest", "bucketing", "dispatch", "device_wait_fetch",
             "scatter", "deflate", "shard_write", "ckpt", "finalise",
-            "main_loop_stall", "drain_utilization", "total",
+            "main_loop_stall", "prefetch_stall", "drain_utilization",
+            "total",
         }
 
     def test_to_json_stable_and_ms_rounded(self):

@@ -93,7 +93,8 @@ def main(argv: list[str] | None = None) -> int:
         chunks = ledger.per_chunk_bytes(records)
         print(
             f"{'chunk':>6} {'h2d_logical':>12} {'h2d_wire':>12} "
-            f"{'d2h_wire':>12} {'shard_raw':>12} {'shard_wire':>12}  note"
+            f"{'d2h_logical':>12} {'d2h_wire':>12} "
+            f"{'shard_raw':>12} {'shard_wire':>12}  note"
         )
         for i, (chunk, row) in enumerate(chunks.items()):
             if i >= _TABLE_ROWS:
@@ -107,6 +108,7 @@ def main(argv: list[str] | None = None) -> int:
             print(
                 f"{chunk:>6} {_fmt_bytes(h2d.get('logical', 0)):>12} "
                 f"{_fmt_bytes(h2d.get('wire', 0)):>12} "
+                f"{_fmt_bytes(d2h.get('logical', 0)):>12} "
                 f"{_fmt_bytes(d2h.get('wire', 0)):>12} "
                 f"{_fmt_bytes(shard.get('logical', 0)):>12} "
                 f"{_fmt_bytes(shard.get('wire', 0)):>12}  {note}"
