@@ -9,7 +9,11 @@ which are also linted themselves.
 
 Exit status: 0 when clean (allowlisted findings don't count, but are
 listed with their reasons under -v), 1 on any non-allowlisted finding,
-2 on usage errors. ``--json`` emits a machine-readable report for CI.
+2 on usage errors. ``--json`` emits a machine-readable report (rule,
+file, line, message per finding) for CI and editors; ``--rule ID``
+(repeatable) runs/bisects single passes; ``--strict`` — the CI gate's
+mode (tools/ci_check.sh) — additionally fails default-set runs whose
+allowlist carries stale entries.
 """
 
 from __future__ import annotations
@@ -68,7 +72,9 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="dutlint",
         description="AST-based invariant linter (clocks, durability, "
-        "fault sites, phase registries, lock discipline, hook guards)",
+        "fault sites, phase registries, lock discipline, hook guards, "
+        "and the serving fleet's protocol model: state machine, txn/"
+        "fence dominance, exception contracts)",
     )
     ap.add_argument(
         "paths", nargs="*",
@@ -80,6 +86,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--rule", action="append", dest="rules", metavar="ID",
                     help="run only this rule (repeatable)")
     ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="also exit 1 on stale allowlist entries (default-set runs "
+        "only — an explicit file subset legitimately misses most "
+        "entries); the CI gate runs with this on",
+    )
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also list allowlist-suppressed findings")
@@ -104,8 +116,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"dutlint: {e}", file=sys.stderr)
         return 2
     result = run_lint(corpus, ALLOWLIST, only_rules=args.rules)
+    # --strict folds allowlist staleness into the exit status, but only
+    # against the full default set (see the warning path below)
+    stale_fails = bool(
+        args.strict and not args.paths and result.unused_allowlist
+    )
 
     if args.json:
+        ok = result.ok and not stale_fails
         print(json.dumps({
             "root": root,
             "n_files": len(corpus.trees) + len(corpus.parse_failures),
@@ -115,9 +133,9 @@ def main(argv: list[str] | None = None) -> int:
                 for f, a in result.suppressed
             ],
             "unused_allowlist": [vars(a) for a in result.unused_allowlist],
-            "ok": result.ok,
+            "ok": ok,
         }, indent=2))
-        return 0 if result.ok else 1
+        return 0 if ok else 1
 
     for f in result.findings:
         print(f.format())
@@ -127,22 +145,31 @@ def main(argv: list[str] | None = None) -> int:
     if not args.paths:
         # staleness is only meaningful against the full default set: an
         # explicit file subset legitimately misses most entries. Stale
-        # suppressions are warnings, not failures, here — the tier-1
-        # gate (tests/test_lint.py) is what forces pruning.
+        # suppressions are warnings here (failures under --strict — the
+        # CI gate); the tier-1 gate (tests/test_lint.py) also forces
+        # pruning.
+        severity = "error" if args.strict else "warning"
         for a in result.unused_allowlist:
             print(
-                f"dutlint: warning: unused allowlist entry "
+                f"dutlint: {severity}: unused allowlist entry "
                 f"({a.rule}, {a.path}) — prune it",
                 file=sys.stderr,
             )
     n_files = len(corpus.trees) + len(corpus.parse_failures)
-    if result.ok:
+    if result.ok and not stale_fails:
         print(
             f"dutlint: OK — {n_files} files, "
             f"{len(RULES) if not args.rules else len(args.rules)} rules, "
             f"{len(result.suppressed)} allowlisted"
         )
         return 0
+    if result.ok and stale_fails:
+        print(
+            f"dutlint: {len(result.unused_allowlist)} stale allowlist "
+            f"entr(y/ies) under --strict",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"dutlint: {len(result.findings)} finding(s) in {n_files} files "
         f"({len(result.suppressed)} allowlisted)",

@@ -243,6 +243,45 @@ def str_tuple_assign(tree: ast.Module, name: str) -> tuple[list[str], int]:
     return [], 0
 
 
+def str_dict_assign(
+    tree: ast.Module, name: str
+) -> tuple[dict[str, tuple[str, ...]], int]:
+    """Module-level ``NAME = {"a": ("b", ...), ...}`` -> (dict, lineno).
+
+    The declared-graph shape (the state machine's TRANSITIONS table):
+    string keys, tuple/list-of-string values. Returns ({}, 0) when the
+    assignment is missing or not fully literal — callers treat that as
+    "registry not found", same contract as :func:`str_tuple_assign`."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if not (isinstance(t, ast.Name) and t.id == name):
+                continue
+            val = node.value
+            if not isinstance(val, ast.Dict):
+                continue
+            out: dict[str, tuple[str, ...]] = {}
+            ok = True
+            for k, v in zip(val.keys, val.values):
+                key = str_const(k) if k is not None else None
+                if key is None or not isinstance(v, (ast.Tuple, ast.List)):
+                    ok = False
+                    break
+                elts = [str_const(e) for e in v.elts]
+                if any(e is None for e in elts):
+                    ok = False
+                    break
+                out[key] = tuple(e for e in elts if e is not None)
+            if ok and out:
+                return out, node.lineno
+    return {}, 0
+
+
 def ancestors(node: ast.AST) -> Iterator[ast.AST]:
     cur = getattr(node, "_lint_parent", None)
     while cur is not None:

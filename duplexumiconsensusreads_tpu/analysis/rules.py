@@ -26,6 +26,7 @@ from duplexumiconsensusreads_tpu.analysis.engine import (
     inside_lock_body,
     register,
     str_const,
+    str_dict_assign,
     str_tuple_assign,
 )
 
@@ -784,17 +785,22 @@ def check_deadline_discipline(corpus: Corpus) -> Iterator[Finding]:
                     "NTP step)",
                 )
 
-    # (c) state-literal registry + serving-suite exercise
-    queue_path = corpus.find("serve/queue.py")
-    if queue_path is None:
+    # (c) state-literal registry + serving-suite exercise. The
+    # registry anchor is serve/states.py (the declared state machine);
+    # pre-refactor corpora (the fixture corpora in tests/test_lint.py)
+    # that still keep JOB_STATES in serve/queue.py anchor there.
+    states_anchor = corpus.find("serve/states.py")
+    if states_anchor is None:
+        states_anchor = corpus.find("serve/queue.py")
+    if states_anchor is None:
         return
     states, states_line = str_tuple_assign(
-        corpus.trees[queue_path], "JOB_STATES"
+        corpus.trees[states_anchor], "JOB_STATES"
     )
     if not states:
         yield Finding(
             rule="deadline-discipline",
-            path=queue_path,
+            path=states_anchor,
             line=1,
             message="JOB_STATES literal tuple not found",
             hint="keep JOB_STATES a module-level tuple of string literals "
@@ -810,7 +816,8 @@ def check_deadline_discipline(corpus: Corpus) -> Iterator[Finding]:
                     path=path,
                     line=line,
                     message=f"journal state literal {lit!r} is not "
-                    f"registered in serve.queue.JOB_STATES",
+                    f"registered in the JOB_STATES registry "
+                    f"(serve/states.py)",
                     hint="register the state (and cover it in "
                     "tests/test_serve.py) or fix the typo",
                 )
@@ -966,3 +973,917 @@ def check_hook_guard(corpus: Corpus) -> Iterator[Finding]:
                     hint=f"wrap in `if {var} is not None:` — hooks must be "
                     "a single None check when tracing is off",
                 )
+
+
+# ------------------------------------------------------ rule: state machine
+
+# calls that prove (by raising JobFenced otherwise) that the entry is
+# in a CLAIMED state — fence checks are from-state evidence exactly
+# like an explicit state comparison
+_FENCE_GUARD_CALLS = ("_check_fence", "check_fence")
+
+
+def _state_views(states: list, transitions: dict) -> dict:
+    """The derived state families, recomputed from the declared graph
+    with the same formulas serve/states.py uses (tests/test_serve.py
+    pins both against the same literals, so they cannot drift apart
+    silently). Keyed by the registry NAMES so membership tests like
+    ``entry.get("state") in CLAIMED_STATES`` resolve to member sets.
+    JOB_STATES is deliberately NOT an evidence family: membership in
+    the full state set proves nothing about the from-state, and
+    counting it would let `if state in JOB_STATES` launder any write
+    past the terminal/undeclared checks."""
+    return {
+        "TERMINAL_STATES": {s for s in states if not transitions.get(s)},
+        "CLAIMED_STATES": {
+            s for s in states if "quarantined" in transitions.get(s, ())
+        },
+        "OPEN_STATES": {s for s in states if transitions.get(s)},
+    }
+
+
+def _from_state_evidence(fn: ast.AST, state_set: set, views: dict) -> set:
+    """The set of from-states the enclosing function proves it is
+    handling: literal state comparisons (``== / != / in / not in``,
+    asserts included — Compare nodes all), membership tests against a
+    named state family, and fence-guard calls (which prove CLAIMED)."""
+    ev: set = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and call_name(n) in _FENCE_GUARD_CALLS:
+            ev |= views["CLAIMED_STATES"]
+        if not isinstance(n, ast.Compare):
+            continue
+        for e in (n.left, *n.comparators):
+            s = str_const(e)
+            if s is not None and s in state_set:
+                ev.add(s)
+            name = (
+                e.id if isinstance(e, ast.Name)
+                else e.attr if isinstance(e, ast.Attribute)
+                else None
+            )
+            if name in views:
+                ev |= views[name]
+            if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                for el in e.elts:
+                    s = str_const(el)
+                    if s is not None and s in state_set:
+                        ev.add(s)
+    return ev
+
+
+def _is_jobs_expr(e: ast.AST) -> bool:
+    return (isinstance(e, ast.Name) and e.id == "jobs") or (
+        isinstance(e, ast.Attribute) and e.attr == "jobs"
+    )
+
+
+def _dict_reaches_jobs(node: ast.Assign, tree: ast.Module) -> bool:
+    """Does this dict-literal assignment land in the jobs cache —
+    directly (``jobs[x] = {...}``) or via the temporary-dict pattern
+    (``entry = {...}; ... jobs[x] = entry`` in the same scope)? A
+    status/response dict that never reaches the cache is read-side
+    rendering, not a journal-entry creation."""
+    def _into_jobs(t: ast.AST) -> bool:
+        return isinstance(t, ast.Subscript) and _is_jobs_expr(t.value)
+
+    if any(_into_jobs(t) for t in node.targets):
+        return True
+    names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+    if not names:
+        return False
+    scope = enclosing_function(node) or tree
+    return any(
+        isinstance(n, ast.Assign)
+        and isinstance(n.value, ast.Name)
+        and n.value.id in names
+        and any(_into_jobs(t) for t in n.targets)
+        for n in ast.walk(scope)
+    )
+
+
+def _state_write_sites(tree: ast.Module, state_set: set):
+    """Yield (kind, to_states, node): "create" for a dict literal with
+    a literal ``state`` key that reaches the jobs cache (direct
+    subscript or accept_one's temporary-dict pattern — see
+    :func:`_dict_reaches_jobs`), "transition" for a ``<x>["state"] =
+    ...`` subscript write. to_states collects every registered literal
+    in the written value (an IfExp write like claim's contributes all
+    of its branches); writes with no registered literal are variable
+    relays — unverifiable here, and the registration rule already
+    polices unregistered literals."""
+    for node in ast.walk(tree):
+        # method-call writes: entry.update({"state": ...}) /
+        # entry.update(state=...) / entry.setdefault("state", ...) —
+        # the same journal move in call clothing; without these the
+        # gate would be fail-open for exactly the writes a subscript
+        # grep can't see
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("update", "setdefault")
+        ):
+            tos: set = set()
+            if node.func.attr == "setdefault":
+                if (
+                    len(node.args) >= 2
+                    and str_const(node.args[0]) == "state"
+                    and (s := str_const(node.args[1])) is not None
+                    and s in state_set
+                ):
+                    tos.add(s)
+            else:
+                for a in node.args:
+                    if isinstance(a, ast.Dict):
+                        for k, v in zip(a.keys, a.values):
+                            if k is not None and str_const(k) == "state":
+                                s = str_const(v)
+                                if s is not None and s in state_set:
+                                    tos.add(s)
+                for kw in node.keywords:
+                    if kw.arg == "state":
+                        s = str_const(kw.value)
+                        if s is not None and s in state_set:
+                            tos.add(s)
+            if tos:
+                yield "transition", tos, node
+            continue
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and str_const(t.slice) == "state":
+                tos = {
+                    s for sub in ast.walk(node.value)
+                    if (s := str_const(sub)) is not None and s in state_set
+                }
+                if tos:
+                    yield "transition", tos, node
+        if isinstance(node.value, ast.Dict) and _dict_reaches_jobs(
+            node, tree
+        ):
+            for k, v in zip(node.value.keys, node.value.values):
+                if k is not None and str_const(k) == "state":
+                    s = str_const(v)
+                    if s is not None and s in state_set:
+                        yield "create", {s}, node
+
+
+@register(
+    "state-machine",
+    "serve/ journal states move only along serve/states.py TRANSITIONS: "
+    "no undeclared edges, no terminal writes, no unreachable or dead "
+    "declared states",
+)
+def check_state_machine(corpus: Corpus) -> Iterator[Finding]:
+    """The protocol model-check. serve/states.py declares the graph
+    (JOB_STATES / INITIAL_STATES / TRANSITIONS); this rule rebuilds the
+    graph the CODE implements — every state write in ``serve/``,
+    sourced with its from-state evidence (state comparisons, asserts,
+    fence-guard calls in the same function) — and diffs the two:
+
+    (a) registry self-consistency: every state has a TRANSITIONS row,
+        every edge endpoint is registered, initial states registered;
+    (b) reachability: every state is reachable from the initial states
+        (an unreachable state is dead protocol the sweeps/compaction
+        still pay for);
+    (c) every observed write is a declared edge: a creation writes an
+        INITIAL state, a transition write's target is a declared
+        successor of at least one evidenced from-state — and a write
+        whose only evidence is terminal states is resurrecting a
+        finished job;
+    (d) a transition write with NO from-state evidence is itself a
+        finding: un-evidenced writes are how undeclared edges ship;
+    (e) dead declared edges: a declared transition no write site
+        implements is protocol fiction — prune it or implement it;
+    (f) the serving suite exercises the declared graph: a registry-pin
+        or parametrize referencing TRANSITIONS, or per-edge
+        ``"src->dst"`` literals."""
+    states_path = corpus.find("serve/states.py")
+    if states_path is None:
+        return
+    tree = corpus.trees[states_path]
+    states, states_line = str_tuple_assign(tree, "JOB_STATES")
+    transitions, t_line = str_dict_assign(tree, "TRANSITIONS")
+    initial, _ = str_tuple_assign(tree, "INITIAL_STATES")
+    if not states or not transitions:
+        yield Finding(
+            rule="state-machine",
+            path=states_path,
+            line=1,
+            message="JOB_STATES / TRANSITIONS literals not found",
+            hint="keep JOB_STATES a literal string tuple and TRANSITIONS "
+            "a literal {state: (successor, ...)} dict so the model "
+            "checker can read the declared graph",
+        )
+        return
+    state_set = set(states)
+
+    # (a) self-consistency
+    for s in states:
+        if s not in transitions:
+            yield Finding(
+                rule="state-machine",
+                path=states_path,
+                line=t_line,
+                message=f"state {s!r} has no TRANSITIONS row",
+                hint="every registered state needs a row — () for "
+                "terminal states",
+            )
+    for src, succs in transitions.items():
+        if src not in state_set:
+            yield Finding(
+                rule="state-machine",
+                path=states_path,
+                line=t_line,
+                message=f"TRANSITIONS key {src!r} is not in JOB_STATES",
+                hint="register the state or drop the row",
+            )
+        for dst in succs:
+            if dst not in state_set:
+                yield Finding(
+                    rule="state-machine",
+                    path=states_path,
+                    line=t_line,
+                    message=f"TRANSITIONS edge {src!r} -> {dst!r} targets "
+                    f"an unregistered state",
+                    hint="register the state or fix the typo",
+                )
+    roots = [s for s in initial if s in state_set] or (
+        ["queued"] if "queued" in state_set else []
+    )
+    for s in initial:
+        if s not in state_set:
+            yield Finding(
+                rule="state-machine",
+                path=states_path,
+                line=states_line,
+                message=f"INITIAL_STATES entry {s!r} is not in JOB_STATES",
+                hint="register the state or fix the typo",
+            )
+
+    # (b) reachability from admission
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        for dst in transitions.get(frontier.pop(), ()):
+            if dst in state_set and dst not in seen:
+                seen.add(dst)
+                frontier.append(dst)
+    for s in states:
+        if s not in seen:
+            yield Finding(
+                rule="state-machine",
+                path=states_path,
+                line=states_line,
+                message=f"state {s!r} is unreachable from the initial "
+                f"states (no admission path reaches it)",
+                hint="declare a transition chain from an INITIAL_STATES "
+                "entry, or drop the dead state",
+            )
+
+    # (c)/(d) observed writes vs the declared graph
+    views = _state_views(states, transitions)
+    initial_set = set(initial) or {"queued"}
+    observed: set = set()
+    serve_paths = [
+        p for p in corpus.package_paths()
+        if "serve" in p.split("/")[:-1] and p != states_path
+    ]
+    for path in serve_paths:
+        for kind, tos, node in _state_write_sites(
+            corpus.trees[path], state_set
+        ):
+            if kind == "create":
+                for t in sorted(tos):
+                    if t not in initial_set:
+                        yield Finding(
+                            rule="state-machine",
+                            path=path,
+                            line=node.lineno,
+                            message=f"journal entry created in non-initial "
+                            f"state {t!r}",
+                            hint="entries are created in INITIAL_STATES "
+                            "(admission); every other state must be "
+                            "reached via a declared transition",
+                        )
+                continue
+            fn = enclosing_function(node)
+            ev = (
+                _from_state_evidence(fn, state_set, views)
+                if fn is not None else set()
+            )
+            if not ev:
+                name = getattr(fn, "name", "<module>")
+                yield Finding(
+                    rule="state-machine",
+                    path=path,
+                    line=node.lineno,
+                    message=f"state transition written in {name}() with no "
+                    f"from-state evidence in scope",
+                    hint="guard (or assert) the entry's current state — "
+                    "or fence it — in the same function, so the "
+                    "transition's source is checkable",
+                )
+                continue
+            for t in sorted(tos):
+                legal_from = {
+                    f for f in ev if t in transitions.get(f, ())
+                }
+                if legal_from:
+                    observed |= {(f, t) for f in legal_from}
+                    continue
+                if ev <= views["TERMINAL_STATES"]:
+                    yield Finding(
+                        rule="state-machine",
+                        path=path,
+                        line=node.lineno,
+                        message=f"write of {t!r} over a terminal-state "
+                        f"entry (evidence: {sorted(ev)})",
+                        hint="terminal states have no successors — a "
+                        "finished job's journal entry may never be "
+                        "rewritten (its results/ file is the record)",
+                    )
+                else:
+                    yield Finding(
+                        rule="state-machine",
+                        path=path,
+                        line=node.lineno,
+                        message=f"undeclared transition "
+                        f"{sorted(ev)} -> {t!r}",
+                        hint="declare the edge in serve/states.py "
+                        "TRANSITIONS (and cover it) or fix the write",
+                    )
+
+    # (e) declared edges no code implements
+    for src in states:
+        for dst in transitions.get(src, ()):
+            if dst in state_set and (src, dst) not in observed:
+                yield Finding(
+                    rule="state-machine",
+                    path=states_path,
+                    line=t_line,
+                    message=f"declared transition {src!r} -> {dst!r} has "
+                    f"no write site in serve/",
+                    hint="implement the edge (a guarded state write) or "
+                    "prune the declaration — a fictional edge hides "
+                    "real drift",
+                )
+
+    # (f) the serving suite exercises the declared graph
+    anchor = corpus.find("tests/test_serve.py")
+    if anchor is None:
+        return
+    anchor_tree = corpus.trees[anchor]
+    blanket = any(
+        (isinstance(n, ast.Name) and n.id == "TRANSITIONS")
+        or (isinstance(n, ast.Attribute) and n.attr == "TRANSITIONS")
+        for n in ast.walk(anchor_tree)
+    )
+    if blanket:
+        return  # a registry-pin/parametrize over the table covers it
+    roots_: list[ast.AST] = []
+    for n in ast.walk(anchor_tree):
+        if isinstance(n, ast.Call):
+            roots_.extend(n.args)
+            roots_.extend(kw.value for kw in n.keywords)
+        elif isinstance(n, ast.Assign):
+            roots_.append(n.value)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            # `for edge in ("a->b", ...):` — the natural shape of a
+            # per-edge driving loop
+            roots_.append(n.iter)
+        elif isinstance(n, ast.Compare):
+            roots_.extend(n.comparators)
+    literals = [
+        lit
+        for root in roots_
+        for sub in ast.walk(root)
+        if (lit := str_const(sub)) is not None
+    ]
+    for src in states:
+        for dst in transitions.get(src, ()):
+            edge = f"{src}->{dst}"
+            if not any(edge in lit for lit in literals):
+                yield Finding(
+                    rule="state-machine",
+                    path=anchor,
+                    line=1,
+                    message=f"declared transition {edge} is never "
+                    f"exercised by the serving suite",
+                    hint="add a test driving it (or a registry pin "
+                    "walking serve.states.TRANSITIONS) in "
+                    "tests/test_serve.py",
+                )
+
+
+# ------------------------------------------------------ rule: txn discipline
+
+# calls that hold the device, the disk, or the clock hostage: none may
+# run while journal.lock is held — every other daemon's every journal
+# move convoys behind it
+_TXN_SLOW_CALLS = {
+    "fsync", "fsync_file", "sleep", "result",
+    "stream_call_consensus", "run_slice", "splice_shards", "plan_shards",
+}
+
+
+def _is_journal_receiver(e: ast.AST) -> bool:
+    """Does this ``.save()`` receiver look like the journal queue —
+    ``self`` (inside SpoolQueue) or a ``*queue*``-named handle (the
+    service's ``self.queue``)? Anything else (a figure, a config
+    object, a report writer) has its own save semantics and is not a
+    journal persist."""
+    from duplexumiconsensusreads_tpu.analysis.engine import expr_path
+
+    path = expr_path(e)
+    if path is None:
+        return False
+    last = path.split(".")[-1]
+    return last == "self" or "queue" in last.lower()
+
+
+def _inside_txn(node: ast.AST) -> bool:
+    """Is ``node`` lexically inside a ``with <x>._txn():`` body?"""
+    for a in ancestors(node):
+        if isinstance(a, (ast.With, ast.AsyncWith)) and any(
+            isinstance(item.context_expr, ast.Call)
+            and call_name(item.context_expr) == "_txn"
+            for item in a.items
+        ):
+            return True
+    return False
+
+
+def _jobs_mutation(node: ast.AST) -> str | None:
+    """Describe a mutation of the ``jobs`` journal cache, or None:
+    subscript/attribute (re)assignment, ``del jobs[...]``, or a
+    mutating method call on a ``jobs`` receiver (the receiver test is
+    :func:`_is_jobs_expr`, shared with the state-machine rule so the
+    two passes can never disagree about what the cache is)."""
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if isinstance(t, ast.Subscript) and _is_jobs_expr(t.value):
+                return "jobs[...] assignment"
+            if _is_jobs_expr(t):
+                return "jobs cache rebind"
+    if isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and _is_jobs_expr(t.value):
+                return "del jobs[...]"
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _MUTATORS
+        and _is_jobs_expr(node.func.value)
+    ):
+        return f"jobs.{node.func.attr}(...)"
+    return None
+
+
+@register(
+    "txn-discipline",
+    "serve/ journal/jobs-cache mutations happen inside a _txn; no slow "
+    "ops or nested txn acquisition inside a txn body",
+)
+def check_txn_discipline(corpus: Corpus) -> Iterator[Finding]:
+    """The flock'd-transaction contract (serve/queue.py \"Fleet
+    transactions\"): every journal mutation is reload -> mutate ->
+    durable persist under journal.lock. Three drift classes:
+
+    (a) a ``jobs``-cache mutation or ``save()`` persist outside any
+        ``with self._txn():`` body — unless the enclosing function is
+        declared caller-holds-the-lock (``*_locked`` suffix, the
+        ``TXN_CACHE_HELPERS`` registry in serve/queue.py, or
+        ``__init__``): an untransacted mutation is the refresh()
+        lost-renewal bug class, a silent fleet write race;
+    (b) a slow call (fsync/sleep/compress/a future's result()/device
+        dispatch) lexically inside a txn body: journal.lock serializes
+        the WHOLE fleet's journal moves, so holding it across slow work
+        convoys every daemon (the deliberate exception — the durable
+        result write sharing mark_done's fence transaction — routes
+        through write_durable, which is not in the slow-call set);
+    (c) nested txn acquisition: a txn body opening another txn (a
+        second ``_txn()`` with, or a call to any method that opens one)
+        self-deadlocks the daemon under flock."""
+    serve_paths = [
+        p for p in corpus.package_paths() if "serve" in p.split("/")[:-1]
+    ]
+    if not serve_paths:
+        return
+    # methods that OPEN a transaction, collected across serve/: any
+    # call to one of these inside a txn body is a nested acquisition
+    txn_methods: set = set()
+    for path in serve_paths:
+        for fn in ast.walk(corpus.trees[path]):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+                isinstance(n, (ast.With, ast.AsyncWith))
+                and any(
+                    isinstance(i.context_expr, ast.Call)
+                    and call_name(i.context_expr) == "_txn"
+                    for i in n.items
+                )
+                for n in ast.walk(fn)
+            ):
+                txn_methods.add(fn.name)
+    # caller-holds-the-lock helpers declared in the queue module
+    helpers: set = {"__init__"}
+    queue_path = corpus.find("serve/queue.py")
+    if queue_path is not None:
+        declared, _ = str_tuple_assign(
+            corpus.trees[queue_path], "TXN_CACHE_HELPERS"
+        )
+        helpers |= set(declared)
+
+    for path in serve_paths:
+        tree = corpus.trees[path]
+        for node in ast.walk(tree):
+            # (c) direct nesting: a txn `with` whose ancestors already
+            # hold one (the call-a-txn-method form is handled below)
+            if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                isinstance(i.context_expr, ast.Call)
+                and call_name(i.context_expr) == "_txn"
+                for i in node.items
+            ) and _inside_txn(node):
+                yield Finding(
+                    rule="txn-discipline",
+                    path=path,
+                    line=node.lineno,
+                    message="nested journal transaction: `with _txn()` "
+                    "inside a txn body",
+                    hint="flock self-deadlocks on re-acquisition from a "
+                    "second fd — one transaction owns the whole move",
+                )
+            # (a) mutations + persists must be transacted
+            desc = _jobs_mutation(node)
+            is_save = (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "save"
+                and _is_journal_receiver(node.func.value)
+            )
+            if (desc or is_save) and not _inside_txn(node):
+                fn = enclosing_function(node)
+                name = getattr(fn, "name", None)
+                exempt = name is not None and (
+                    name.endswith("_locked") or name in helpers
+                )
+                if not exempt:
+                    what = desc or "journal save()"
+                    yield Finding(
+                        rule="txn-discipline",
+                        path=path,
+                        line=node.lineno,
+                        message=f"{what} outside a journal transaction "
+                        f"in {name or '<module>'}()",
+                        hint="wrap the mutation in `with self._txn():` "
+                        "(or mark the helper caller-holds-the-lock: "
+                        "*_locked suffix / TXN_CACHE_HELPERS)",
+                    )
+            if not isinstance(node, ast.Call) or not _inside_txn(node):
+                continue
+            name = call_name(node)
+            # (b) slow ops under journal.lock
+            if name in _TXN_SLOW_CALLS or "compress" in name.lower():
+                yield Finding(
+                    rule="txn-discipline",
+                    path=path,
+                    line=node.lineno,
+                    message=f"slow call {name}() inside a journal "
+                    f"transaction body",
+                    hint="do the slow work outside the txn — "
+                    "journal.lock serializes the whole fleet's "
+                    "journal moves",
+                )
+            # (c) nested acquisition: a call to any txn-opening method
+            # inside a txn body (the `self._txn()` call that opens THIS
+            # body never matches — "_txn" itself opens no inner txn)
+            if name in txn_methods:
+                yield Finding(
+                    rule="txn-discipline",
+                    path=path,
+                    line=node.lineno,
+                    message=f"nested journal transaction: {name}() "
+                    f"opens a txn inside a txn body",
+                    hint="flock self-deadlocks on re-acquisition "
+                    "from a second fd — restructure so one "
+                    "transaction owns the whole move",
+                )
+
+
+# ------------------------------------------------------ rule: fence dominance
+
+# the durable job-path commits: every one must carry the caller's lease
+# identity (daemon_id + fencing token — the journal transaction fences
+# on them) or run under the shared fenced-renewal guard
+_PUBLISH_CALLS = {
+    "mark_done", "mark_failed", "mark_expired", "requeue",
+    "register_shards",
+}
+# the registered fence helpers: a call to any of these in the same
+# function dominates the publish (worker.fenced_renew is THE shared
+# guard; the queue-internal _check_fence is the transaction-side check)
+_FENCE_CALLS = {"fenced_renew", "_fenced_renew", "verify_lease",
+                "_check_fence"}
+
+
+@register(
+    "fence-dominance",
+    "serve/ durable publishes (mark_*/requeue/register_shards) must be "
+    "fenced: lease identity passed, or a fenced-renew guard in scope",
+)
+def check_fence_dominance(corpus: Corpus) -> Iterator[Finding]:
+    """The zombie-writer gate: a daemon that lost its lease must not be
+    able to publish, requeue or journal ANYTHING for the job (the
+    reclaiming daemon owns it now). The queue's mutating methods fence
+    inside their transaction — but only when the caller passes its
+    lease identity, so an identity-less call site is an unfenced escape
+    hatch that ships silently and loses a race years later. Every call
+    to a publish-family method outside serve/queue.py must therefore
+    (a) mention the lease identity (a ``token``/``daemon_id`` name in
+    its arguments), or (b) sit in a function that runs a registered
+    fence guard (``fenced_renew``/``verify_lease``) itself."""
+    for path in corpus.package_paths():
+        if "serve" not in path.split("/")[:-1] or path.endswith(
+            "serve/queue.py"
+        ):
+            continue
+        tree = corpus.trees[path]
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _PUBLISH_CALLS:
+                continue
+            args: list[ast.AST] = list(node.args) + [
+                kw.value for kw in node.keywords
+            ]
+            kw_names = [kw.arg for kw in node.keywords if kw.arg]
+            fenced = any(
+                "token" in n or "daemon" in n
+                for n in kw_names
+            ) or any(
+                ("token" in sub.id.lower() or "daemon" in sub.id.lower())
+                if isinstance(sub, ast.Name)
+                else ("token" in sub.attr.lower()
+                      or "daemon" in sub.attr.lower())
+                if isinstance(sub, ast.Attribute)
+                else False
+                for a in args
+                for sub in ast.walk(a)
+            )
+            if not fenced:
+                fn = enclosing_function(node)
+                scope = fn if fn is not None else tree
+                fenced = any(
+                    isinstance(n, ast.Call)
+                    and call_name(n) in _FENCE_CALLS
+                    for n in ast.walk(scope)
+                )
+            if not fenced:
+                yield Finding(
+                    rule="fence-dominance",
+                    path=path,
+                    line=node.lineno,
+                    message=f"unfenced durable publish "
+                    f"{call_name(node)}(...)",
+                    hint="pass the slice's lease identity (daemon_id + "
+                    "token — the journal txn fences on them) or guard "
+                    "the function with fenced_renew",
+                )
+
+
+# -------------------------------------------------- rule: exception contract
+
+# the exceptions whose HANDLING is part of the protocol, not local
+# style. "base": the exact declared base class — JobFenced/InjectedKill
+# are BaseException precisely so no `except Exception` ladder can
+# absorb a modelled kill or a fence abort; changing the base voids the
+# kill-equals-SIGKILL and zombie-fencing contracts everywhere at once.
+# "reraise": deterministic invariant violations — a retry re-derives
+# the identical failure, so any handler naming them must re-raise
+# immediately, and no broad handler may sit between a raising call and
+# its re-raise guard.
+CONTRACT_EXCEPTIONS = {
+    "JobFenced": {"base": "BaseException", "reraise": False},
+    "InjectedKill": {"base": "BaseException", "reraise": False},
+    "D2hCompactionOverflow": {"base": "RuntimeError", "reraise": True},
+}
+
+
+def _handler_type_names(type_node: ast.AST | None) -> set:
+    names: set = set()
+    if type_node is None:
+        return names
+    nodes = (
+        list(type_node.elts) if isinstance(type_node, ast.Tuple)
+        else [type_node]
+    )
+    for n in nodes:
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.add(n.attr)
+    return names
+
+
+def _raised_name(exc: ast.AST | None) -> str | None:
+    if exc is None:
+        return None
+    if isinstance(exc, ast.Call):
+        return call_name(exc)
+    if isinstance(exc, ast.Name):
+        return exc.id
+    if isinstance(exc, ast.Attribute):
+        return exc.attr
+    return None
+
+
+@register(
+    "exception-contract",
+    "runtime//serve/ handlers honour the contract exceptions: "
+    "BaseException kills stay unabsorbed, deterministic overflows "
+    "re-raise immediately",
+)
+def check_exception_contract(corpus: Corpus) -> Iterator[Finding]:
+    """Walks every handler in ``runtime/`` + ``serve/`` against
+    :data:`CONTRACT_EXCEPTIONS`:
+
+    (a) each contract exception's class keeps its declared base — a
+        JobFenced quietly rebased onto Exception would be absorbed by
+        every job-scoped ``except Exception`` and break zombie fencing
+        with no test noticing until a takeover race lands;
+    (b) no bare ``except:`` — it absorbs the BaseException contracts
+        (InjectedKill's kill-equals-SIGKILL model, JobFenced aborts);
+    (c) an ``except BaseException`` handler must re-raise or capture
+        its exception (store-and-reraise, the service's fatal-path
+        idiom) — silently swallowing one un-models a kill;
+    (d) a handler naming a re-raise-immediately exception must have
+        ``raise`` as its FIRST statement: log-then-retry on a
+        deterministic overflow burns the whole retry/isolation ladder
+        re-deriving one invariant violation;
+    (e) a ``try`` whose body calls a function that (transitively, one
+        wrapper hop) raises a re-raise-immediately exception must not
+        absorb it in a broad Exception/BaseException handler without a
+        dedicated re-raise handler first — the retry-ladder shape that
+        motivated the contract."""
+    scoped = [
+        p for p in corpus.package_paths()
+        if {"runtime", "serve"} & set(p.split("/")[:-1])
+    ]
+    reraise_names = {
+        name for name, spec in CONTRACT_EXCEPTIONS.items() if spec["reraise"]
+    }
+
+    # (a) declared bases
+    for path in scoped:
+        for node in ast.walk(corpus.trees[path]):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            spec = CONTRACT_EXCEPTIONS.get(node.name)
+            if spec is None:
+                continue
+            bases = {
+                b.id if isinstance(b, ast.Name)
+                else b.attr if isinstance(b, ast.Attribute) else "?"
+                for b in node.bases
+            }
+            if spec["base"] not in bases:
+                yield Finding(
+                    rule="exception-contract",
+                    path=path,
+                    line=node.lineno,
+                    message=f"{node.name} must derive {spec['base']} "
+                    f"(declared contract), found {sorted(bases)}",
+                    hint="the exception's BASE is the contract: "
+                    "BaseException contracts must sail through every "
+                    "`except Exception` ladder",
+                )
+
+    # direct raisers of re-raise-immediately exceptions, plus one
+    # wrapper hop (the unpack()-style local adapters the retry ladders
+    # actually call); deeper call chains end at a job boundary where
+    # failing the job IS the contract, so propagation stops here
+    direct: set = set()
+    for path in scoped:
+        for fn in ast.walk(corpus.trees[path]):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if any(
+                isinstance(n, ast.Raise)
+                and _raised_name(n.exc) in reraise_names
+                for n in ast.walk(fn)
+            ):
+                direct.add(fn.name)
+    raisers = set(direct)
+    for path in scoped:
+        for fn in ast.walk(corpus.trees[path]):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in direct:
+                continue
+            if any(
+                isinstance(n, ast.Call) and call_name(n) in direct
+                for n in ast.walk(fn)
+            ):
+                raisers.add(fn.name)
+
+    for path in scoped:
+        tree = corpus.trees[path]
+        for node in ast.walk(tree):
+            # (b)/(c)/(d) per handler
+            if isinstance(node, ast.ExceptHandler):
+                if node.type is None:
+                    yield Finding(
+                        rule="exception-contract",
+                        path=path,
+                        line=node.lineno,
+                        message="bare `except:` absorbs the BaseException "
+                        "contracts (InjectedKill, JobFenced)",
+                        hint="catch the exception classes you mean; a "
+                        "modelled kill must leave real-SIGKILL state",
+                    )
+                    continue
+                names = _handler_type_names(node.type)
+                if "BaseException" in names:
+                    reraises = any(
+                        isinstance(n, ast.Raise)
+                        for stmt in node.body
+                        for n in ast.walk(stmt)
+                    )
+                    captures = node.name is not None and any(
+                        isinstance(n, ast.Name) and n.id == node.name
+                        for stmt in node.body
+                        for n in ast.walk(stmt)
+                    )
+                    if not (reraises or captures):
+                        yield Finding(
+                            rule="exception-contract",
+                            path=path,
+                            line=node.lineno,
+                            message="except BaseException handler neither "
+                            "re-raises nor captures the exception",
+                            hint="re-raise (cleanup handlers) or store it "
+                            "for the fatal path (the service's "
+                            "_fatal idiom) — never swallow a kill",
+                        )
+                hit = names & reraise_names
+                if hit:
+                    first = node.body[0] if node.body else None
+                    ok = isinstance(first, ast.Raise) and (
+                        first.exc is None
+                        or (
+                            isinstance(first.exc, ast.Name)
+                            and first.exc.id == node.name
+                        )
+                    )
+                    if not ok:
+                        yield Finding(
+                            rule="exception-contract",
+                            path=path,
+                            line=node.lineno,
+                            message=f"handler for {sorted(hit)} must "
+                            f"re-raise immediately (first statement)",
+                            hint="deterministic invariant violations "
+                            "re-derive identically — retrying or "
+                            "logging-then-continuing burns the ladder "
+                            "for nothing",
+                        )
+                continue
+            # (e) retry-ladder absorption
+            if not isinstance(node, ast.Try):
+                continue
+            body_calls = {
+                call_name(n)
+                for stmt in node.body
+                for n in ast.walk(stmt)
+                if isinstance(n, ast.Call)
+            }
+            risky = body_calls & raisers
+            if not risky:
+                continue
+            for h in node.handlers:
+                names = _handler_type_names(h.type)
+                if names & reraise_names:
+                    break  # dedicated guard precedes the broad ladder
+                broad = h.type is None or {
+                    "Exception", "BaseException"
+                } & names
+                if broad and not any(
+                    isinstance(n, ast.Raise)
+                    for stmt in h.body
+                    for n in ast.walk(stmt)
+                ):
+                    yield Finding(
+                        rule="exception-contract",
+                        path=path,
+                        line=h.lineno,
+                        message=f"broad handler may absorb "
+                        f"{sorted(reraise_names)} raised by "
+                        f"{sorted(risky)}()",
+                        hint="add `except D2hCompactionOverflow: raise` "
+                        "(the deterministic-failure guard) before the "
+                        "broad retry handler",
+                    )
+                    break

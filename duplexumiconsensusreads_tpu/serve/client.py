@@ -18,11 +18,12 @@ import time
 
 from duplexumiconsensusreads_tpu.serve.job import validate_spec
 from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
+from duplexumiconsensusreads_tpu.serve import states
 
-# states with nothing left to wait for
-TERMINAL_STATES = (
-    "done", "failed", "rejected", "expired", "quarantined", "unknown",
-)
+# states with nothing left to wait for: the journal's terminal family
+# (from the declared state machine) plus the client-side "unknown"
+# pseudo-state status() reports for a job no record answers for
+TERMINAL_STATES = states.TERMINAL_STATES + ("unknown",)
 
 # --wait backoff: the delay doubles from poll_s up to this cap, with
 # multiplicative jitter so a herd of waiting clients (every `--wait`

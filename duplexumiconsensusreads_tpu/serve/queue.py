@@ -75,42 +75,27 @@ from duplexumiconsensusreads_tpu.io.durable import (
 )
 from duplexumiconsensusreads_tpu.serve.job import JobSpec, validate_spec
 
+# the job state machine — states, legal transitions, and the derived
+# families — lives in serve/states.py (the single declared source of
+# truth dutlint's state-machine rule checks the code against); the
+# names are re-exported here so queue-side callers keep one import
+from duplexumiconsensusreads_tpu.serve.states import (  # noqa: F401
+    CLAIMED_STATES,
+    JOB_STATES,
+    OPEN_STATES,
+    TERMINAL_STATES,
+    TRANSITIONS,
+)
+
 JOURNAL_VERSION = 1
 
-# journal job states; the only legal transitions are
-#   queued -> running -> (done | failed | queued on preempt/reclaim)
-#   queued -> expired            (deadline passed before a claim)
-#   running -> expired           (slice aborted at a chunk boundary)
-#   running -> quarantined       (crash_count reached max_crashes on a
-#                                 takeover/watchdog abort — the job is
-#                                 poison: it kills whatever runs it, so
-#                                 it must never re-enter the queue)
-# and, for a sharding PARENT (spec carries shards/shard_bytes —
-# serve/shard/):
-#   queued -> splitting -> fanned -> queued -> merging -> done | failed
-# "splitting"/"merging" are the parent's claimed states (a lease +
-# fencing token protect them exactly like "running"; the journal
-# phase field decides which literal a claim writes); "fanned" is the
-# parked aggregate state while sub-jobs run — no lease, not claimable,
-# advanced by the fleet's parent sweep when the last child lands.
-JOB_STATES = ("queued", "running", "done", "failed", "rejected",
-              "expired", "quarantined", "splitting", "fanned", "merging")
-
-# states held under a lease + fencing token: the fence check, lease
-# renewal, takeover and watchdog sweeps all treat them alike — a
-# planner or merger slice is fenced/reclaimed exactly like a consensus
-# slice
-CLAIMED_STATES = ("running", "splitting", "merging")
-
-# states with scheduling work left: the fleet idle check and the
-# admission open-jobs bound count these (a fanned parent IS open work —
-# its merge hasn't happened)
-OPEN_STATES = ("queued", "fanned") + CLAIMED_STATES
-
-# states with nothing left to schedule: compaction may drop them (their
-# durable results/ file remains the record) and the idle check ignores
-# them
-TERMINAL_STATES = ("done", "failed", "rejected", "expired", "quarantined")
+# helpers that may touch the in-memory jobs cache OUTSIDE a lexical
+# `with self._txn():` body because their caller owns the transaction
+# (or, for _load, because the client-side read path is documented
+# single-threaded — see status()). dutlint's txn-discipline rule reads
+# this registry; everything not named here (and not *_locked/__init__)
+# must mutate the cache inside a transaction.
+TXN_CACHE_HELPERS = ("_load", "_compact")
 
 # poison quarantine: a job whose run aborts THIS many times without a
 # clean preemption (daemon death takeovers, watchdog stall reclaims) is
@@ -805,6 +790,9 @@ class SpoolQueue:
         would run compaction mid-iteration (mutating the dict being
         swept) and rewrite+fsync the journal N times for one sweep.
         Returns the reclaim record for the caller's counters/events."""
+        # only a leased state can abort uncleanly — and this assert is
+        # also the from-state evidence the state-machine lint reads
+        assert entry.get("state") in CLAIMED_STATES, entry.get("state")
         lease = entry.get("lease")
         prev = (lease or {}).get("owner")
         crashes = int(entry.get("crash_count", 0)) + 1

@@ -74,10 +74,10 @@ from duplexumiconsensusreads_tpu.serve.queue import (
     DISK_LOW_WATER_BYTES,
     LEASE_DEFAULT_S,
     MAX_CRASHES_DEFAULT,
-    OPEN_STATES,
     JobFenced,
     SpoolQueue,
 )
+from duplexumiconsensusreads_tpu.serve.states import OPEN_STATES
 from duplexumiconsensusreads_tpu.serve.scheduler import FairScheduler
 from duplexumiconsensusreads_tpu.serve.worker import (
     JobDeadlineExceeded,

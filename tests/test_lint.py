@@ -45,6 +45,9 @@ class TestEngine:
             "clock-discipline", "durability-protocol", "fault-registry",
             "phase-registry", "lock-discipline", "hook-guard",
             "lease-discipline", "deadline-discipline",
+            # the protocol model-checker passes
+            "state-machine", "txn-discipline", "fence-dominance",
+            "exception-contract",
         } <= set(RULES)
         for rule in RULES.values():
             assert rule.title
@@ -818,6 +821,549 @@ class TestHookGuard:
         assert res.ok
 
 
+STATES_OK = """
+    JOB_STATES = ("queued", "running", "done", "failed", "quarantined",
+                  "rejected")
+    INITIAL_STATES = ("queued", "rejected")
+    TRANSITIONS = {
+        "queued": ("running",),
+        "running": ("done", "failed", "queued", "quarantined"),
+        "done": (),
+        "failed": (),
+        "quarantined": (),
+        "rejected": (),
+    }
+    """
+# a queue implementing every declared edge, each write with from-state
+# evidence (comparison guard, fence-guard call, or membership assert)
+QUEUE_SM_OK = """
+    class Q:
+        def admit(self, jid, ok):
+            if ok:
+                self.jobs[jid] = {"state": "queued", "seq": 0}
+            else:
+                self.jobs[jid] = {"state": "rejected", "seq": 0}
+        def claim(self, entry):
+            if entry.get("state") != "queued":
+                return None
+            entry["state"] = "running"
+        def finish(self, entry, good):
+            self._check_fence(entry)
+            entry["state"] = "done" if good else "failed"
+        def requeue(self, entry):
+            self._check_fence(entry)
+            entry["state"] = "queued"
+        def quarantine(self, entry):
+            assert entry.get("state") in CLAIMED_STATES
+            entry["state"] = "quarantined"
+    """
+# a registry-pin referencing TRANSITIONS satisfies the coverage leg
+TESTS_SM_OK = """
+    from pkg.serve import states
+    def test_pin():
+        walk(states.TRANSITIONS)
+    """
+
+
+class TestStateMachine:
+    def base(self, **over):
+        files = {
+            "pkg/serve/states.py": STATES_OK,
+            "pkg/serve/queue.py": QUEUE_SM_OK,
+            "tests/test_serve.py": TESTS_SM_OK,
+        }
+        files.update(over)
+        return lint(files, rules=["state-machine"])
+
+    def test_passes_when_code_matches_the_declared_graph(self):
+        assert self.base().ok
+
+    def test_missing_states_module_skips_the_rule(self):
+        res = lint(
+            {"pkg/serve/queue.py": QUEUE_SM_OK}, rules=["state-machine"]
+        )
+        assert res.ok
+
+    def test_fires_on_write_over_a_terminal_state(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def resurrect(entry):
+                if entry.get("state") == "done":
+                    entry["state"] = "queued"
+            """})
+        assert rules_of(res) == [("state-machine", "pkg/serve/svc.py")]
+        assert "terminal" in res.findings[0].message
+
+    def test_fires_on_undeclared_transition(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def unadmit(entry):
+                if entry.get("state") == "running":
+                    entry["state"] = "rejected"
+            """})
+        assert rules_of(res) == [("state-machine", "pkg/serve/svc.py")]
+        assert "undeclared transition" in res.findings[0].message
+        assert "rejected" in res.findings[0].message
+
+    def test_fires_on_write_without_from_state_evidence(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def zap(entry):
+                entry["state"] = "queued"
+            """})
+        assert rules_of(res) == [("state-machine", "pkg/serve/svc.py")]
+        assert "no from-state evidence" in res.findings[0].message
+        assert "zap" in res.findings[0].message
+
+    def test_fence_guard_counts_as_claimed_evidence(self):
+        # the real codebase's idiom: _check_fence proves CLAIMED, so a
+        # publish function needs no literal state comparison
+        res = self.base(**{"pkg/serve/svc.py": """
+            def publish(self, entry):
+                self._check_fence(entry)
+                entry["state"] = "done"
+            """})
+        assert res.ok
+
+    def test_fires_on_creation_in_non_initial_state(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def smuggle(self, jid):
+                self.jobs[jid] = {"state": "running", "seq": 0}
+            """})
+        assert rules_of(res) == [("state-machine", "pkg/serve/svc.py")]
+        assert "non-initial" in res.findings[0].message
+
+    def test_temporary_dict_creation_is_seen(self):
+        # the accept_one pattern: entry built as a temporary, THEN
+        # journaled — still a creation, still held to INITIAL_STATES
+        res = self.base(**{"pkg/serve/svc.py": """
+            def smuggle(self, jid):
+                entry = {"state": "running", "seq": 0}
+                self.jobs[jid] = entry
+            """})
+        assert rules_of(res) == [("state-machine", "pkg/serve/svc.py")]
+        assert "non-initial" in res.findings[0].message
+
+    def test_status_dicts_that_never_reach_the_cache_are_ignored(self):
+        # read-side rendering: a response dict with a state field is
+        # not a journal-entry creation
+        res = self.base(**{"pkg/serve/svc.py": """
+            def status(jid):
+                resp = {"state": "done", "detail": "x"}
+                return resp
+            """})
+        assert res.ok
+
+    def test_update_and_setdefault_writes_are_seen(self):
+        # state writes in call clothing must not slip the gate
+        res = self.base(**{"pkg/serve/svc.py": """
+            def sneak(entry):
+                if entry.get("state") == "done":
+                    entry.update({"state": "queued"})
+            def sneak_kw(entry):
+                if entry.get("state") == "done":
+                    entry.update(state="queued")
+            def sneak_sd(entry):
+                if entry.get("state") == "done":
+                    entry.setdefault("state", "queued")
+            """})
+        assert [f.rule for f in res.findings] == ["state-machine"] * 3
+        assert all("terminal" in f.message for f in res.findings)
+
+    def test_guarded_update_write_passes(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def requeue(entry):
+                if entry.get("state") == "running":
+                    entry.update({"state": "queued"})
+            """})
+        assert res.ok
+
+    def test_full_registry_membership_is_not_evidence(self):
+        # `in JOB_STATES` proves nothing about the from-state: without
+        # this, a meaningless guard would launder terminal-state
+        # resurrection past the check
+        res = self.base(**{"pkg/serve/svc.py": """
+            def launder(entry):
+                if entry.get("state") in JOB_STATES:
+                    entry["state"] = "queued"
+            """})
+        assert rules_of(res) == [("state-machine", "pkg/serve/svc.py")]
+        assert "no from-state evidence" in res.findings[0].message
+
+    def test_fires_on_unreachable_state(self):
+        res = self.base(**{"pkg/serve/states.py": """
+            JOB_STATES = ("queued", "running", "done", "failed",
+                          "quarantined", "rejected", "limbo")
+            INITIAL_STATES = ("queued", "rejected")
+            TRANSITIONS = {
+                "queued": ("running",),
+                "running": ("done", "failed", "queued", "quarantined"),
+                "done": (),
+                "failed": (),
+                "quarantined": (),
+                "rejected": (),
+                "limbo": (),
+            }
+            """})
+        assert [f.rule for f in res.findings] == ["state-machine"]
+        assert "limbo" in res.findings[0].message
+        assert "unreachable" in res.findings[0].message
+
+    def test_fires_on_declared_edge_with_no_write_site(self):
+        res = self.base(**{"pkg/serve/states.py": STATES_OK.replace(
+            '"queued": ("running",),',
+            '"queued": ("running", "failed"),',
+        )})
+        assert [f.rule for f in res.findings] == ["state-machine"]
+        assert "no write site" in res.findings[0].message
+        assert "failed" in res.findings[0].message
+
+    def test_edge_literals_also_satisfy_the_coverage_leg(self):
+        # no TRANSITIONS reference, but every declared edge appears as
+        # a "src->dst" literal — the non-blanket coverage form
+        res = self.base(**{"tests/test_serve.py": """
+            def test_edges():
+                for edge in ("queued->running", "running->done",
+                             "running->failed", "running->queued",
+                             "running->quarantined"):
+                    drive(edge)
+            """})
+        assert res.ok
+
+    def test_fires_on_unexercised_declared_transition(self):
+        res = self.base(**{"tests/test_serve.py": """
+            def test_edges():
+                drive("queued->running")
+            """})
+        assert res.findings  # the four running->* edges are uncovered
+        assert all(f.path == "tests/test_serve.py" for f in res.findings)
+        assert any("running->done" in f.message for f in res.findings)
+
+
+TXN_QUEUE_OK = """
+    import contextlib
+    TXN_CACHE_HELPERS = ("_load",)
+    class Q:
+        @contextlib.contextmanager
+        def _txn(self):
+            self._load()
+            yield
+        def _load(self):
+            self.jobs = {}
+        def admit(self, jid, entry):
+            with self._txn():
+                self.jobs[jid] = entry
+                self.save()
+        def _compact_locked(self, jid):
+            del self.jobs[jid]
+        def save(self):
+            write_durable("queue.json", b"{}")
+    """
+
+
+class TestTxnDiscipline:
+    def base(self, **over):
+        files = {"pkg/serve/queue.py": TXN_QUEUE_OK}
+        files.update(over)
+        return lint(files, rules=["txn-discipline"])
+
+    def test_passes_on_transacted_mutations(self):
+        assert self.base().ok
+
+    def test_fires_on_jobs_mutation_outside_a_txn(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def rogue(q, jid):
+                q.jobs[jid] = {"state": "queued"}
+            """})
+        assert rules_of(res) == [("txn-discipline", "pkg/serve/svc.py")]
+        assert "outside a journal transaction" in res.findings[0].message
+
+    def test_fires_on_untransacted_save(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def flush(queue):
+                queue.save()
+            """})
+        assert rules_of(res) == [("txn-discipline", "pkg/serve/svc.py")]
+        assert "save()" in res.findings[0].message
+
+    def test_non_journal_save_receivers_are_ignored(self):
+        # .save() is only a journal persist on self/*queue* receivers —
+        # a figure/report object's save has its own semantics
+        res = self.base(**{"pkg/serve/svc.py": """
+            def snapshot(fig, path):
+                fig.save(path)
+            """})
+        assert res.ok
+
+    def test_locked_suffix_and_registry_helpers_are_exempt(self):
+        # _compact_locked and _load mutate the cache with the caller
+        # holding the lock — declared, not flagged (the base fixture
+        # already passes with both present)
+        res = self.base(**{"pkg/serve/svc.py": """
+            def _apply_locked(q, jid):
+                q.jobs[jid] = {"state": "queued"}
+            """})
+        assert res.ok
+
+    def test_fires_on_slow_call_inside_a_txn(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            import time
+            def slow(q, z, data):
+                with q._txn():
+                    time.sleep(1.0)
+                    z.compress(data)
+            """})
+        msgs = sorted(f.message for f in res.findings)
+        assert len(msgs) == 2
+        assert "compress()" in msgs[0] and "sleep()" in msgs[1]
+
+    def test_fires_on_nested_txn_via_method_call(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def outer(q, jid, entry):
+                with q._txn():
+                    q.admit(jid, entry)
+            """})
+        assert rules_of(res) == [("txn-discipline", "pkg/serve/svc.py")]
+        assert "nested journal transaction" in res.findings[0].message
+        assert "admit" in res.findings[0].message
+
+    def test_fires_on_directly_nested_txn_with(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def outer(q):
+                with q._txn():
+                    with q._txn():
+                        pass
+            """})
+        assert rules_of(res) == [("txn-discipline", "pkg/serve/svc.py")]
+        assert "with _txn()" in res.findings[0].message
+
+    def test_reads_need_no_txn(self):
+        res = self.base(**{"pkg/serve/svc.py": """
+            def peek(q, jid):
+                return q.jobs.get(jid, {}).get("state")
+            """})
+        assert res.ok
+
+
+class TestFenceDominance:
+    def test_passes_when_lease_identity_is_passed(self):
+        res = lint(
+            {"pkg/serve/service.py": """
+                def publish(q, jid, result, daemon_id, token):
+                    q.mark_done(jid, result, daemon_id, token)
+                def requeue(q, jid, n):
+                    q.requeue(jid, n, back=True, daemon_id="d", token=3)
+                """},
+            rules=["fence-dominance"],
+        )
+        assert res.ok
+
+    def test_passes_under_a_fence_guard_in_scope(self):
+        res = lint(
+            {"pkg/serve/service.py": """
+                def merge(q, jid, dicts):
+                    fenced_renew(q, jid)
+                    q.register_shards(jid, dicts)
+                """},
+            rules=["fence-dominance"],
+        )
+        assert res.ok
+
+    def test_fires_on_identity_less_publish(self):
+        res = lint(
+            {"pkg/serve/service.py": """
+                def publish(q, jid, result):
+                    q.mark_done(jid, result)
+                """},
+            rules=["fence-dominance"],
+        )
+        assert rules_of(res) == [("fence-dominance", "pkg/serve/service.py")]
+        assert "unfenced durable publish mark_done" in res.findings[0].message
+        assert "fenced_renew" in res.findings[0].hint
+
+    def test_queue_internals_and_non_serve_files_are_exempt(self):
+        res = lint(
+            {
+                # the implementation side: fences inside its own txn
+                "pkg/serve/queue.py": """
+                    class Q:
+                        def requeue(self, jid, n):
+                            self.jobs[jid]["chunks_done"] = n
+                    """,
+                # outside serve/: not on the job path
+                "pkg/runtime/stream.py": """
+                    def helper(q, jid):
+                        q.requeue(jid, 0)
+                    """,
+            },
+            rules=["fence-dominance"],
+        )
+        assert res.ok
+
+
+class TestExceptionContract:
+    def test_fires_on_contract_class_with_wrong_base(self):
+        res = lint(
+            {"pkg/serve/queue.py": """
+                class JobFenced(Exception):
+                    pass
+                """},
+            rules=["exception-contract"],
+        )
+        assert rules_of(res) == [("exception-contract", "pkg/serve/queue.py")]
+        assert "BaseException" in res.findings[0].message
+
+    def test_passes_on_declared_base(self):
+        res = lint(
+            {"pkg/serve/queue.py": """
+                class JobFenced(BaseException):
+                    pass
+                """},
+            rules=["exception-contract"],
+        )
+        assert res.ok
+
+    def test_fires_on_bare_except_in_scope(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def f(g):
+                    try:
+                        g()
+                    except:
+                        pass
+                """},
+            rules=["exception-contract"],
+        )
+        assert rules_of(res) == [("exception-contract", "pkg/runtime/stream.py")]
+        assert "bare" in res.findings[0].message
+
+    def test_fires_on_swallowed_base_exception(self):
+        res = lint(
+            {"pkg/serve/service.py": """
+                def f(g):
+                    try:
+                        g()
+                    except BaseException:
+                        pass
+                """},
+            rules=["exception-contract"],
+        )
+        assert rules_of(res) == [("exception-contract", "pkg/serve/service.py")]
+        assert "neither re-raises nor captures" in res.findings[0].message
+
+    def test_reraise_and_store_idioms_pass(self):
+        res = lint(
+            {"pkg/serve/service.py": """
+                def cleanup(g, f):
+                    try:
+                        g()
+                    except BaseException:
+                        f.close()
+                        raise
+                def fatal(self, g):
+                    try:
+                        g()
+                    except BaseException as e:
+                        self._fatal = e
+                """},
+            rules=["exception-contract"],
+        )
+        assert res.ok
+
+    def test_fires_on_deferred_reraise_of_overflow(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def f(unpack, log):
+                    try:
+                        return unpack()
+                    except D2hCompactionOverflow:
+                        log("overflow")
+                        raise
+                """},
+            rules=["exception-contract"],
+        )
+        assert rules_of(res) == [("exception-contract", "pkg/runtime/stream.py")]
+        assert "re-raise immediately" in res.findings[0].message
+
+    def test_immediate_reraise_passes(self):
+        res = lint(
+            {"pkg/runtime/stream.py": """
+                def f(unpack):
+                    try:
+                        return unpack()
+                    except D2hCompactionOverflow:
+                        raise
+                """},
+            rules=["exception-contract"],
+        )
+        assert res.ok
+
+    RAISER = """
+        class D2hCompactionOverflow(RuntimeError):
+            pass
+        def unpack_fetch_outputs(x):
+            raise D2hCompactionOverflow("overflow")
+        """
+
+    def test_fires_on_retry_ladder_absorbing_a_deterministic_raise(self):
+        # unpack() is one wrapper hop from the raise; the broad retry
+        # handler would re-derive the identical overflow forever
+        res = lint(
+            {
+                "pkg/runtime/executor.py": self.RAISER,
+                "pkg/runtime/stream.py": """
+                    def unpack(x):
+                        return unpack_fetch_outputs(x)
+                    def materialize(x):
+                        err = None
+                        for attempt in range(3):
+                            try:
+                                return unpack(x)
+                            except Exception as e:
+                                err = e
+                        raise err
+                    """,
+            },
+            rules=["exception-contract"],
+        )
+        assert [f.rule for f in res.findings] == ["exception-contract"]
+        assert "broad handler may absorb" in res.findings[0].message
+        assert res.findings[0].path == "pkg/runtime/stream.py"
+
+    def test_reraise_guard_before_the_broad_handler_passes(self):
+        res = lint(
+            {
+                "pkg/runtime/executor.py": self.RAISER,
+                "pkg/runtime/stream.py": """
+                    def unpack(x):
+                        return unpack_fetch_outputs(x)
+                    def materialize(x):
+                        err = None
+                        for attempt in range(3):
+                            try:
+                                return unpack(x)
+                            except D2hCompactionOverflow:
+                                raise
+                            except Exception as e:
+                                err = e
+                        raise err
+                    """,
+            },
+            rules=["exception-contract"],
+        )
+        assert res.ok
+
+    def test_out_of_scope_files_are_ignored(self):
+        res = lint(
+            {"pkg/telemetry/trace.py": """
+                def f(g):
+                    try:
+                        g()
+                    except:
+                        pass
+                """},
+            rules=["exception-contract"],
+        )
+        assert res.ok  # scope is runtime/ + serve/ only
+
+
 # ------------------------------------------------------------------- CLI
 
 class TestCli:
@@ -853,6 +1399,134 @@ class TestCli:
         assert p.returncode == 0
         for rid in RULES:
             assert rid in p.stdout
+
+    def test_json_findings_are_machine_readable(self, tmp_path):
+        # the CI/editor contract: exit 1 + a parseable report naming
+        # rule, file, line and message for every finding
+        bad = tmp_path / "pkg" / "runtime" / "hot.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("import time\ndef f():\n    return time.time()\n")
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+             "--root", str(tmp_path), "--json", "pkg/runtime/hot.py"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 1
+        rep = json.loads(p.stdout)
+        assert not rep["ok"]
+        (f,) = rep["findings"]
+        assert f["rule"] == "clock-discipline"
+        assert f["path"] == "pkg/runtime/hot.py"
+        assert f["line"] == 3
+        assert "time.time()" in f["message"]
+
+    def test_rule_selection_runs_only_the_named_pass(self, tmp_path):
+        # one file violating two rules; --rule bisects to one of them
+        bad = tmp_path / "pkg" / "runtime" / "w.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text(
+            "import time\n"
+            "def f(p):\n"
+            "    open(p, 'wb').write(b'x')\n"
+            "    return time.time()\n"
+        )
+        base = [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+                "--root", str(tmp_path), "--json", "pkg/runtime/w.py"]
+        both = json.loads(subprocess.run(
+            base, capture_output=True, text=True, timeout=120,
+        ).stdout)
+        assert {f["rule"] for f in both["findings"]} == {
+            "clock-discipline", "durability-protocol",
+        }
+        only = json.loads(subprocess.run(
+            base + ["--rule", "durability-protocol"],
+            capture_output=True, text=True, timeout=120,
+        ).stdout)
+        assert {f["rule"] for f in only["findings"]} == {
+            "durability-protocol",
+        }
+
+    def test_model_checker_violation_exits_1_naming_rule_and_line(
+        self, tmp_path
+    ):
+        # the new-pass CLI contract end-to-end: a protocol violation in
+        # a throwaway corpus exits 1 and names rule + file:line
+        states = tmp_path / "pkg" / "serve" / "states.py"
+        states.parent.mkdir(parents=True)
+        states.write_text(textwrap.dedent(STATES_OK))
+        svc = tmp_path / "pkg" / "serve" / "svc.py"
+        svc.write_text("def zap(entry):\n    entry['state'] = 'queued'\n")
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+             "--root", str(tmp_path), "--rule", "state-machine",
+             "pkg/serve/states.py", "pkg/serve/svc.py"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 1
+        assert "pkg/serve/svc.py:2: [state-machine]" in p.stdout
+
+    def test_unknown_rule_is_a_usage_error(self):
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+             "--rule", "no-such-rule"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 2
+        assert "unknown rule" in p.stderr
+
+    def test_strict_fails_on_stale_allowlist_entries(self, tmp_path):
+        # an empty root's default set suppresses nothing, so every real
+        # allowlist entry is stale there: --strict turns the warning
+        # into exit 1 (the ci_check gate), the default stays advisory
+        args = [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+                "--root", str(tmp_path)]
+        lax = subprocess.run(
+            args, capture_output=True, text=True, timeout=120,
+        )
+        assert lax.returncode == 0
+        assert "warning: unused allowlist entry" in lax.stderr
+        strict = subprocess.run(
+            args + ["--strict"], capture_output=True, text=True, timeout=120,
+        )
+        assert strict.returncode == 1
+        assert "error: unused allowlist entry" in strict.stderr
+
+
+# ------------------------------------------------------------ CI gate script
+
+class TestCiCheck:
+    """tools/ci_check.sh is the one-command commit gate (dutlint
+    --strict + check_trace --require-summary on the committed fixture
+    capture); running it here is what keeps it from rotting."""
+
+    def test_ci_check_passes_on_the_shipped_tree(self):
+        p = subprocess.run(
+            ["sh", os.path.join(REPO, "tools", "ci_check.sh")],
+            capture_output=True, text=True, timeout=300,
+            # the gate must lint under THIS suite's interpreter, not
+            # whatever `python` resolves to on PATH
+            env={**os.environ, "PYTHON": sys.executable},
+        )
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "[ci_check] OK" in p.stderr
+
+    def test_fixture_capture_is_complete_and_pinned(self, tmp_path):
+        # the committed capture must carry its terminal summary — and
+        # the validator must still FAIL a summary-less (crashed-run)
+        # capture, or the --require-summary leg means nothing
+        fixture = os.path.join(REPO, "tests", "data",
+                               "run.fixture.trace.jsonl")
+        lines = open(fixture).read().splitlines()
+        assert '"type":"summary"' in lines[-1]
+        torn = tmp_path / "torn.trace.jsonl"
+        torn.write_text("\n".join(lines[:-1]) + "\n")
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "check_trace.py"),
+             str(torn), "--require-summary"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 1
+        assert "summary" in p.stderr
 
 
 # ------------------------------------------------------------ tier-1 gate
@@ -897,6 +1571,9 @@ class TestShippedTree:
                          "queue.py"),
             os.path.join("duplexumiconsensusreads_tpu", "serve",
                          "service.py"),
+            # the declared state machine the model-checker rules anchor
+            os.path.join("duplexumiconsensusreads_tpu", "serve",
+                         "states.py"),
         ):
             assert must.replace("/", os.sep) in {
                 t.replace("/", os.sep) for t in targets

@@ -190,6 +190,73 @@ class TestFairScheduler:
             FairScheduler(chunk_budget=-1)
 
 
+# --------------------------------------------------------- state registry
+
+class TestStateRegistry:
+    """serve/states.py is the declared state machine; these pins are
+    the behaviour contract of the PR that introduced it — the derived
+    families must reproduce the pre-refactor literal tuples EXACTLY
+    (same members, same order), or the fleet's fence/idle/compaction
+    semantics changed. The TRANSITIONS walk doubles as the registry-pin
+    coverage the state-machine lint rule's test-exercise leg reads."""
+
+    def test_derived_views_reproduce_pre_refactor_tuples(self):
+        from duplexumiconsensusreads_tpu.serve import states
+
+        assert states.JOB_STATES == (
+            "queued", "running", "done", "failed", "rejected",
+            "expired", "quarantined", "splitting", "fanned", "merging",
+        )
+        assert states.CLAIMED_STATES == ("running", "splitting", "merging")
+        assert states.OPEN_STATES == (
+            "queued", "fanned", "running", "splitting", "merging",
+        )
+        assert states.TERMINAL_STATES == (
+            "done", "failed", "rejected", "expired", "quarantined",
+        )
+        assert states.INITIAL_STATES == ("queued", "rejected")
+
+    def test_transition_graph_is_well_formed(self):
+        from duplexumiconsensusreads_tpu.serve.states import (
+            INITIAL_STATES,
+            JOB_STATES,
+            TERMINAL_STATES,
+            TRANSITIONS,
+        )
+
+        assert set(TRANSITIONS) == set(JOB_STATES)
+        for src, succs in sorted(TRANSITIONS.items()):
+            for dst in succs:
+                assert dst in JOB_STATES, f"{src}->{dst}"
+            # terminal means terminal: no outgoing edges
+            if src in TERMINAL_STATES:
+                assert succs == (), src
+        # every state is reachable from admission
+        seen = set(INITIAL_STATES)
+        frontier = list(INITIAL_STATES)
+        while frontier:
+            for dst in TRANSITIONS[frontier.pop()]:
+                if dst not in seen:
+                    seen.add(dst)
+                    frontier.append(dst)
+        assert seen == set(JOB_STATES)
+
+    def test_queue_re_exports_the_registry(self):
+        # queue-side callers (and older imports) read the same objects
+        from duplexumiconsensusreads_tpu.serve import queue, states
+
+        assert queue.JOB_STATES is states.JOB_STATES
+        assert queue.CLAIMED_STATES is states.CLAIMED_STATES
+        assert queue.OPEN_STATES is states.OPEN_STATES
+        assert queue.TERMINAL_STATES is states.TERMINAL_STATES
+        assert queue.TRANSITIONS is states.TRANSITIONS
+        # the client's wait-terminal view is the registry plus its one
+        # client-side pseudo-state
+        assert client.TERMINAL_STATES == states.TERMINAL_STATES + (
+            "unknown",
+        )
+
+
 # ----------------------------------------------------------- spool queue
 
 class TestSpoolQueue:

@@ -1,0 +1,34 @@
+#!/bin/sh
+# CI gate: the repo's commit-time contracts, runnable as one command.
+#
+#   sh tools/ci_check.sh
+#
+# Two legs, both exit-1 on violation:
+#
+#   1. dutlint --strict over the whole default set (package + tools/ +
+#      test anchors): every invariant rule active, zero non-allowlisted
+#      findings, AND zero stale allowlist entries — a suppression whose
+#      finding was fixed must be pruned in the same change.
+#   2. check_trace --require-summary over the committed fixture capture
+#      (tests/data/run.fixture.trace.jsonl): the telemetry schema
+#      validator itself must accept a known-good, COMPLETE capture —
+#      so a schema change that would reject healthy runs (or a
+#      validator regression that accepts torn ones) fails here, not in
+#      production triage.
+#
+# tests/test_lint.py runs this script as a tier-1 test, so the gate
+# cannot rot out of CI.
+set -eu
+root="$(cd "$(dirname "$0")/.." && pwd)"
+# honour the caller's interpreter (the tier-1 test passes its own
+# sys.executable); bare `python` is PATH-dependent on python3-only hosts
+py="${PYTHON:-python}"
+
+echo "[ci_check] dutlint --strict (all rules, stale-allowlist fatal)" >&2
+"$py" "$root/tools/dutlint.py" --strict
+
+echo "[ci_check] check_trace --require-summary (fixture capture)" >&2
+"$py" "$root/tools/check_trace.py" \
+    "$root/tests/data/run.fixture.trace.jsonl" --require-summary
+
+echo "[ci_check] OK" >&2

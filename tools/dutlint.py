@@ -3,12 +3,15 @@
 
     python tools/dutlint.py              # lint package + tools + anchors
     python tools/dutlint.py --list-rules
-    python tools/dutlint.py --rule fault-registry -v
-    python tools/dutlint.py --json       # machine-readable (CI)
+    python tools/dutlint.py --rule state-machine -v   # bisect one pass
+    python tools/dutlint.py --json       # machine-readable (CI/editors)
+    python tools/dutlint.py --strict     # + stale allowlist = exit 1
 
-Exit 1 on any non-allowlisted finding. Sibling of tools/check_trace.py
-(runtime capture validation) — this one validates the SOURCE against
-the same contracts, at PR time instead of run time.
+Exit 1 on any non-allowlisted finding (and, under --strict, on stale
+allowlist entries). Sibling of tools/check_trace.py (runtime capture
+validation) — this one validates the SOURCE against the same
+contracts, at PR time instead of run time; tools/ci_check.sh runs
+both as the one-command commit gate.
 """
 
 import os
