@@ -290,6 +290,11 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
     xfer_dirs, _ = str_tuple_assign(
         corpus.trees[trace_path], "KNOWN_XFER_DIRS"
     )
+    # h2d ledger-record attr registry (the bucket-tuner's fill-factor
+    # audit fields ride h2d records; absent in pre-tuner corpora)
+    h2d_attrs, _ = str_tuple_assign(
+        corpus.trees[trace_path], "KNOWN_H2D_XFER_ATTRS"
+    )
     if not stages:
         yield Finding(
             rule="phase-registry",
@@ -393,6 +398,25 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
                     "KNOWN_XFER_DIRS (and the ledger analysis + "
                     "ARCHITECTURE.md schema)",
                 )
+            if name == "xfer" and lit == "h2d" and h2d_attrs:
+                # h2d records carry the packing/fill audit attrs; an
+                # unregistered keyword is a silent schema fork — the
+                # xfer envelope golden and wirestat's fill reader both
+                # key on the registered set
+                for kw in node.keywords or ():
+                    if kw.arg in (None, "chunk", "lane", "resumed"):
+                        continue
+                    if kw.arg not in h2d_attrs:
+                        yield Finding(
+                            rule="phase-registry",
+                            path=path,
+                            line=node.lineno,
+                            message=f"h2d xfer attr {kw.arg!r} is not "
+                            f"registered",
+                            hint="register it in telemetry.trace."
+                            "KNOWN_H2D_XFER_ATTRS (and the xfer schema "
+                            "golden + ARCHITECTURE.md)",
+                        )
 
     # the RunReport streaming-seconds golden in tests == stages + derived
     golden_path = corpus.find("tests/test_telemetry.py")

@@ -54,6 +54,11 @@ CANONICAL_METRICS = (
     ("e2e_d2h_packed_speedup", True, False),
     ("e2e_h2d_bits_per_cycle", False, False),
     ("e2e_prefetch_depth", False, False),
+    # bucket auto-tuner (PR 13): measured fill of the long-tail fixture
+    # under the auto verdict and the verdict's cost-model ratio —
+    # informational, never gated (shape decisions follow the input mix)
+    ("e2e_fill_factor", True, False),
+    ("tuner_predicted_speedup", True, False),
     ("e2e_vs_cpu_e2e", True, False),
     ("serve_amortised_speedup", True, False),
     # defensive serving (PR 9): quarantine depth should sit AT the

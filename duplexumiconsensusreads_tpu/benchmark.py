@@ -77,7 +77,8 @@ COMPACT_KEYS = (
     "e2e_wire_floor_frac", "e2e_wire_floor_frac_measured",
     "e2e_wire_h2d_mb_s_measured", "e2e_wire_d2h_mb_s_measured",
     "e2e_bytes_per_read", "e2e_packed_speedup", "e2e_d2h_packed_speedup",
-    "e2e_h2d_bits_per_cycle", "e2e_prefetch_depth", "e2e_vs_cpu_e2e",
+    "e2e_h2d_bits_per_cycle", "e2e_prefetch_depth",
+    "e2e_fill_factor", "tuner_predicted_speedup", "e2e_vs_cpu_e2e",
     "serve_amortised_speedup", "serve_fleet_takeover_latency_s",
     "serve_quarantine_after_crashes", "serve_watchdog_detect_latency_s",
     "serve_shard_speedup", "serve_shard_merge_s",
@@ -927,6 +928,81 @@ def _swallow(fn):
         pass
 
 
+def run_bucket_tuner_bench() -> dict:
+    """The ``bucket_tuner`` leg: MEASURED fill factors of the bucket
+    auto-tuner on the canonical long-tail fixture, host-only (the CPU
+    bench sim — no device leg needed: fill factor is a pure function of
+    the group-size mix and the packer, and the byte-identity matrix in
+    tests pins that the ladder never changes results).
+
+    The fixture long-tails a simulated batch by merging its uniform
+    position groups on a quadratic schedule (group j of the remap
+    absorbs ~sqrt-growing runs), the shape hybrid panels actually have:
+    many shallow tiles plus a hot tail — exactly where one global
+    capacity pays tail padding on every flush. Emits:
+
+      e2e_fill_factor              measured fill (real rows / padded
+                                   row-slots) of build_buckets under
+                                   the auto verdict's ladder
+      bucket_tuner_fill_factor_off the single-capacity baseline fill
+      tuner_predicted_speedup      the verdict's cost-model ratio
+      tuner_ladder                 the chosen rungs
+    """
+    from duplexumiconsensusreads_tpu.bucketing import build_buckets
+    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+    from duplexumiconsensusreads_tpu.tuning import choose_ladder, group_sizes
+    from duplexumiconsensusreads_tpu.types import GroupingParams
+
+    capacity = int(os.environ.get("DUT_BENCH_CAPACITY", 2048))
+    n_mol = int(os.environ.get("DUT_BENCH_TUNER_MOLECULES", 20_000))
+    cfg = SimConfig(
+        n_molecules=n_mol, read_len=150, n_positions=600,
+        mean_family_size=3, umi_error=0.01, duplex=True, seed=17,
+    )
+    batch, _ = simulate_batch(cfg)
+    # long-tail remap: consecutive uniform groups merge in runs cycling
+    # 1..8, so merged group sizes span ~1x..8x the base tile depth —
+    # the shallow-tiles-plus-hot-tail mix hybrid panels actually have,
+    # all still below the capacity (oversized groups take the escapes
+    # identically under every ladder and would dilute the measurement).
+    # Exact grouping for the leg: merging positions can collide UMIs
+    # across molecules, which only matters to adjacency semantics, and
+    # this leg measures PACKING, not consensus (the matrix tests own
+    # byte identity).
+    pos = np.asarray(batch.pos_key)
+    uniq, inv = np.unique(pos, return_inverse=True)
+    merged = np.zeros(len(uniq), np.int64)
+    m = j = 0
+    while j < len(uniq):
+        run = 1 + (m % 8)
+        merged[j : j + run] = m
+        j += run
+        m += 1
+    batch.pos_key[:] = merged[inv]
+    gp = GroupingParams(strategy="exact")
+
+    verdict = choose_ladder(group_sizes(batch), capacity, pack_mult=1)
+
+    def measured_fill(ladder):
+        bks = build_buckets(batch, capacity=capacity, grouping=gp,
+                            ladder=ladder)
+        real = sum(int(b.valid.sum()) for b in bks)
+        pad = sum(b.capacity for b in bks)
+        return round(real / max(pad, 1), 4)
+
+    fill_off = measured_fill(None)
+    fill_auto = (
+        measured_fill(verdict.ladder) if len(verdict.ladder) > 1 else fill_off
+    )
+    return {
+        "e2e_fill_factor": fill_auto,
+        "bucket_tuner_fill_factor_off": fill_off,
+        "tuner_predicted_speedup": verdict.predicted_speedup,
+        "tuner_ladder": list(verdict.ladder),
+        "bucket_tuner_reads": int(np.asarray(batch.valid).sum()),
+    }
+
+
 def run_cpu_e2e(n_target: int) -> dict:
     """The SAME streamed end-to-end pipeline forced onto the XLA-CPU
     backend (VERDICT r2 item 2: the >=50x north-star claim is about
@@ -1234,6 +1310,12 @@ def main() -> None:
     # ---- per-config compute matrix (VERDICT r3 item 4) ----
     if int(os.environ.get("DUT_BENCH_PER_CONFIG", 1)):
         result["per_config"] = run_per_config(mesh)
+
+    # ---- bucket_tuner leg: measured fill factors of the auto-tuner on
+    # the canonical long-tail fixture (host-only, cheap; DUT_BENCH_TUNER=0
+    # disables) ----
+    if int(os.environ.get("DUT_BENCH_TUNER", 1)):
+        result.update(run_bucket_tuner_bench())
 
     # ---- end-to-end phase: wall-clock through the streaming pipeline.
     # Phase order is pinned (VERDICT r4 item 4): wire probe, TPU e2e,

@@ -22,6 +22,20 @@ Rules:
   so consensus sees the whole family in one piece;
 - each bucket records source read indices so outputs can be scattered
   back to the caller's order.
+
+Bucket LADDERS (``ladder=`` — the profile-guided auto-tuner's lever,
+see tuning/): instead of one global capacity, a run may carry 2-4 pow2
+size classes, e.g. ``(256, 1024, 4096)``. Contiguous runs of position
+groups are then partitioned by an exact DP that minimises total padded
+row-slots over the ladder (``_ladder_partition``) — a long-tail group
+mix stops forcing every bucket to the top rung's padding. The
+partition NEVER changes results: buckets still hold whole position
+groups, each bucket's geometry invariants (u_max/f_max sized from its
+own n_unique) hold per rung because dispatch classes key on capacity,
+and the executors' final (pos_key, UMI) sort makes output bytes a pure
+function of the read set — byte-identical at ANY ladder (pinned by
+tests/test_tuning.py's matrix). The top rung plays the old capacity's
+role for the oversized-group and jumbo escapes.
 """
 
 from __future__ import annotations
@@ -117,6 +131,87 @@ def _pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
 
 
+def _rung_for(n: int, ladder: tuple) -> int:
+    """Smallest ladder rung holding ``n`` rows (ladder is ascending and
+    its top rung bounds every caller's ``n`` by construction)."""
+    for r in ladder:
+        if n <= r:
+            return r
+    return ladder[-1]
+
+
+# past this many position groups in one contiguous run, the ladder DP
+# coalesces consecutive groups into blocks of up to min(ladder)//8 rows
+# first — bucket boundaries then land on block edges, bounding the DP at
+# O(reads/block * |ladder|) python steps for a worst waste of one block
+# per bucket (<= 12.5% of the smallest rung)
+_LADDER_DP_MAX_GROUPS = 4096
+
+
+def _ladder_partition(
+    bounds: np.ndarray, ladder: tuple
+) -> list[tuple[int, int, int]]:
+    """Partition a contiguous run of whole position groups into buckets
+    drawn from ``ladder``, minimising total padded row-slots.
+
+    ``bounds`` holds the groups' half-open offsets (len m+1, ascending);
+    every single group fits the top rung (oversized groups took the
+    precluster/jumbo escapes before this is called). Returns
+    ``[(start, end, rung), ...]`` covering ``bounds[0]..bounds[-1]``.
+
+    Exact DP: cost(i) = min over rungs r of cost(j_min(r, i)) + r where
+    j_min is the earliest cut such that groups (j..i] fit r. Prefix
+    costs are monotone (truncating a feasible packing stays feasible),
+    so the earliest cut in each rung's window is optimal and a
+    two-pointer per rung makes the whole thing O(m * |ladder|). The
+    single-rung case degenerates to the classic greedy's cost, so a
+    1-rung ladder pads exactly like the legacy single-capacity path.
+    """
+    if len(bounds) > _LADDER_DP_MAX_GROUPS + 1:
+        block = max(min(ladder) // 8, 1)
+        keep = [0]
+        for i in range(1, len(bounds)):
+            # close BEFORE a group that would overflow a non-empty
+            # block: every coalesced block is then either <= `block`
+            # rows or one single group (<= the top rung by the caller's
+            # contract), so the DP below always stays feasible — a
+            # block merging a partial run with a near-capacity group
+            # could otherwise exceed every rung and leave cost(i)
+            # unreachable
+            if bounds[i] - bounds[keep[-1]] > block and i - 1 > keep[-1]:
+                keep.append(i - 1)
+        if keep[-1] != len(bounds) - 1:
+            keep.append(len(bounds) - 1)
+        bounds = bounds[np.asarray(keep)]
+    m = len(bounds) - 1
+    if m <= 0:
+        return []
+    inf = float("inf")
+    cost = [0.0] + [inf] * m
+    choice: list[tuple[int, int] | None] = [None] * (m + 1)
+    jmin = [0] * len(ladder)
+    b0 = int(bounds[0])
+    for i in range(1, m + 1):
+        hi = int(bounds[i])
+        for ri, r in enumerate(ladder):
+            j = jmin[ri]
+            while hi - int(bounds[j]) > r:
+                j += 1
+            jmin[ri] = j
+            if j < i and cost[j] + r < cost[i]:
+                cost[i] = cost[j] + r
+                choice[i] = (j, r)
+    out: list[tuple[int, int, int]] = []
+    i = m
+    while i > 0:
+        j, r = choice[i]  # type: ignore[misc]
+        out.append((int(bounds[j]), int(bounds[i]), r))
+        i = j
+    out.reverse()
+    assert out[0][0] == b0 and out[-1][1] == int(bounds[-1])
+    return out
+
+
 #: counter keys build_buckets increments when a RESULT-CHANGING
 #: fallback fires (VERDICT r2: every deviation from oracle semantics
 #: must be tallied, not just warned about)
@@ -135,6 +230,7 @@ def build_buckets(
     adjacency: bool = False,
     grouping: GroupingParams | None = None,
     counters: dict | None = None,
+    ladder: tuple | None = None,
 ) -> list[Bucket]:
     """Pack a host ReadBatch into fixed-capacity buckets.
 
@@ -143,7 +239,25 @@ def build_buckets(
     omitted, UMI-tools defaults (Hamming<=1, count_ratio 2) are used.
     ``counters`` (a plain dict) is incremented with FALLBACK_COUNTERS
     whenever a result-changing fallback fires.
+
+    ``ladder`` (ascending pow2 rung capacities whose top rung equals
+    ``capacity``) switches the plain-bucket packer from the greedy
+    single-capacity fill to the padded-rows-minimising DP over the
+    rungs (see the module docstring); the oversized-group and jumbo
+    escapes keep their ``capacity``-keyed behaviour, but the family
+    runs they emit round up to the smallest fitting rung instead of
+    always paying the top rung. Results are identical at any ladder.
     """
+    if ladder is not None:
+        ladder = tuple(int(r) for r in ladder)
+        if len(ladder) < 1 or list(ladder) != sorted(set(ladder)):
+            raise ValueError(f"ladder must be ascending distinct rungs, got {ladder}")
+        if ladder[-1] != capacity:
+            raise ValueError(
+                f"ladder top rung {ladder[-1]} must equal capacity {capacity}"
+            )
+        if len(ladder) == 1:
+            ladder = None  # degenerate: the classic single-capacity path
     if grouping is not None:
         adjacency = adjacency or grouping.strategy in ("adjacency", "cluster")
     valid = np.asarray(batch.valid, bool)
@@ -183,18 +297,29 @@ def build_buckets(
         ]
     )[0]
 
-    # plain buckets as contiguous [start, end) ranges of idx_sorted —
-    # their unique-(pos, UMI) counts come from fam_start (no per-bucket
-    # pack+unique, which was a top host cost at scale)
+    # plain buckets as contiguous [start, end, bucket_capacity) ranges
+    # of idx_sorted — their unique-(pos, UMI) counts come from fam_start
+    # (no per-bucket pack+unique, which was a top host cost at scale)
     ranges: list[tuple] = []
     # (idx, umi_override|None, capacity, preclustered, n_unique)
     special: list[tuple] = []
     cur_start = cur_end = 0
+    # ladder mode: pending contiguous position-group bounds awaiting the
+    # DP cut (offsets into idx_sorted; groups stay whole either way)
+    pend: list[int] = []
 
     def flush():
         nonlocal cur_start, cur_end
+        if ladder is not None:
+            if len(pend) > 1:
+                for a, b, cap in _ladder_partition(
+                    np.asarray(pend, np.int64), ladder
+                ):
+                    ranges.append((a, b, cap))
+            pend.clear()
+            return
         if cur_end > cur_start:
-            ranges.append((cur_start, cur_end))
+            ranges.append((cur_start, cur_end, capacity))
             cur_start = cur_end
 
     # Jumbo buckets keep a whole >capacity family in one piece, but the
@@ -207,6 +332,11 @@ def build_buckets(
     def count(key, by=1):
         if counters is not None:
             counters[key] = counters.get(key, 0) + by
+
+    def run_cap(n: int) -> int:
+        # ladder mode: a family run of n rows pays the smallest rung
+        # that holds it instead of the top capacity
+        return capacity if ladder is None else _rung_for(n, ladder)
 
     def pack_family_runs(idx_g, bounds, umi_rows, preclustered):
         """Greedy-pack whole families (runs delimited by ``bounds``,
@@ -238,7 +368,7 @@ def build_buckets(
                 )
                 count("n_jumbo_hardcut_families")
                 if run_n:
-                    emit(run_s, fs, capacity, fi - run_fi)
+                    emit(run_s, fs, run_cap(fs - run_s), fi - run_fi)
                 for cs in range(fs, fe, jumbo_max):
                     ce = min(cs + jumbo_max, fe)
                     count("n_jumbo_hardcut_splits")
@@ -247,16 +377,19 @@ def build_buckets(
                 continue
             if fsize > capacity:
                 if run_n:
-                    emit(run_s, fs, capacity, fi - run_fi)
+                    emit(run_s, fs, run_cap(fs - run_s), fi - run_fi)
                 emit(fs, fe, _pow2(fsize), 1)
                 run_s, run_n, run_fi = fe, 0, fi + 1
                 continue
             if run_n + fsize > capacity:
-                emit(run_s, fs, capacity, fi - run_fi)
+                emit(run_s, fs, run_cap(fs - run_s), fi - run_fi)
                 run_s, run_n, run_fi = fs, 0, fi
             run_n += fsize
         if run_n:
-            emit(run_s, len(idx_g), capacity, len(bounds) - 1 - run_fi)
+            emit(
+                run_s, len(idx_g), run_cap(len(idx_g) - run_s),
+                len(bounds) - 1 - run_fi,
+            )
 
     pos_bounds = np.r_[pos_start, n]
     for gi in range(len(pos_start)):
@@ -310,6 +443,11 @@ def build_buckets(
                 pack_family_runs(sel, np.r_[fs_, e] - s, None, False)
             cur_start = cur_end = e  # special paths consumed [s, e)
             continue
+        if ladder is not None:
+            if not pend:
+                pend.append(int(s))
+            pend.append(int(e))
+            continue
         if (cur_end - cur_start) + size > capacity:
             flush()
             cur_start = s
@@ -320,13 +458,13 @@ def build_buckets(
         _fill_bucket(
             batch,
             idx_sorted[a:b],
-            capacity,
+            cap,
             n_unique=int(
                 np.searchsorted(fam_start, b, side="left")
                 - np.searchsorted(fam_start, a, side="left")
             ),
         )
-        for a, b in ranges
+        for a, b, cap in ranges
     ]
     out.extend(
         _fill_bucket(

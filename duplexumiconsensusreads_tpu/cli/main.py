@@ -230,6 +230,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--chunk-reads)",
     )
     c.add_argument(
+        "--bucket-ladder",
+        default=None,
+        metavar="{auto,off,R1,R2,..}",
+        help="mixed-capacity bucket ladder (streaming): 'auto' profiles "
+        "the first chunk's family-size histogram and picks 1-3 pow2 "
+        "bucket size classes by the tuner's padded-cycles cost model "
+        "(tuning/); an explicit ascending pow2 list like '256,2048' "
+        "pins the rungs (the top rung replaces --capacity); 'off' "
+        "(default) keeps the single --capacity. Output bytes are "
+        "identical at every setting — the ladder only cuts padding "
+        "(device FLOPs + wire bytes). Requires --chunk-reads",
+    )
+    c.add_argument(
         "--read-group-id",
         default=None,
         help="output consensus read group id (fgbio-style single @RG on "
@@ -542,7 +555,7 @@ def _load_config_file(path: str) -> dict:
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
         "min_input_qual", "capacity", "devices", "cycle_shards",
         "chunk_reads", "max_inflight", "drain_workers", "packed",
-        "prefetch_depth", "config",
+        "prefetch_depth", "bucket_ladder", "config",
         "mate_aware", "max_reads",
         "per_base_tags", "read_group_id", "write_index", "count_ratio",
         "ref_projected", "umi_whitelist", "umi_max_mismatches",
@@ -682,6 +695,13 @@ def _cmd_call(args) -> int:
         raise SystemExit(f"--drain-workers must be >= 1 (got {drain_workers})")
     packed = opt("packed", "auto")
     prefetch_depth = opt("prefetch_depth", 2)
+    bucket_ladder = opt("bucket_ladder", "off")
+    from duplexumiconsensusreads_tpu.tuning import normalize_bucket_ladder
+
+    try:
+        ladder_norm = normalize_bucket_ladder(bucket_ladder)
+    except ValueError as e:
+        raise SystemExit(f"--bucket-ladder: {e}")
     if packed not in ("auto", "byte", "off"):
         raise SystemExit(
             f"invalid packed value {packed!r} (allowed: ['auto', 'byte', "
@@ -824,6 +844,10 @@ def _cmd_call(args) -> int:
             "drain_workers": drain_workers,
             "packed": packed,
             "prefetch_depth": prefetch_depth,
+            "bucket_ladder": (
+                list(ladder_norm) if isinstance(ladder_norm, tuple)
+                else ladder_norm
+            ),
             "mate_aware": mate_aware,
             "max_reads": max_reads,
             "per_base_tags": per_base_tags,
@@ -885,6 +909,16 @@ def _cmd_call(args) -> int:
         # not silently dropped
         raise SystemExit(
             "--packed/--prefetch-depth require the streaming executor "
+            "(--chunk-reads N)"
+        )
+    if chunk_reads <= 0 and (
+        args.bucket_ladder is not None or ladder_norm != "off"
+    ):
+        # the ladder is a streaming-bucketer concern; a whole-file run
+        # would silently ignore it (refuse-don't-drop, like --packed —
+        # and like there, the RESOLVED value covers config-file keys)
+        raise SystemExit(
+            "--bucket-ladder requires the streaming executor "
             "(--chunk-reads N)"
         )
     if args.heartbeat:
@@ -992,6 +1026,7 @@ def _cmd_call(args) -> int:
             drain_workers=drain_workers,
             packed=packed,
             prefetch_depth=prefetch_depth,
+            bucket_ladder=ladder_norm,
             checkpoint_path=host_ckpt,
             resume=args.resume,
             report_path=host_report,
@@ -1026,6 +1061,7 @@ def _cmd_call(args) -> int:
             drain_workers=drain_workers,
             packed=packed,
             prefetch_depth=prefetch_depth,
+            bucket_ladder=ladder_norm,
             checkpoint_path=args.checkpoint,
             resume=args.resume,
             report_path=args.report,

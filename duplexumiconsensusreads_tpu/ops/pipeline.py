@@ -8,6 +8,14 @@ cycles, B umi bases, u_max unique-UMI slots) so XLA compiles it once
 per bucket geometry; host bucketing (bucketing/) guarantees every
 bucket fits the spec. The same function is the unit that
 parallel/sharded.py maps over the device mesh (config 4).
+
+Bucket LADDERS (bucketing/ ``ladder=``, tuning/ auto-tuner) need no
+special casing here: each rung is just another bucket capacity, so
+``partition_buckets`` keys a dispatch class per (rung, preclustered,
+unique-count) and ``spec_for_buckets`` sizes that class's u_max/f_max/
+m_max from its OWN buckets — the grouping invariant that bounds f_max
+and the packed-D2H k_pad therefore holds per rung by construction, and
+the jit cache absorbs each rung's spec exactly like a jumbo class's.
 """
 
 from __future__ import annotations

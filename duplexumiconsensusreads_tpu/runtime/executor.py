@@ -83,6 +83,15 @@ class RunReport:
     # from an assertion into arithmetic (bytes / MB/s ~ observed wall).
     bytes_h2d: int = 0
     bytes_d2h: int = 0
+    # padding observability (streaming): real read rows dispatched vs
+    # total padded row-slots (bucket capacities x padded bucket counts,
+    # retried dispatches counted like the byte ledger counts them) —
+    # fill factor = n_rows_real / n_rows_padded, the tuner's audit trail
+    n_rows_real: int = 0
+    n_rows_padded: int = 0
+    # resolved bucket ladder of the run ([] = single-capacity): explicit
+    # rungs verbatim, or the tuner verdict an auto run settled on
+    bucket_ladder: list = dataclasses.field(default_factory=list)
     seconds: dict = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
@@ -467,9 +476,9 @@ def _pack_d2h_fn():
             return {
                 "n_families": out["n_families"],
                 "n_molecules": out["n_molecules"],
-                # dense ids live in [-1, F) and F <= capacity < 2**16:
-                # bias by one into u16
-                "ids16": (ids + 1).astype(jnp.uint16),
+                # F <= capacity < 2**16, so the shared u16 lane
+                # convention applies
+                "ids16": ids_to_u16(ids),
                 "cons_q": qb,
                 "cons_b2": pack_2bit(base & 3),
                 "cons_flags": flags,
@@ -488,6 +497,106 @@ def d2h_pack_ok(capacity: int, per_base_tags: bool) -> bool:
     (capacity bounds both), and per-base-tag runs fetch the full
     (F, L) depth/err matrices the compact layout does not carry."""
     return capacity < (1 << 16) and not per_base_tags
+
+
+# ------------------------------------------------- ids-lane u16 rung
+#
+# The remaining fetch-side wire-diet rung the ROADMAP named: when the
+# FULL packed-D2H compaction is gated off (per-base-tag runs fetch the
+# (F, L) matrices the compact layout cannot carry), the unpacked fetch
+# still moved BOTH (B, R) i32 id arrays even though the scatter only
+# ever consumes one (molecule_id in duplex else family_id — exactly the
+# selection the full rung already makes). This partial rung fetches
+# only the consumed array, biased by one into u16 like the full rung's
+# ids16 lane: 8 id bytes/row -> 2. Gated per class at capacity >= 2**16
+# (dense ids live in [-1, capacity)) with a ledgered packed_fallback
+# event like every other rung; d2h_packed="off" keeps the honest
+# fully-unpacked A/B baseline.
+
+IDS16_FETCH_KEYS = tuple(
+    k for k in FETCH_KEYS if k not in ("family_id", "molecule_id")
+) + ("ids16",)
+
+# THE u16 id-lane convention, one pack/unpack pair on purpose: dense
+# ids live in [-1, capacity), so +IDS16_BIAS fits them into u16 when
+# the capacity gate holds. Both d2h rungs (the full compaction's ids16
+# lane and the partial ids-lane rung) and both host reconstructions go
+# through these two functions — the bias, sentinel and dtypes changing
+# in one site but not another would silently break the round-trip's
+# byte identity.
+IDS16_BIAS = 1
+
+
+def ids_to_u16(ids):
+    """Device-side half of the u16 id-lane convention (jit-traceable)."""
+    import jax.numpy as jnp
+
+    return (ids + IDS16_BIAS).astype(jnp.uint16)
+
+
+def ids_from_u16(a) -> np.ndarray:
+    """Host-side inverse: exact i32 reconstruction of the id array."""
+    return np.asarray(a).astype(np.int32) - IDS16_BIAS
+
+
+_IDS16_FN = None
+
+
+def _ids16_fn():
+    global _IDS16_FN
+    if _IDS16_FN is None:
+        import jax
+
+        _IDS16_FN = jax.jit(ids_to_u16)
+    return _IDS16_FN
+
+
+def ids16_ok(capacity: int) -> bool:
+    """Gate for the ids-lane u16 rung: biased dense ids (<= capacity)
+    must fit u16 — the same bound as the full rung's ids16 lane."""
+    return capacity < (1 << 16)
+
+
+def d2h_rung_for_class(
+    d2h_on: bool, ids16_want: bool, capacity: int, per_base_tags: bool
+) -> tuple[str, str | None]:
+    """THE per-class return-path rung decision, one pure function so
+    the gate logic is unit-testable without a device and the dispatch
+    site cannot drift from it. Returns (rung, fallback_reason):
+
+      "packed"  full consensus-only compaction (d2h_pack_ok holds for
+                this class)
+      "ids16"   partial rung — full compaction gated off (per-base
+                tags / capacity) but the consumed id array still packs
+                u16
+      "off"     fully unpacked; fallback_reason names the ledgered
+                packed_fallback when a wanted rung was refused
+                (capacity >= 2**16 overflows the u16 lanes — the full
+                rung's established jumbo reason when it was on, the
+                ids-lane reason when only the partial rung was in
+                play), None when the caller asked for off
+    """
+    if d2h_on:
+        if d2h_pack_ok(capacity, per_base_tags):
+            return "packed", None
+        # the class capacity defeated the full rung; the same u16
+        # bound defeats the ids lane, so this is always a full falloff
+        return "off", "jumbo-class-capacity-overflows-u16"
+    if ids16_want:
+        if ids16_ok(capacity):
+            return "ids16", None
+        return "off", "ids-lane-overflows-u16"
+    return "off", None
+
+
+def pack_ids_u16(out: dict, duplex: bool) -> dict:
+    """Replace the pipeline output's two id arrays with the ONE the
+    scatter consumes, biased into u16 on device (tiny jit, no static
+    args — never a pipeline recompile)."""
+    ids = out["molecule_id" if duplex else "family_id"]
+    d = {k: v for k, v in out.items() if k not in ("family_id", "molecule_id")}
+    d["ids16"] = _ids16_fn()(ids)
+    return d
 
 
 def d2h_k_pad(cbuckets, spec) -> int:
@@ -537,6 +646,16 @@ def unpack_fetch_outputs(fetched: dict, cbuckets, spec) -> dict:
     from duplexumiconsensusreads_tpu.constants import BASE_N, NO_CALL_QUAL
 
     if "cons_q" not in fetched:
+        if "ids16" in fetched:
+            # ids-lane u16 rung (full compaction off): reconstruct the
+            # one consumed id array at its exact i32 dtype; everything
+            # else crossed unpacked
+            duplex = spec.consensus.mode == "duplex"
+            out = {k: v for k, v in fetched.items() if k != "ids16"}
+            out["molecule_id" if duplex else "family_id"] = ids_from_u16(
+                fetched["ids16"]
+            )
+            return out
         return fetched
     duplex = spec.consensus.mode == "duplex"
     f = (spec.m_max if duplex else spec.f_max) or cbuckets[0].capacity
@@ -584,8 +703,8 @@ def unpack_fetch_outputs(fetched: dict, cbuckets, spec) -> dict:
     return {
         "n_families": nf,
         "n_molecules": nm,
-        ("molecule_id" if duplex else "family_id"): (
-            np.asarray(fetched["ids16"]).astype(np.int32) - 1
+        ("molecule_id" if duplex else "family_id"): ids_from_u16(
+            fetched["ids16"]
         ),
         "cons_valid": cons_valid,
         "cons_base": cons_base,
@@ -605,6 +724,15 @@ def d2h_logical_nbytes(fetched: dict, cbuckets, spec) -> int:
     i32 id arrays, two (B,) i32 count vectors, and the (B, F[, L])
     consensus-row tensors)."""
     if "cons_q" not in fetched:
+        if "ids16" in fetched:
+            # ids-lane u16 rung: the unpacked fetch would have moved
+            # BOTH (B, R) i32 id arrays where the wire carried one u16
+            ids = fetched["ids16"]
+            n_ids = int(np.prod(ids.shape))
+            wire = sum(
+                v.nbytes for v in fetched.values() if hasattr(v, "nbytes")
+            )
+            return wire - ids.nbytes + 2 * n_ids * 4
         return sum(v.nbytes for v in fetched.values() if hasattr(v, "nbytes"))
     duplex = spec.consensus.mode == "duplex"
     f = (spec.m_max if duplex else spec.f_max) or cbuckets[0].capacity

@@ -72,14 +72,17 @@ from duplexumiconsensusreads_tpu.ops.pipeline import (
 _ALPHA_CAP = (1 << max(SUBBYTE_QBITS)) - 1
 from duplexumiconsensusreads_tpu.runtime.executor import (
     DRAIN_PHASES,
+    IDS16_FETCH_KEYS,
     PACKED_FETCH_KEYS,
     D2hCompactionOverflow,
     RunReport,
     d2h_k_pad,
     d2h_logical_nbytes,
     d2h_pack_ok,
+    d2h_rung_for_class,
     fetch_outputs,
     pack_fetch_outputs,
+    pack_ids_u16,
     partition_buckets,
     scatter_bucket_outputs,
     sort_consensus_outputs,
@@ -947,6 +950,18 @@ def stream_call_consensus(
     # host packing + H2D of chunk k+1 overlaps device compute of chunk
     # k without unbounded device-buffer pileup. Output bytes are
     # identical at any depth.
+    bucket_ladder="off",  # mixed-capacity bucket ladder (tuning/):
+    # "off" = the single --capacity (legacy), "auto" = profile the
+    # first chunk's group-size histogram and pick a 1-3 rung ladder by
+    # the tuner's padded-cycles cost model (a ledgered tuner_verdict
+    # event), or an explicit ascending pow2 rung tuple / "r1,r2" string
+    # whose top rung REPLACES capacity as the bucket capacity. Output
+    # bytes are identical at every setting (the final per-chunk
+    # (pos_key, UMI) sort makes bytes a pure function of the read set),
+    # which is also why the ladder deliberately stays OUT of the
+    # checkpoint fingerprint: shards are ladder-invariant, so a
+    # verdict-driven serve slice can resume a prefix an auto slice
+    # committed.
     trace_path: str | None = None,  # per-chunk span capture (JSONL;
     # telemetry/trace.py). None = tracing off, and every hook in the
     # hot path is a single None check — the zero-cost contract
@@ -1011,6 +1026,7 @@ def stream_call_consensus(
             per_base_tags=per_base_tags, read_group=read_group,
             write_index=write_index, packed=packed,
             d2h_packed=d2h_packed, prefetch_depth=prefetch_depth,
+            bucket_ladder=bucket_ladder,
             tr=tr, heartbeat_s=heartbeat_s, hb_box=hb_box,
             provenance_cl=provenance_cl,
             chunk_base=chunk_base, first_read=first_read,
@@ -1052,6 +1068,7 @@ def _stream_call(
     packed: str = "auto",
     d2h_packed: str = "auto",
     prefetch_depth: int = 2,
+    bucket_ladder="off",
     tr: TraceRecorder | None = None,
     heartbeat_s: float = 0.0,
     hb_box: list | None = None,
@@ -1108,7 +1125,21 @@ def _stream_call(
         raise ValueError(f"packed must be auto/byte/off, got {packed!r}")
     if d2h_packed not in ("auto", "off"):
         raise ValueError(f"d2h_packed must be auto/off, got {d2h_packed!r}")
+    from duplexumiconsensusreads_tpu import tuning
+
+    # bucket-ladder resolution: an explicit ladder is known now (its
+    # top rung replaces --capacity as the effective bucket capacity);
+    # "auto" resolves ONCE against the first non-empty chunk's profile
+    # below, so the compile classes stay stable for the whole run
+    ladder_mode = tuning.normalize_bucket_ladder(bucket_ladder)
+    run_ladder: tuple | None = None
+    ladder_auto = ladder_mode == "auto"
+    if isinstance(ladder_mode, tuple):
+        run_ladder = ladder_mode if len(ladder_mode) > 1 else None
+        capacity = ladder_mode[-1]
     rep = RunReport(backend="tpu-stream")
+    if isinstance(ladder_mode, tuple):
+        rep.bucket_ladder = [int(r) for r in ladder_mode]
     rep.n_drain_workers = drain_workers
     duplex = consensus.mode == "duplex"
     # monotonic everywhere in phase accounting: an NTP step mid-run
@@ -1247,6 +1278,12 @@ def _stream_call(
         packed != "off" and d2h_packed != "off"
         and d2h_pack_ok(capacity, per_base_tags)
     )
+    # the ids-lane u16 rung wants to fire whenever the return path is
+    # not explicitly off — it covers exactly the classes the FULL
+    # compaction rung cannot (per-base-tag runs, u16-overflowing
+    # capacities re-checked per class); "off" keeps the honest
+    # fully-unpacked A/B baseline on both knobs
+    ids16_want = packed != "off" and d2h_packed != "off"
     if (
         packed != "off" and d2h_packed != "off"
         and not d2h_pack_ok(capacity, per_base_tags)
@@ -1297,19 +1334,29 @@ def _stream_call(
             # a tunneled chip (see the per-phase breakdown)
             pack_stacked(stacked, spec)
         h2d = stacked_nbytes(stacked)
+        # padding observability: real read rows vs padded row-slots of
+        # this class's dispatch (mesh-pad empties included — they ride
+        # the wire and the GEMM alike); retried dispatches re-count,
+        # exactly like the byte ledger counts wire traffic
+        rows_pad = int(stacked["pos"].shape[0]) * buckets[0].capacity
+        rows_real = sum(int(bk.valid.sum()) for bk in buckets)
         out = sharded_pipeline(stacked, spec, mesh)
         # the run-level d2h decision re-checked against the CLASS
-        # capacity: jumbo buckets carry a next-pow2 capacity up to 64x
-        # the run's (bucketing/buckets.py), and the packed layout's u16
-        # depth/id lanes are only lossless below 2**16 rows
-        use_d2h = d2h_on and d2h_pack_ok(buckets[0].capacity, per_base_tags)
-        if d2h_on and not use_d2h:
+        # capacity (one pure helper — executor.d2h_rung_for_class — so
+        # the gate matrix is unit-tested without a device): jumbo
+        # buckets carry a next-pow2 capacity up to 64x the run's
+        # (bucketing/buckets.py), and the packed layouts' u16 lanes are
+        # only lossless below 2**16 rows
+        rung, fallback = d2h_rung_for_class(
+            d2h_on, ids16_want, buckets[0].capacity, per_base_tags
+        )
+        if fallback is not None:
+            # same ledgered-downgrade discipline as every other rung
             telemetry.emit_event(
                 "packed_fallback", scope="d2h",
-                reason="jumbo-class-capacity-overflows-u16",
-                capacity=buckets[0].capacity,
+                reason=fallback, capacity=buckets[0].capacity,
             )
-        if use_d2h:
+        if rung == "packed":
             # packed consensus-only return path: compact + pack the
             # output rows ON DEVICE before any copy starts (still at
             # dispatch time, so the async overlap is intact), then
@@ -1317,6 +1364,16 @@ def _stream_call(
             out = start_fetch(
                 pack_fetch_outputs(out, spec, d2h_k_pad(buckets, spec)),
                 keys=PACKED_FETCH_KEYS,
+            )
+        elif rung == "ids16":
+            # ids-lane u16 rung: the full compaction is gated off for
+            # this class (per-base tags), but the scatter still
+            # consumes only ONE id array and biased dense ids fit u16 —
+            # fetch that one, u16, instead of both i32 arrays
+            out = start_fetch(
+                pack_ids_u16(out, duplex),
+                keys=IDS16_FETCH_KEYS,
+                extra=("cons_depth", "cons_err") if per_base_tags else (),
             )
         else:
             # start the device->host copies of the consumed keys right
@@ -1330,6 +1387,8 @@ def _stream_call(
         with phase_lock:  # dict += from concurrent workers would race
             phase["dispatch"] += dt
             rep.bytes_h2d += h2d
+            rep.n_rows_real += rows_real
+            rep.n_rows_padded += rows_pad
             if tr is not None:
                 led["h2d_logical"] += logical
                 led["h2d_wire"] += h2d
@@ -1344,7 +1403,14 @@ def _stream_call(
                 2 + spec.packed_qbits if spec.packed_qbits
                 else 8 if spec.packed_io else 16
             )
-            tr.xfer("h2d", logical, h2d, t0, dt, chunk=chunk, bpc=bpc)
+            # rows_real/rows_pad + the class capacity: the per-rung
+            # fill-factor audit trail (wirestat's fill column and the
+            # tuner acceptance both read these)
+            tr.xfer(
+                "h2d", logical, h2d, t0, dt, chunk=chunk, bpc=bpc,
+                rows_real=rows_real, rows_pad=rows_pad,
+                cap=buckets[0].capacity,
+            )
         return out
 
     def unpack(raw, cbuckets, cspec):
@@ -1808,8 +1874,36 @@ def _stream_call(
                 rep.n_downsampled_reads += downsample_families(batch, max_reads)
             fb: dict = {}
             t0 = time.monotonic()
+            if ladder_auto:
+                # profile pass (host-only, once per run): the first
+                # non-empty chunk's position-group size sequence feeds
+                # the tuner's padded-cycles cost model; the verdict is
+                # pinned for the whole run so compile classes stay
+                # stable, and it is LEDGERED so any capture can audit
+                # the shape decision
+                sizes = tuning.group_sizes(batch)
+                if len(sizes):
+                    verdict = tuning.choose_ladder(
+                        sizes, capacity, pack_mult=n_data
+                    )
+                    run_ladder = (
+                        verdict.ladder if len(verdict.ladder) > 1 else None
+                    )
+                    ladder_auto = False
+                    rep.bucket_ladder = [int(r) for r in verdict.ladder]
+                    if tr is not None:
+                        tr.event(
+                            "tuner_verdict", chunk=k,
+                            ladder=list(verdict.ladder),
+                            fill_factor=verdict.fill_factor,
+                            fill_factor_off=verdict.fill_factor_off,
+                            predicted_speedup=verdict.predicted_speedup,
+                            n_groups=verdict.n_groups,
+                            source=verdict.source,
+                        )
             buckets = build_buckets(
-                batch, capacity=capacity, grouping=grouping, counters=fb
+                batch, capacity=capacity, grouping=grouping, counters=fb,
+                ladder=run_ladder,
             )
             # the run's real-cycle qual alphabet feeds the sub-byte
             # rung decision: one scan per chunk, accumulated into a
@@ -2022,6 +2116,11 @@ def _stream_call(
                 # denominator (resume-skipped chunks moved no bytes,
                 # so numerator and denominator agree by construction)
                 "n_records": rep.n_records,
+                # padding totals: fill factor = real/padded, the tuner
+                # verdicts' audit trail (wirestat cross-checks these
+                # against the per-record rows_real/rows_pad sums)
+                "n_rows_real": rep.n_rows_real,
+                "n_rows_padded": rep.n_rows_padded,
             },
             bytes={
                 **led,

@@ -38,6 +38,7 @@ CONFIG_DEFAULTS = {
     "drain_workers": 2,
     "packed": "auto",
     "prefetch_depth": 2,
+    "bucket_ladder": "off",
     "mate_aware": "auto",
     "max_reads": 0,
     "per_base_tags": False,
@@ -133,6 +134,19 @@ def validate_spec(d: dict) -> JobSpec:
                 "prefetch_depth"):
         if not isinstance(merged[key], int) or merged[key] < 1:
             raise ValueError(f"config {key} must be an int >= 1")
+    ladder = _normalized_ladder(merged)  # raises ValueError on a bad value
+    if isinstance(ladder, tuple) and ladder[-1] != merged["capacity"]:
+        # an explicit ladder's top rung REPLACES the capacity in the
+        # executor — but serve_provenance excludes bucket_ladder from
+        # the @PG CL (tuner overrides must not change job bytes), so a
+        # mismatched top rung would make the recorded '--capacity' a
+        # lie and break the reproduce-from-provenance contract. Refuse
+        # at submission like every other config error.
+        raise ValueError(
+            f"config bucket_ladder top rung {ladder[-1]} must equal "
+            f"config capacity {merged['capacity']} (the top rung IS the "
+            f"job's capacity; set them consistently)"
+        )
     chaos = d.get("chaos")
     if chaos is not None:
         if not isinstance(chaos, str) or not chaos:
@@ -198,6 +212,26 @@ def validate_spec(d: dict) -> JobSpec:
     )
 
 
+def _normalized_ladder(c: dict):
+    """The config's bucket_ladder, NORMALISED ("auto" | "off" | rung
+    tuple) — one helper shared by validation, job_params and
+    spec_signature so a cosmetic variant ("AUTO", " 256 , 1024 ") can
+    never bypass the verdict store or split the compile signature.
+    Raises ValueError naming the field on an invalid value."""
+    ladder = c["bucket_ladder"]
+    if not isinstance(ladder, (str, list, tuple)):
+        raise ValueError(
+            f"config bucket_ladder must be 'auto', 'off' or a rung list "
+            f"(got {ladder!r})"
+        )
+    from duplexumiconsensusreads_tpu.tuning import normalize_bucket_ladder
+
+    try:
+        return normalize_bucket_ladder(ladder)
+    except ValueError as e:
+        raise ValueError(f"config bucket_ladder: {e}")
+
+
 def job_params(spec: JobSpec):
     """(GroupingParams, ConsensusParams, stream kwargs) for one job —
     the serve-side mirror of cli/main.py's flag resolution."""
@@ -224,6 +258,7 @@ def job_params(spec: JobSpec):
         drain_workers=c["drain_workers"],
         packed=c["packed"],
         prefetch_depth=c["prefetch_depth"],
+        bucket_ladder=_normalized_ladder(c),
         mate_aware=c["mate_aware"],
         max_reads=c["max_reads"],
         per_base_tags=bool(c["per_base_tags"]),
@@ -250,6 +285,17 @@ def serve_provenance(config: dict) -> str:
         val = merged[key]
         if val == default:
             continue
+        if key == "bucket_ladder":
+            # the ladder is a SHAPE knob that provably cannot change
+            # output bytes (the executors' final sort makes bytes a
+            # pure function of the read set), and the serve layer may
+            # override it per slice from a tuner verdict — embedding it
+            # in the @PG CL would make job bytes depend on the tuner's
+            # state, breaking bytes == f(input, config). Excluded like
+            # the daemon's argv, for the same reason. (It is also the
+            # only list-capable config key, so every value below is a
+            # scalar.)
+            continue
         flag = "--" + key.replace("_", "-")
         if isinstance(val, bool):
             parts.append(flag)
@@ -262,12 +308,25 @@ def serve_provenance(config: dict) -> str:
 def spec_signature(spec: JobSpec) -> str:
     """The job's COMPILE identity: the config subset that determines
     bucket geometry + pipeline spec (capacity, grouping strategy, mode,
-    error model, per-base tags). Two jobs sharing a signature share XLA
-    programs, so the second is a compile-cache hit in the warm daemon —
-    the amortisation the service exists to provide."""
+    error model, per-base tags, and the bucket-ladder spec — each rung
+    is its own dispatch-class capacity, so the ladder IS geometry). Two
+    jobs sharing a signature share XLA programs, so the second is a
+    compile-cache hit in the warm daemon — the amortisation the service
+    exists to provide. "auto" jobs share the auto token: their resolved
+    ladders come from the spool's verdict store, which maps one input
+    profile to one ladder, so equal-profile jobs still share programs
+    in practice."""
     c = {**CONFIG_DEFAULTS, **spec.config}
+    try:
+        ladder = _normalized_ladder(c)
+    except ValueError:
+        # a never-validated spec (direct construction): fall back to
+        # the raw token — the signature must never raise
+        ladder = c["bucket_ladder"]
+    if isinstance(ladder, (list, tuple)):
+        ladder = ",".join(str(x) for x in ladder)
     return "|".join(
         str(c[k])
         for k in ("capacity", "grouping", "mode", "error_model",
                   "per_base_tags")
-    )
+    ) + f"|ladder={ladder}"

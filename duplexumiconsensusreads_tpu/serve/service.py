@@ -169,6 +169,15 @@ class ConsensusService:
             lambda jobs, spec: self.sched.shed_reason(jobs, spec.priority)
         )
         self.worker = WarmWorker(n_devices=n_devices)
+        # fleet-shared tuner verdicts (tuning/store.py): auto-ladder
+        # jobs consult/persist per-input-profile bucket-shape verdicts
+        # through the spool, so every daemon serving this traffic mix
+        # converges on the same fast shapes (and the same compiles)
+        from duplexumiconsensusreads_tpu.tuning.store import spool_store
+
+        self.verdicts = spool_store(spool_dir)
+        self.worker.verdict_store = self.verdicts
+        self.worker.on_verdict = self._tuner_verdict_event
         self.workers = workers
         self.poll_s = poll_s
         self.heartbeat_s = heartbeat_s
@@ -790,6 +799,17 @@ class ConsensusService:
                 if self._fatal is None:
                     self._fatal = e
             self._drain.set()
+
+    def _tuner_verdict_event(self, job_id: str, attrs: dict) -> None:
+        """The worker's on_verdict hook: ledger a bucket-ladder verdict
+        decision (persisted fresh, source="run", or reused from the
+        spool store, source="store") into the service capture — the
+        KNOWN_EVENTS registry promises the fleet's shape decisions are
+        auditable from any capture."""
+        tr = self._tr
+        if tr is not None:
+            tr.event("tuner_verdict", job=job_id, lane=f"job-{job_id}",
+                     **attrs)
 
     def _fenced(self, job_id: str, lane: str, detail: str) -> None:
         """A slice lost its lease: count it, record it, commit nothing.

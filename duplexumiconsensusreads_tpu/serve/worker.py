@@ -118,6 +118,26 @@ def fenced_renew(queue: SpoolQueue, job_id: str, daemon_id: str,
     )
 
 
+def verdict_key(spec) -> str:
+    """Verdict-store key for a job spec: input identity x compile
+    signature. A shard sub-job folds its range into the key — it
+    profiles ITS range's group-size mix, which can legitimately differ
+    per region, so sibling shards (and the whole-file job) must not
+    collide on one verdict; a collision would pin a ladder tuned for a
+    different region and break the store's same-key-same-value
+    contract."""
+    from duplexumiconsensusreads_tpu import tuning
+
+    sig = spec_signature(spec)
+    if spec.shard is not None:
+        sig += (
+            f"|shard={spec.shard.get('chunk_base')}"
+            f":{spec.shard.get('key_lo')}"
+            f":{spec.shard.get('key_hi')}"
+        )
+    return tuning.profile_key(spec.input, sig)
+
+
 def _ckpt_done_count(out_path: str) -> int:
     """Chunks already durably committed for this output (the auto
     checkpoint's ``done`` map — a gap-free prefix by the frontier
@@ -145,6 +165,20 @@ class WarmWorker:
         self.n_spec_hits = 0
         self.n_spec_misses = 0
         self.n_slices = 0
+        # tuner verdict traffic (tuning/store.py): auto-ladder slices
+        # that reused a stored verdict vs fresh resolutions persisted.
+        # The store rides a worker ATTRIBUTE (set by the service), not a
+        # run_slice kwarg: tests and the bench wrap run_slice with
+        # old-signature shims, and a new keyword would break every shim
+        self.verdict_store = None
+        # service-set ledger hook, callable(job_id, attrs): emits a
+        # tuner_verdict event into the service capture whenever a slice
+        # reuses or persists a verdict — the registry promises the
+        # fleet's shape decisions are auditable from the capture. An
+        # attribute for the same reason verdict_store is one.
+        self.on_verdict = None
+        self.n_verdict_hits = 0
+        self.n_verdict_puts = 0
 
     def compile_hit_rate(self) -> float:
         total = self.n_spec_hits + self.n_spec_misses
@@ -162,6 +196,48 @@ class WarmWorker:
                 else:
                     self.n_spec_misses += 1
         return hit
+
+    def _emit_verdict(self, job_id: str, attrs: dict) -> None:
+        """Ledger a verdict decision through the service's hook (no-op
+        for direct-worker callers like tests and the bench shims)."""
+        hook = self.on_verdict
+        if hook is not None:
+            hook(job_id, attrs)
+
+    def _note_verdict(
+        self, verdicts, vkey, reused, ladder, rows_real, rows_pad,
+        job_id: str = "",
+    ) -> None:
+        """Persist a fresh auto run's resolved ladder into the spool
+        store (no-op on reuse — the stored verdict already matches by
+        construction). Best-effort: a store write failure must never
+        fail the job whose bytes are already durable."""
+        if verdicts is None or vkey is None or reused or not ladder:
+            return
+        try:
+            from duplexumiconsensusreads_tpu import tuning
+
+            rungs = tuning.validate_ladder(ladder)
+        except ValueError:
+            # a resolved single-rung "ladder" can be an off-ladder
+            # capacity (non-pow2 / below MIN_RUNG) that validate_ladder
+            # would refuse on reuse — persisting it would make every
+            # later slice hit, fail validation, re-profile and re-put
+            # the store forever; skip instead (re-profiling is cheap)
+            return
+        entry = {
+            "ladder": [int(r) for r in rungs],
+            "source": "run",
+        }
+        if rows_pad:
+            entry["fill_factor"] = round(rows_real / rows_pad, 4)
+        try:
+            verdicts.put(vkey, entry)
+        except OSError:
+            return
+        with self._lock:
+            self.n_verdict_puts += 1
+        self._emit_verdict(job_id, dict(entry))
 
     def _job_plan(self, spec: JobSpec) -> faults.FaultPlan | None:
         if not spec.chaos:
@@ -224,6 +300,47 @@ class WarmWorker:
             # the merged output gets the one index; per-shard BAIs
             # would be thrown away
             kwargs["write_index"] = False
+        # tuner verdict consult (self.verdict_store — tuning/store.py,
+        # wired by the service): an "auto" bucket-ladder job takes the
+        # spool's stored verdict for its input profile when one exists
+        # (skipping the profile pass and pinning the fleet-wide shape);
+        # a fresh auto resolution is persisted after the slice below.
+        # Shape-only: output bytes are identical with or without a
+        # verdict, which is why the override rides kwargs and never
+        # touches spec.config (the @PG provenance header derives from
+        # config and must not depend on tuner state).
+        verdicts = self.verdict_store
+        vkey = None
+        verdict_reused = False
+        if verdicts is not None and kwargs.get("bucket_ladder") == "auto":
+            from duplexumiconsensusreads_tpu import tuning
+
+            vkey = verdict_key(spec)
+            hit = verdicts.get(vkey)
+            if hit and hit.get("ladder"):
+                try:
+                    rungs = tuning.validate_ladder(hit["ladder"])
+                    if rungs[-1] != kwargs["capacity"]:
+                        # a well-formed but wrong-capacity entry (hand
+                        # edit, torn write that parses) would silently
+                        # change the run's effective capacity — and the
+                        # escape thresholds with it — while the @PG CL
+                        # still claims the configured one
+                        raise ValueError("verdict top rung != capacity")
+                    kwargs["bucket_ladder"] = rungs
+                    verdict_reused = True
+                    with self._lock:
+                        self.n_verdict_hits += 1
+                    self._emit_verdict(spec.job_id, {
+                        "ladder": [int(r) for r in kwargs["bucket_ladder"]],
+                        "source": "store",
+                    })
+                except ValueError:
+                    pass  # corrupt stored verdict: re-profile honestly
+        # resolved-ladder snapshot for verdict persistence: a preempted
+        # slice raises out of the executor, so the progress callback
+        # mirrors the live report fields (same idiom as slice_bytes)
+        ladder_seen: dict = {"ladder": None, "rows_real": 0, "rows_pad": 0}
         n_resumed = _ckpt_done_count(spec.output)
         commits = [0]
         # wire bytes this slice moved, as of its last committed chunk:
@@ -258,6 +375,9 @@ class WarmWorker:
             slice_bytes["h2d_bytes"] = _rep.bytes_h2d
             slice_bytes["d2h_bytes"] = _rep.bytes_d2h
             slice_bytes["reads"] = _rep.n_records
+            ladder_seen["ladder"] = list(_rep.bucket_ladder)
+            ladder_seen["rows_real"] = _rep.n_rows_real
+            ladder_seen["rows_pad"] = _rep.n_rows_padded
             fresh = commits[0] - n_resumed
             if lease is not None and lease.on_chunk is not None:
                 lease.on_chunk()
@@ -310,6 +430,11 @@ class WarmWorker:
             # compiled, so later jobs of this signature start warm
             with self._lock:
                 self._warm_specs.add(spec_signature(spec))
+            self._note_verdict(
+                verdicts, vkey, verdict_reused, ladder_seen["ladder"],
+                ladder_seen["rows_real"], ladder_seen["rows_pad"],
+                job_id=spec.job_id,
+            )
             return ("preempted", p.chunks_done, p.reason, dict(slice_bytes))
         except JobDeadlineExceeded:
             # same warm logic: the slice ran real chunks before the
@@ -325,6 +450,11 @@ class WarmWorker:
         # warm would inflate the compile-hit metric the bench reports
         with self._lock:
             self._warm_specs.add(spec_signature(spec))
+        self._note_verdict(
+            verdicts, vkey, verdict_reused, list(rep.bucket_ladder),
+            rep.n_rows_real, rep.n_rows_padded,
+            job_id=spec.job_id,
+        )
         result = json.loads(rep.to_json())
         result["output"] = os.path.abspath(spec.output)
         return ("done", result)

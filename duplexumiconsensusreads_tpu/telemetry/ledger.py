@@ -62,7 +62,7 @@ from duplexumiconsensusreads_tpu.telemetry.trace import KNOWN_XFER_DIRS
 __all__ = [
     "KNOWN_XFER_DIRS", "SUMMARY_BYTE_KEYS", "xfer_records", "byte_totals",
     "bandwidth_stats", "wire_floor", "packing_stats", "per_chunk_bytes",
-    "summary_bytes", "sum_check_bytes", "output_check",
+    "summary_bytes", "sum_check_bytes", "output_check", "fill_stats",
 ]
 
 # summary["bytes"] keys the executor embeds (all integers; *_logical
@@ -252,7 +252,10 @@ def _resumed_wire(records: list[dict]) -> int:
 
 def per_chunk_bytes(records: list[dict]) -> dict[int, dict]:
     """Per chunk: logical/wire byte sums per direction (the byte table
-    ``wirestat.py`` prints beside ``trace_report.py``'s time table)."""
+    ``wirestat.py`` prints beside ``trace_report.py``'s time table).
+    h2d rows also sum the dispatch records' ``rows_real``/``rows_pad``
+    padding attrs (absent on pre-tuner captures), so the table can
+    print a per-chunk fill-factor column."""
     out: dict[int, dict] = {}
     for rec in xfer_records(records):
         if "chunk" not in rec:
@@ -265,7 +268,44 @@ def per_chunk_bytes(records: list[dict]) -> dict[int, dict]:
             d["logical"] += int(rec["logical"])
         d["wire"] += int(rec.get("wire", 0))
         d["resumed"] = bool(d["resumed"] or rec.get("resumed"))
+        if rec.get("dir") == "h2d" and _is_num(rec.get("rows_pad")):
+            d["rows_real"] = d.get("rows_real", 0) + int(rec.get("rows_real", 0))
+            d["rows_pad"] = d.get("rows_pad", 0) + int(rec["rows_pad"])
     return dict(sorted(out.items()))
+
+
+def fill_stats(records: list[dict]) -> dict:
+    """Bucket fill-factor view of a capture (the padding the tuner
+    exists to cut): real read rows vs padded row-slots summed from the
+    h2d dispatch records, the run's resolved fill factor, and the
+    record-vs-summary cross-check mirroring the byte sum-check — exact
+    integer equality, one-sided under recorder truncation, skipped on
+    captures whose summary predates the counters. Returns {} for
+    pre-tuner captures (no rows attrs anywhere)."""
+    rows_real = rows_pad = 0
+    for rec in xfer_records(records):
+        if rec.get("dir") == "h2d" and _is_num(rec.get("rows_pad")):
+            rows_real += int(rec.get("rows_real", 0))
+            rows_pad += int(rec["rows_pad"])
+    if not rows_pad:
+        return {}
+    out = {
+        "rows_real": rows_real,
+        "rows_pad": rows_pad,
+        "fill_factor": round(rows_real / rows_pad, 4),
+    }
+    s = summary_record(records) or {}
+    counters = s.get("counters") or {}
+    want_real = counters.get("n_rows_real")
+    want_pad = counters.get("n_rows_padded")
+    if _is_num(want_real) and _is_num(want_pad):
+        dropped = int(s.get("n_dropped") or 0)
+        if dropped:
+            ok = rows_real <= int(want_real) and rows_pad <= int(want_pad)
+        else:
+            ok = rows_real == int(want_real) and rows_pad == int(want_pad)
+        out["sum_check_ok"] = ok
+    return out
 
 
 def summary_bytes(records: list[dict]) -> dict | None:

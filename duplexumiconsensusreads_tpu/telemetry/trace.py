@@ -85,8 +85,14 @@ KNOWN_EVENTS = (
     "truncated",  # the bounded recorder hit max_events; tail dropped
     "packed_fallback",  # wire packing downgraded a rung (pos ids past
     # u16, qual cap past the 6-bit payload, per-base tags forcing an
-    # unpacked d2h): the per-chunk packing decision the ledger records
-    # instead of a mid-dispatch job failure (attrs: reason, scope)
+    # unpacked d2h, a class capacity overflowing the u16 ids lane): the
+    # per-chunk packing decision the ledger records instead of a
+    # mid-dispatch job failure (attrs: reason, scope)
+    "tuner_verdict",  # bucket auto-tuner (tuning/): the profile pass
+    # settled the run's bucket ladder (attrs: ladder, fill_factor,
+    # fill_factor_off, predicted_speedup, source) — in a run capture at
+    # the first profiled chunk, in a service capture when a verdict is
+    # persisted/reused for a job's input profile
     # serving layer (serve/service.py): the job lifecycle in a
     # kind="service" capture. Every job_* event carries a "job" attr and
     # a "job-<id>" lane, so one capture decomposes per job the way a run
@@ -125,6 +131,16 @@ KNOWN_XFER_DIRS = (
     "d2h",  # fetch: consensus output tensors -> host
     "shard",  # drain: raw record stream -> deflated durable shard
 )
+
+# Schema attrs an h2d ledger record may carry beyond the core envelope
+# (logical/wire/t/dur/chunk/lane) — a registry like the dirs above, so
+# dutlint's phase-registry rule pins every literal keyword at the
+# emitting site and the xfer schema golden cannot drift silently:
+#   bpc        the packing rung's wire bits per base/qual cycle
+#   rows_real  real read rows in the dispatch (bucket fill numerator)
+#   rows_pad   padded row-slots dispatched (capacity x padded buckets)
+#   cap        the dispatch class's bucket capacity (its ladder rung)
+KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap")
 
 
 def current_lane() -> str:

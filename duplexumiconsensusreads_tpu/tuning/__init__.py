@@ -1,0 +1,15 @@
+from duplexumiconsensusreads_tpu.tuning.tuner import (  # noqa: F401
+    MAX_RUNGS,
+    MIN_RUNG,
+    TunerVerdict,
+    candidate_ladders,
+    choose_ladder,
+    group_sizes,
+    ladder_cost,
+    normalize_bucket_ladder,
+    profile_key,
+    race_ssc_methods,
+    single_capacity_cost,
+    validate_ladder,
+)
+from duplexumiconsensusreads_tpu.tuning.store import VerdictStore  # noqa: F401

@@ -356,6 +356,12 @@ TRACE_XFER_OK = """
     KNOWN_EVENTS = ("retry",)
     KNOWN_XFER_DIRS = ("h2d", "d2h", "shard")
     """
+TRACE_XFER_ATTRS = """
+    KNOWN_STAGES = ("ingest", "finalise")
+    KNOWN_EVENTS = ("retry",)
+    KNOWN_XFER_DIRS = ("h2d", "d2h", "shard")
+    KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap")
+    """
 
 
 class TestPhaseRegistry:
@@ -453,6 +459,46 @@ class TestPhaseRegistry:
                     tr.xfer("anything", 0, 0, 0.0, 0.0)
             """})
         assert res.ok
+
+    def test_fires_on_unregistered_h2d_xfer_attr(self):
+        res = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_XFER_ATTRS,
+            "pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.xfer("h2d", 0, 0, 0.0, 0.0, chunk=1, bpc=8,
+                            mystery_attr=3)
+            """,
+        })
+        assert any(
+            "mystery_attr" in f.message and "KNOWN_H2D_XFER_ATTRS"
+            in (f.hint or "") for f in res.findings
+        )
+
+    def test_passes_on_registered_h2d_attrs_and_pre_tuner_corpora(self):
+        ok = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_XFER_ATTRS,
+            "pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.xfer("h2d", 0, 0, 0.0, 0.0, chunk=1, bpc=8,
+                            rows_real=5, rows_pad=8, cap=8)
+            """,
+        })
+        assert ok.ok
+        # no KNOWN_H2D_XFER_ATTRS registry (pre-tuner trees): skip
+        legacy = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_XFER_OK,
+            "pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.xfer("h2d", 0, 0, 0.0, 0.0, anything_goes=1)
+            """,
+        })
+        assert legacy.ok
 
 
 class TestLockDiscipline:

@@ -2665,3 +2665,152 @@ class TestSharding:
             ))
         with pytest.raises(ValueError, match="required keys"):
             validate_spec(_spec(shard={"parent": "p"}))
+
+
+# ----------------------------------------------- bucket-ladder serving
+
+class TestBucketLadder:
+    """Serve-side half of the ladder acceptance matrix: jobs at every
+    --bucket-ladder setting are byte-identical to the off/serial
+    reference (the @PG CL deliberately excludes the ladder — a shape
+    knob the tuner may override per slice must never reach the bytes),
+    and a fleet's auto jobs converge through the spool's verdict
+    store."""
+
+    @pytest.mark.parametrize("ladder", ["off", "auto", [32, 128],
+                                        [32, 64, 128]])
+    def test_job_bytes_identical_at_every_ladder(
+        self, sim, tmp_path, ladder
+    ):
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "out.bam")
+        jid = client.submit(
+            spool, in_path, out,
+            config={**CONFIG, "bucket_ladder": ladder},
+        )
+        svc = ConsensusService(spool, chunk_budget=0)
+        snap = svc.run_until_idle()
+        assert snap["jobs_done"] == 1, snap
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        st = SpoolQueue(spool).status(jid)
+        assert st["state"] == "done"
+        # the result report records the resolved ladder
+        ladder_res = st["result"]["bucket_ladder"]
+        if ladder == "off":
+            assert ladder_res == []
+        elif isinstance(ladder, list):
+            assert ladder_res == ladder
+        else:
+            assert ladder_res and ladder_res[-1] == CONFIG["capacity"]
+
+    def test_ladder_joins_the_compile_signature(self):
+        a = validate_spec(_spec())
+        b = validate_spec(_spec(config={**CONFIG, "bucket_ladder": "auto"}))
+        c = validate_spec(
+            _spec(config={**CONFIG, "bucket_ladder": [32, 128]})
+        )
+        assert len({spec_signature(s) for s in (a, b, c)}) == 3
+
+    def test_invalid_ladder_config_rejected_at_submission(self):
+        with pytest.raises(ValueError, match="bucket_ladder"):
+            validate_spec(_spec(config={**CONFIG, "bucket_ladder": [7, 9]}))
+        with pytest.raises(ValueError, match="bucket_ladder"):
+            validate_spec(_spec(config={**CONFIG, "bucket_ladder": 12}))
+        # well-formed but top rung != capacity: the explicit ladder
+        # would silently replace the capacity the @PG CL records
+        # (serve_provenance excludes bucket_ladder), so the recorded
+        # command line could no longer reproduce the job's bytes
+        with pytest.raises(ValueError, match="top rung"):
+            validate_spec(
+                _spec(config={**CONFIG, "bucket_ladder": [32, 256]})
+            )
+
+    def test_fleet_converges_through_the_verdict_store(
+        self, sim, tmp_path
+    ):
+        from duplexumiconsensusreads_tpu import tuning
+
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        outs = [str(tmp_path / f"o{i}.bam") for i in range(2)]
+        for o in outs:
+            client.submit(
+                spool, in_path, o,
+                config={**CONFIG, "bucket_ladder": "auto"},
+            )
+        svc_trace = str(tmp_path / "svc.trace.jsonl")
+        svc = ConsensusService(spool, chunk_budget=0,
+                               trace_path=svc_trace)
+        snap = svc.run_until_idle()
+        assert snap["jobs_done"] == 2
+        for o in outs:
+            with open(o, "rb") as f:
+                assert f.read() == ref_bytes
+        # job 1 profiled fresh and PERSISTED; job 2 (same input profile)
+        # REUSED the stored verdict instead of re-profiling
+        assert svc.worker.n_verdict_puts == 1
+        assert svc.worker.n_verdict_hits == 1
+        # ...and BOTH decisions are ledgered in the service capture
+        # (KNOWN_EVENTS tuner_verdict: the fleet's shape decisions are
+        # auditable from any capture), on their jobs' lanes
+        with open(svc_trace) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        tv = [r for r in recs
+              if r.get("type") == "event" and r.get("name") == "tuner_verdict"]
+        assert sorted(r["source"] for r in tv) == ["run", "store"]
+        for r in tv:
+            assert r["ladder"][-1] == CONFIG["capacity"]
+            assert r["lane"] == f"job-{r['job']}"
+        store = tuning.VerdictStore(os.path.join(spool,
+                                                 "tuner_verdicts.json"))
+        assert len(store) == 1
+        sig = spec_signature(
+            validate_spec(_spec(config={**CONFIG, "bucket_ladder": "auto"}))
+        )
+        hit = store.get(tuning.profile_key(in_path, sig))
+        assert hit is not None and hit["ladder"][-1] == CONFIG["capacity"]
+        # a SECOND daemon on the same spool starts converged: its first
+        # auto job is a store hit, zero fresh profiles
+        out3 = str(tmp_path / "o3.bam")
+        client.submit(spool, in_path, out3,
+                      config={**CONFIG, "bucket_ladder": "auto"})
+        svc2 = ConsensusService(spool, chunk_budget=0)
+        # jobs_done includes the 2 journal-rebuilt completions (the
+        # restart-truthful-counters contract) plus this one
+        assert svc2.run_until_idle()["jobs_done"] == 3
+        assert svc2.worker.n_verdict_hits == 1
+        assert svc2.worker.n_verdict_puts == 0
+        with open(out3, "rb") as f:
+            assert f.read() == ref_bytes
+
+    def test_wrong_capacity_stored_verdict_is_refused(self, sim, tmp_path):
+        """A well-formed store entry whose top rung != the job's
+        capacity must NOT be reused: it would silently change the run's
+        effective capacity (and the oversized/jumbo escape thresholds)
+        while the @PG CL still claims the configured one. The slice
+        re-profiles honestly and overwrites the bad entry."""
+        from duplexumiconsensusreads_tpu import tuning
+        from duplexumiconsensusreads_tpu.serve.worker import verdict_key
+
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "o.bam")
+        cfg = {**CONFIG, "bucket_ladder": "auto"}
+        client.submit(spool, in_path, out, config=cfg)
+        vkey = verdict_key(
+            validate_spec(_spec(input=in_path, config=cfg))
+        )
+        store = tuning.VerdictStore(
+            os.path.join(spool, "tuner_verdicts.json")
+        )
+        # valid pow2 ascending, but top rung 64 != capacity 128
+        store.put(vkey, {"ladder": [32, 64], "source": "run"})
+        svc = ConsensusService(spool, chunk_budget=0)
+        assert svc.run_until_idle()["jobs_done"] == 1
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        assert svc.worker.n_verdict_hits == 0
+        assert svc.worker.n_verdict_puts == 1
+        assert store.get(vkey)["ladder"][-1] == CONFIG["capacity"]

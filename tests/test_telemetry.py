@@ -344,8 +344,15 @@ class TestByteLedger:
             if r.get("resumed"):
                 assert set(r) == base | {"resumed"}
             elif r["dir"] == "h2d":
-                assert set(r) == base | {"logical", "bpc"}
+                # bpc joined with the wire-diet-v2 packing ladder;
+                # rows_real/rows_pad/cap with the bucket auto-tuner's
+                # fill-factor audit trail (wirestat's fill column)
+                assert set(r) == base | {
+                    "logical", "bpc", "rows_real", "rows_pad", "cap",
+                }
                 assert r["bpc"] in (16, 8, 7, 5)
+                assert 0 <= r["rows_real"] <= r["rows_pad"]
+                assert r["rows_pad"] % r["cap"] == 0
             else:
                 assert set(r) == base | {"logical"}
             assert isinstance(r["wire"], int) and r["wire"] >= 0
@@ -826,7 +833,8 @@ class TestReportShape:
             "n_projection_fallback_reads", "n_projection_fallback_groups",
             "n_projection_unanchored_reads", "n_umi_corrected",
             "n_dropped_whitelist", "mate_aware", "backend",
-            "bytes_h2d", "bytes_d2h", "seconds",
+            "bytes_h2d", "bytes_d2h", "n_rows_real", "n_rows_padded",
+            "bucket_ladder", "seconds",
         }
         assert {f.name for f in dataclasses.fields(RunReport)} == golden
 

@@ -1,0 +1,681 @@
+"""Bucket ladders + the profile-guided auto-tuner (tuning/).
+
+The acceptance contract this suite pins:
+
+  * output bytes are IDENTICAL at every --bucket-ladder setting —
+    {off, auto, explicit 2-rung, explicit 3-rung} — vs the off/serial
+    reference (the ladder is a shape transform, never a result
+    transform), jumbo-family interaction included;
+  * the ladder DP is exact: covers the run, respects rung bounds,
+    never costs more padded rows than the single-capacity greedy;
+  * auto verdicts are ledgered (tuner_verdict in the capture) and
+    auditable (fill-factor attrs on every h2d ledger record, counters
+    in the summary, the wirestat fill column/sum-check);
+  * the ids-lane u16 fetch rung is byte-exact, saves d2h bytes where
+    the full compaction is gated off, and downgrades with a ledgered
+    reason at capacity >= 2**16 (the per-class rung decision is one
+    pure helper, unit-tested over the whole gate matrix);
+  * tools/tune_ssc.py's JSON contract records the raced winner.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from duplexumiconsensusreads_tpu import tuning
+from duplexumiconsensusreads_tpu.bucketing import build_buckets
+from duplexumiconsensusreads_tpu.bucketing.buckets import _ladder_partition
+from duplexumiconsensusreads_tpu.io import read_bam, simulated_bam
+from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+GP = GroupingParams(strategy="adjacency", paired=True)
+CP = ConsensusParams(mode="duplex")
+
+
+# ------------------------------------------------------------ the DP
+
+
+class TestLadderPartition:
+    def _check(self, sizes, ladder):
+        bounds = np.concatenate([[0], np.cumsum(sizes)])
+        cuts = _ladder_partition(bounds, ladder)
+        # exact coverage, rung membership, per-bucket bound
+        assert cuts[0][0] == 0 and cuts[-1][1] == int(bounds[-1])
+        for (a, b, cap), (a2, _, _) in zip(cuts, cuts[1:] + [(bounds[-1],) * 3]):
+            assert cap in ladder and b - a <= cap
+            assert a2 == b
+        return sum(c for _, _, c in cuts)
+
+    def test_covers_bounds_and_beats_greedy(self):
+        rng = np.random.default_rng(5)
+        sizes = rng.integers(1, 512, size=200)
+        ladder = (64, 128, 512)
+        cost = self._check(sizes, ladder)
+        base = tuning.single_capacity_cost(sizes, 512)
+        # the greedy single-capacity partition is a feasible ladder
+        # solution (every bucket at the top rung), so the DP can never
+        # pad more
+        assert cost <= base["rows_padded"]
+
+    def test_small_tail_takes_small_rung(self):
+        cost = self._check([100] * 5 + [30], (32, 128, 512))
+        assert cost == 512 + 32  # 500 at the top rung + the 30 tail
+
+    def test_single_rung_matches_greedy_cost(self):
+        rng = np.random.default_rng(7)
+        sizes = rng.integers(1, 200, size=120)
+        cost = self._check(sizes, (256,))
+        assert cost == tuning.single_capacity_cost(sizes, 256)["rows_padded"]
+
+    def test_coalesce_path_stays_exact(self):
+        sizes = np.full(6000, 5)
+        cost = self._check(sizes, (256, 1024))
+        assert cost >= 30000  # covers every read
+        # worst waste bounded by one min-rung//8 block per bucket
+        assert cost <= 30000 + (cost // 1024 + 1) * (256 // 8) + 1024
+
+
+class TestNormalize:
+    def test_carriers(self):
+        assert tuning.normalize_bucket_ladder("auto") == "auto"
+        assert tuning.normalize_bucket_ladder(None) == "off"
+        assert tuning.normalize_bucket_ladder("256,1024") == (256, 1024)
+        assert tuning.normalize_bucket_ladder([64, 512]) == (64, 512)
+        assert tuning.normalize_bucket_ladder((2048,)) == (2048,)
+
+    @pytest.mark.parametrize("bad", [
+        "7,13",            # not pow2
+        "512,256",         # descending
+        "8",               # below MIN_RUNG
+        "32,64,128,256,512",  # too many rungs
+        "",                # empty
+        12,                # wrong carrier
+        [64, 64],          # duplicate
+    ])
+    def test_rejections(self, bad):
+        with pytest.raises(ValueError):
+            tuning.normalize_bucket_ladder(bad)
+
+
+class TestChooseLadder:
+    def test_verdict_shape_and_roundtrip(self):
+        sizes = np.array([40] * 50 + [700] * 4 + [25] * 30)
+        v = tuning.choose_ladder(sizes, 1024, pack_mult=2)
+        assert v.ladder[-1] == v.capacity == 1024
+        assert 1 <= len(v.ladder) <= tuning.MAX_RUNGS
+        assert v.fill_factor >= v.fill_factor_off
+        assert v.predicted_speedup >= 1.0
+        assert v.pack_mult == 2 and v.n_reads == int(sizes.sum())
+        assert tuning.TunerVerdict.from_dict(v.to_dict()) == v
+
+    def test_long_tail_picks_a_ladder(self):
+        # shallow tiles + hot tail: the classic win case — the tuner
+        # must find a multi-rung ladder and predict a real gain
+        rng = np.random.default_rng(11)
+        sizes = np.concatenate([
+            rng.integers(20, 90, size=400),
+            rng.integers(900, 1800, size=30),
+        ])
+        rng.shuffle(sizes)
+        v = tuning.choose_ladder(sizes, 2048)
+        assert len(v.ladder) >= 2
+        assert v.fill_factor > v.fill_factor_off
+        assert v.predicted_speedup > 1.0
+
+    def test_uniform_mix_keeps_single_capacity(self):
+        # nothing to win: near-full greedy buckets — the class-overhead
+        # term must stop rung proliferation
+        sizes = np.full(2000, 16)
+        v = tuning.choose_ladder(sizes, 1024)
+        assert v.ladder == (1024,)
+        assert v.predicted_speedup == 1.0
+
+
+# -------------------------------------------------- bucketer integration
+
+
+class TestBuildBucketsLadder:
+    def _batch(self, **kw):
+        cfg = SimConfig(
+            n_molecules=kw.pop("n_molecules", 300),
+            n_positions=kw.pop("n_positions", 40),
+            umi_error=0.02, duplex=True, seed=kw.pop("seed", 3), **kw,
+        )
+        batch, _ = simulate_batch(cfg)
+        return batch
+
+    def test_read_set_identical_and_padding_shrinks(self):
+        batch = self._batch()
+        valid = int(np.asarray(batch.valid).sum())
+        pads = {}
+        for lad in (None, (64, 512), (32, 128, 512)):
+            bks = build_buckets(batch, capacity=512, grouping=GP, ladder=lad)
+            idx = np.concatenate(
+                [b.read_index[b.read_index >= 0] for b in bks]
+            )
+            assert len(idx) == len(set(idx.tolist())) == valid
+            for b in bks:
+                assert int(b.valid.sum()) <= b.capacity
+                if lad is not None and b.capacity <= 512:
+                    assert b.capacity in lad
+            pads[lad] = sum(b.capacity for b in bks)
+        assert pads[(32, 128, 512)] <= pads[None]
+
+    def test_ladder_validation(self):
+        batch = self._batch()
+        with pytest.raises(ValueError):
+            build_buckets(batch, capacity=512, grouping=GP, ladder=(64, 256))
+        with pytest.raises(ValueError):
+            build_buckets(batch, capacity=512, grouping=GP, ladder=(512, 64))
+
+    def test_jumbo_families_ride_their_own_pow2_class(self):
+        # a family larger than the TOP rung still gets its next-pow2
+        # jumbo bucket; plain buckets stay on the ladder's rungs
+        batch = self._batch(
+            n_molecules=30, n_positions=3, mean_family_size=24,
+            max_family_size=120, seed=9,
+        )
+        bks = build_buckets(batch, capacity=64, grouping=GP, ladder=(32, 64))
+        caps = {b.capacity for b in bks}
+        assert any(c > 64 for c in caps), "fixture produced no jumbo family"
+        for b in bks:
+            if b.capacity > 64:
+                assert b.capacity == 1 << (b.capacity.bit_length() - 1)
+            else:
+                assert b.capacity in (32, 64)
+        idx = np.concatenate([b.read_index[b.read_index >= 0] for b in bks])
+        assert len(idx) == len(set(idx.tolist())) == int(
+            np.asarray(batch.valid).sum()
+        )
+
+
+# ------------------------------------------------------ streaming matrix
+
+
+class TestLadderMatrix:
+    """The acceptance A/B: every --bucket-ladder setting must produce
+    output BYTE-IDENTICAL to the off/serial reference."""
+
+    @pytest.fixture(scope="class")
+    def matrix_sim(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("ladder")
+        path = str(d / "in.bam")
+        cfg = SimConfig(n_molecules=60, n_positions=8, umi_error=0.02, seed=31)
+        simulated_bam(cfg, path=path, sort=True)
+        ref = str(d / "ref.bam")
+        # serial reference: single drain worker, ladder off
+        rep = stream_call_consensus(
+            path, ref, GP, CP, capacity=128, chunk_reads=90,
+            drain_workers=1, bucket_ladder="off",
+        )
+        assert rep.n_chunks >= 3
+        with open(ref, "rb") as f:
+            return path, f.read(), rep
+
+    @pytest.mark.parametrize("ladder", ["off", "auto", "32,128", "32,64,128"])
+    def test_byte_identity(self, matrix_sim, tmp_path, ladder):
+        path, ref_bytes, ref_rep = matrix_sim
+        out = str(tmp_path / f"l_{ladder.replace(',', '_')}.bam")
+        rep = stream_call_consensus(
+            path, out, GP, CP, capacity=128, chunk_reads=90,
+            bucket_ladder=ladder,
+        )
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        assert rep.n_consensus == ref_rep.n_consensus
+        # the resolved ladder is reported; explicit 3-rung must shrink
+        # the padded rows the serial reference paid
+        if ladder == "off":
+            assert rep.bucket_ladder == []
+            assert rep.n_rows_padded == ref_rep.n_rows_padded
+        elif ladder == "auto":
+            assert rep.bucket_ladder and rep.bucket_ladder[-1] == 128
+        else:
+            assert rep.bucket_ladder == [int(x) for x in ladder.split(",")]
+            assert rep.n_rows_padded < ref_rep.n_rows_padded
+        assert 0 < rep.n_rows_real <= rep.n_rows_padded
+
+    def test_explicit_top_rung_replaces_capacity(self, matrix_sim, tmp_path):
+        # a ladder whose top rung differs from --capacity wins: the top
+        # rung IS the effective capacity (documented knob precedence),
+        # and bytes still match the reference
+        path, ref_bytes, _ = matrix_sim
+        out = str(tmp_path / "top.bam")
+        rep = stream_call_consensus(
+            path, out, GP, CP, capacity=128, chunk_reads=90,
+            bucket_ladder=(32, 64),
+        )
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        assert rep.bucket_ladder == [32, 64]
+
+    def test_jumbo_plus_ladder_byte_identity(self, tmp_path):
+        # jumbo families (> top rung) and a ladder at once: the
+        # interaction case the issue names
+        path = str(tmp_path / "jumbo.bam")
+        cfg = SimConfig(
+            n_molecules=30, n_positions=3, mean_family_size=24,
+            max_family_size=120, umi_error=0.01, seed=9,
+        )
+        simulated_bam(cfg, path=path, sort=True)
+        outs = {}
+        for name, lad in (("off", "off"), ("ladder", (32, 64))):
+            out = str(tmp_path / f"{name}.bam")
+            rep = stream_call_consensus(
+                path, out, GP, CP, capacity=64, chunk_reads=80,
+                bucket_ladder=lad,
+            )
+            assert rep.n_consensus > 0
+            with open(out, "rb") as f:
+                outs[name] = f.read()
+        assert outs["ladder"] == outs["off"]
+
+
+# --------------------------------------------- observability + wirestat
+
+
+class TestLadderObservability:
+    @pytest.fixture(scope="class")
+    def traced(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("ladder_trace")
+        path = str(d / "in.bam")
+        simulated_bam(
+            SimConfig(n_molecules=60, n_positions=8, umi_error=0.02, seed=31),
+            path=path, sort=True,
+        )
+        out = str(d / "out.bam")
+        trace = str(d / "trace.jsonl")
+        rep = stream_call_consensus(
+            path, out, GP, CP, capacity=128, chunk_reads=90,
+            bucket_ladder="auto", trace_path=trace,
+        )
+        with open(trace) as f:
+            records = [json.loads(line) for line in f]
+        return records, rep, trace
+
+    def test_tuner_verdict_is_ledgered(self, traced):
+        records, rep, _ = traced
+        evs = [
+            r for r in records
+            if r.get("type") == "event" and r.get("name") == "tuner_verdict"
+        ]
+        assert len(evs) == 1  # one verdict per run, at the first chunk
+        ev = evs[0]
+        assert ev["ladder"] == rep.bucket_ladder
+        assert 0 < ev["fill_factor_off"] <= 1
+        assert ev["predicted_speedup"] >= 1.0
+        # the capture still validates against the run schema
+        from duplexumiconsensusreads_tpu.telemetry import report
+        assert report.validate_trace(records) == []
+
+    def test_fill_attrs_and_summary_counters(self, traced):
+        from duplexumiconsensusreads_tpu.telemetry import ledger
+
+        records, rep, _ = traced
+        fill = ledger.fill_stats(records)
+        assert fill["rows_real"] == rep.n_rows_real
+        assert fill["rows_pad"] == rep.n_rows_padded
+        assert fill["sum_check_ok"] is True
+        assert 0 < fill["fill_factor"] <= 1
+        per = ledger.per_chunk_bytes(records)
+        assert any(
+            row.get("h2d", {}).get("rows_pad") for row in per.values()
+        )
+
+    def test_wirestat_fill_column_and_exit_codes(self, traced, tmp_path):
+        _, _, trace = traced
+        env = dict(JAX_PLATFORMS="cpu")
+        import os as _os
+
+        env = {**_os.environ, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.run(
+            [sys.executable, "tools/wirestat.py", trace, "--json"],
+            capture_output=True, text=True, env=env,
+            cwd=_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["fill"]["sum_check_ok"] is True
+        assert 0 < doc["fill"]["fill_factor"] <= 1
+        # tampered rows must trip the fill sum-check like the byte one
+        bad = str(tmp_path / "bad.jsonl")
+        with open(trace) as f, open(bad, "w") as g:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("type") == "xfer" and rec.get("dir") == "h2d":
+                    rec["rows_pad"] = rec["rows_pad"] + 64
+                g.write(json.dumps(rec) + "\n")
+        proc = subprocess.run(
+            [sys.executable, "tools/wirestat.py", bad],
+            capture_output=True, text=True, env=env,
+            cwd=_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 1
+
+
+# --------------------------------------------------- ids-lane u16 rung
+
+
+class TestIds16Rung:
+    def test_rung_decision_matrix(self):
+        from duplexumiconsensusreads_tpu.runtime.executor import (
+            d2h_rung_for_class,
+        )
+
+        # full rung healthy
+        assert d2h_rung_for_class(True, True, 128, False) == ("packed", None)
+        # full rung defeated by a jumbo class: established reason
+        assert d2h_rung_for_class(True, True, 1 << 16, False) == (
+            "off", "jumbo-class-capacity-overflows-u16",
+        )
+        # per-base tags force the partial rung
+        assert d2h_rung_for_class(False, True, 128, True) == ("ids16", None)
+        # the partial rung's own capacity gate, ledgered (the satellite:
+        # gated at capacity >= 2**16 with a fallback event)
+        assert d2h_rung_for_class(False, True, 1 << 16, True) == (
+            "off", "ids-lane-overflows-u16",
+        )
+        assert d2h_rung_for_class(False, True, (1 << 16) // 2, True) == (
+            "ids16", None,
+        )
+        # both knobs off: silent, honest baseline
+        assert d2h_rung_for_class(False, False, 128, False) == ("off", None)
+
+    def test_per_base_tags_byte_identity_and_savings(self, tmp_path):
+        path = str(tmp_path / "in.bam")
+        simulated_bam(
+            SimConfig(n_molecules=60, n_positions=8, umi_error=0.02, seed=31),
+            path=path, sort=True,
+        )
+        outs, reps = {}, {}
+        for name, kw in (
+            ("base", dict(packed="off", d2h_packed="off")),
+            ("ids16", dict(packed="auto", d2h_packed="auto")),
+        ):
+            out = str(tmp_path / f"{name}.bam")
+            reps[name] = stream_call_consensus(
+                path, out, GP, CP, capacity=128, chunk_reads=90,
+                per_base_tags=True, **kw,
+            )
+            with open(out, "rb") as f:
+                outs[name] = f.read()
+        assert outs["ids16"] == outs["base"]
+        # per-base tags gate the FULL compaction off, so the saving here
+        # is exactly the ids lane: 2x (B, R) i32 -> 1x (B, R) u16
+        assert reps["ids16"].bytes_d2h < reps["base"].bytes_d2h
+
+    def test_unpack_roundtrip_and_logical_bytes(self):
+        from duplexumiconsensusreads_tpu.ops.pipeline import PipelineSpec
+        from duplexumiconsensusreads_tpu.runtime.executor import (
+            d2h_logical_nbytes,
+            unpack_fetch_outputs,
+        )
+
+        spec = PipelineSpec(
+            grouping=GroupingParams(strategy="adjacency", paired=True),
+            consensus=ConsensusParams(mode="duplex"),
+        )
+        ids = np.array([[3, 0, -1, 7]], np.int32)
+        fetched = {
+            "ids16": (ids + 1).astype(np.uint16),
+            "n_families": np.array([2], np.int32),
+            "n_molecules": np.array([2], np.int32),
+        }
+        out = unpack_fetch_outputs(fetched, [], spec)
+        assert "ids16" not in out and "family_id" not in out
+        assert out["molecule_id"].dtype == np.int32
+        np.testing.assert_array_equal(out["molecule_id"], ids)
+        # logical = wire - u16 lane + BOTH i32 lanes
+        wire = sum(v.nbytes for v in fetched.values())
+        assert d2h_logical_nbytes(fetched, [], spec) == (
+            wire - fetched["ids16"].nbytes + 2 * ids.size * 4
+        )
+
+
+# --------------------------------------------------------- verdict store
+
+
+class TestVerdictStore:
+    def test_roundtrip_and_corruption_tolerance(self, tmp_path):
+        store = tuning.VerdictStore(str(tmp_path / "v.json"))
+        assert store.get("k") is None
+        store.put("k", {"ladder": [64, 256], "fill_factor": 0.9})
+        assert store.get("k")["ladder"] == [64, 256]
+        assert len(store) == 1
+        # torn/garbage store degrades to empty, never raises
+        with open(store.path, "w") as f:
+            f.write("{not json")
+        assert store.get("k") is None
+        store.put("k2", {"ladder": [128]})
+        assert store.get("k2") == {"ladder": [128]}
+
+    def test_bounded(self, tmp_path, monkeypatch):
+        from duplexumiconsensusreads_tpu.tuning import store as store_mod
+
+        monkeypatch.setattr(store_mod, "MAX_VERDICTS_KEPT", 3)
+        store = tuning.VerdictStore(str(tmp_path / "v.json"))
+        for i in range(5):
+            store.put(f"k{i}", {"ladder": [64]})
+        assert len(store) == 3
+        assert store.get("k0") is None and store.get("k4") is not None
+
+    def test_profile_key_tracks_input_identity(self, tmp_path):
+        p = tmp_path / "a.bam"
+        p.write_bytes(b"x" * 10)
+        k1 = tuning.profile_key(str(p), "sig")
+        assert k1 == tuning.profile_key(str(p), "sig")
+        assert k1 != tuning.profile_key(str(p), "other-sig")
+        p.write_bytes(b"y" * 11)
+        assert k1 != tuning.profile_key(str(p), "sig")
+
+
+# ------------------------------------------------------------- tune_ssc
+
+
+class TestTuneSsc:
+    def test_build_result_records_winner(self):
+        sys.path.insert(0, "tools")
+        try:
+            import tune_ssc
+        finally:
+            sys.path.pop(0)
+        race = {
+            "backend": "cpu", "n_reads": 100, "capacity": 128, "reps": 1,
+            "methods": {
+                "matmul": {"method": "matmul", "blockseg_t": None,
+                           "step_s": 0.2, "reads_per_sec": 500.0},
+                "blockseg(T=64)": {"method": "blockseg", "blockseg_t": 64,
+                                   "step_s": 0.1, "reads_per_sec": 1000.0},
+            },
+            "winner": "blockseg(T=64)", "winner_method": "blockseg",
+        }
+        res = tune_ssc.build_result(race)
+        assert res["winner"] == "blockseg(T=64)"
+        assert res["winner_method"] == "blockseg"
+        assert res["version"] == 2 and res["tool"] == "tune_ssc"
+        json.dumps(res)  # the whole result must be JSON-serialisable
+
+    def test_race_runs_live_kernels(self):
+        # tiny geometry, one method pair: proves the race harness runs
+        # the CURRENT fused pipeline end to end and ranks by measured
+        # reads/s (the post-r5 re-race contract)
+        race = tuning.race_ssc_methods(
+            methods=("matmul", "blockseg"), blockseg_ts=(64,), reps=1,
+            n_molecules=120, read_len=32, n_positions=6, capacity=64,
+        )
+        assert set(race["methods"]) == {"matmul", "blockseg(T=64)"}
+        assert race["winner"] in race["methods"]
+        assert race["winner_method"] in ("matmul", "blockseg")
+        for row in race["methods"].values():
+            assert row["step_s"] > 0 and row["reads_per_sec"] > 0
+
+
+# ------------------------------------------------------------------ CLI
+
+
+class TestCliFlag:
+    def test_whole_file_refuses_ladder(self, tmp_path):
+        from duplexumiconsensusreads_tpu.cli import main
+
+        path = str(tmp_path / "in.bam")
+        simulated_bam(SimConfig(n_molecules=10), path=path, sort=True)
+        with pytest.raises(SystemExit, match="bucket-ladder"):
+            main(["call", path, "-o", str(tmp_path / "o.bam"),
+                  "--bucket-ladder", "auto"])
+
+    def test_bad_value_refused(self, tmp_path):
+        from duplexumiconsensusreads_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="bucket-ladder"):
+            main(["call", str(tmp_path / "in.bam"), "-o",
+                  str(tmp_path / "o.bam"), "--chunk-reads", "90",
+                  "--bucket-ladder", "7,9"])
+
+    def test_streaming_cli_happy_path(self, tmp_path):
+        from duplexumiconsensusreads_tpu.cli import main
+
+        path = str(tmp_path / "in.bam")
+        simulated_bam(
+            SimConfig(n_molecules=40, n_positions=6, umi_error=0.02, seed=5),
+            path=path, sort=True,
+        )
+        out_l = str(tmp_path / "l.bam")
+        out_o = str(tmp_path / "o.bam")
+        assert main(["call", path, "-o", out_l, "--config", "config3",
+                     "--capacity", "128", "--chunk-reads", "90",
+                     "--bucket-ladder", "32,128"]) == 0
+        assert main(["call", path, "-o", out_o, "--config", "config3",
+                     "--capacity", "128", "--chunk-reads", "90"]) == 0
+        _, rl = read_bam(out_l)
+        _, ro = read_bam(out_o)
+        assert len(rl) == len(ro)
+        np.testing.assert_array_equal(rl.seq, ro.seq)
+        np.testing.assert_array_equal(rl.qual, ro.qual)
+
+
+# ------------------------------------------------------ bench tuner leg
+
+
+class TestBucketTunerBench:
+    def test_fill_improves_on_the_long_tail_fixture(self, monkeypatch):
+        """The acceptance criterion, verbatim: the CPU bench sim's
+        e2e_fill_factor improves vs single-capacity bucketing on the
+        canonical long-tail fixture (MEASURED through build_buckets,
+        not just the cost model's prediction)."""
+        monkeypatch.setenv("DUT_BENCH_TUNER_MOLECULES", "6000")
+        monkeypatch.setenv("DUT_BENCH_CAPACITY", "2048")
+        from duplexumiconsensusreads_tpu.benchmark import (
+            run_bucket_tuner_bench,
+        )
+
+        res = run_bucket_tuner_bench()
+        assert res["e2e_fill_factor"] > res["bucket_tuner_fill_factor_off"]
+        assert res["tuner_predicted_speedup"] > 1.0
+        assert len(res["tuner_ladder"]) >= 2
+        assert res["tuner_ladder"][-1] == 2048
+
+    def test_leg_keys_ride_the_compact_line_and_trajectory(self):
+        from duplexumiconsensusreads_tpu import benchhist
+        from duplexumiconsensusreads_tpu.benchmark import COMPACT_KEYS
+
+        canon = {k for k, _, _ in benchhist.CANONICAL_METRICS}
+        for key in ("e2e_fill_factor", "tuner_predicted_speedup"):
+            assert key in COMPACT_KEYS
+            assert key in canon
+            # informational, never gated: shape decisions follow the
+            # input mix, and the gate must not cry weather
+            assert not dict(
+                (k, g) for k, _, g in benchhist.CANONICAL_METRICS
+            )[key]
+
+
+# --------------------------------------------------- review regressions
+
+
+class TestReviewRegressions:
+    def test_coalesce_never_builds_an_infeasible_block(self):
+        """A partial coalesce block followed by a near-capacity group
+        must not merge past the top rung (was a TypeError crash on the
+        at-scale hot-tail inputs the tuner targets)."""
+        sizes = [1, 4096] + [1] * 4200
+        bounds = np.concatenate([[0], np.cumsum(sizes)])
+        cuts = _ladder_partition(bounds, (256, 4096))
+        assert cuts[0][0] == 0 and cuts[-1][1] == int(bounds[-1])
+        for a, b, cap in cuts:
+            assert b - a <= cap and cap in (256, 4096)
+
+    def test_off_baseline_flushes_at_oversized_groups(self):
+        """single_capacity_cost must close the open bucket at an
+        oversized group exactly like the real packer's special-path
+        flush — the model and the run may never disagree."""
+        got = tuning.single_capacity_cost(np.array([100, 300, 100]), 256)
+        assert got["n_buckets"] == 2 and got["rows_padded"] == 512
+
+    def test_ladder_config_variants_normalise_everywhere(self):
+        """'AUTO' / spaced rung strings must behave exactly like their
+        canonical forms: same compile signature, same kwargs — a
+        cosmetic variant must not bypass the verdict store."""
+        from duplexumiconsensusreads_tpu.serve.job import (
+            job_params,
+            spec_signature,
+            validate_spec,
+        )
+
+        def spec(ladder):
+            return validate_spec({
+                "job_id": "j", "input": "/i.bam", "output": "/o.bam",
+                "config": {"chunk_reads": 90, "capacity": 128,
+                           "bucket_ladder": ladder},
+            })
+
+        canon, shouty = spec("auto"), spec("AUTO")
+        assert spec_signature(canon) == spec_signature(shouty)
+        assert job_params(shouty)[2]["bucket_ladder"] == "auto"
+        spaced, listy = spec(" 32 , 128 "), spec([32, 128])
+        assert spec_signature(spaced) == spec_signature(listy)
+        assert job_params(spaced)[2]["bucket_ladder"] == (32, 128)
+
+    def test_unreusable_single_rung_verdicts_are_not_persisted(self, tmp_path):
+        """A resolved capacity that validate_ladder would refuse on
+        reuse (non-pow2 / below MIN_RUNG) must not be persisted —
+        persisting it would make every later slice hit, fail, and
+        re-put the store forever."""
+        from duplexumiconsensusreads_tpu.serve.worker import WarmWorker
+
+        w = WarmWorker()
+        store = tuning.VerdictStore(str(tmp_path / "v.json"))
+        w._note_verdict(store, "k", False, [16], 10, 20)  # below MIN_RUNG
+        w._note_verdict(store, "k", False, [96], 10, 20)  # not pow2
+        assert len(store) == 0 and w.n_verdict_puts == 0
+        w._note_verdict(store, "k", False, [128], 10, 20)
+        assert store.get("k")["ladder"] == [128] and w.n_verdict_puts == 1
+
+    def test_shard_subjobs_get_range_scoped_verdict_keys(self, tmp_path):
+        """Sibling shard sub-jobs (and the whole-file job) must not
+        collide on one verdict-store key: each profiles its own
+        range's group-size mix."""
+        from duplexumiconsensusreads_tpu.serve.job import validate_spec
+        from duplexumiconsensusreads_tpu.serve.worker import verdict_key
+
+        p = tmp_path / "in.bam"
+        p.write_bytes(b"x" * 64)
+
+        def key(shard):
+            d = {"job_id": "j", "input": str(p), "output": "/o.bam",
+                 "config": {"chunk_reads": 90, "bucket_ladder": "auto"}}
+            if shard:
+                d["job_id"] = f"j.s{shard['idx']}"
+                d["shard"] = shard
+            return verdict_key(validate_spec(d))
+
+        whole = key(None)
+        s0 = key({"parent": "j", "idx": 0, "k": 2, "chunk_base": 0,
+                  "key_lo": 0, "key_hi": 50})
+        s1 = key({"parent": "j", "idx": 1, "k": 2, "chunk_base": 5,
+                  "key_lo": 50, "key_hi": None})
+        assert len({whole, s0, s1}) == 3

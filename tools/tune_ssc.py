@@ -91,76 +91,115 @@ reads/s. Fix shipped: run_per_config times two rounds and reports the
 best (the CPU-denominator discipline). The remaining gap to config3 is
 the jumbo geometry's honest price: per-read one-hot GEMM work scales
 with f_max, and f_max doubles (4096 vs 2048 per same 2x reads).
+
+v2 (PR 13): the race body moved to tuning.race_ssc_methods and this
+tool became the offline DRIVER: it races whatever kernels are LIVE —
+the journal numbers above predate the r5 min-rank propagation rewrite,
+which changed the grouping FLOP mix, so the method table needed
+re-racing — and records the per-method table plus the WINNER in a JSON
+result (last stdout line; --json writes it to a file) instead of only
+a human table. Re-run on hardware after any kernel rewrite; the
+executors' DEFAULT_SSC_METHOD* constants cite this tool's journal.
 """
 
 from __future__ import annotations
 
-import time
+import argparse
+import json
+import sys
 
-import numpy as np
+
+def build_result(race: dict) -> dict:
+    """The tool's JSON contract around a tuning.race_ssc_methods result:
+    the per-method table verbatim plus the winner, stamped with the
+    tool's schema version so downstream consumers (the serve layer's
+    verdict store, a future bench leg) can trust the shape. Pure
+    function — unit-testable without a device race."""
+    return {
+        "tool": "tune_ssc",
+        "version": 2,
+        "backend": race["backend"],
+        "n_reads": race["n_reads"],
+        "capacity": race["capacity"],
+        "reps": race["reps"],
+        "methods": race["methods"],
+        # the re-raced table's verdict: the method the executors should
+        # default to on THIS backend for this FLOP mix (the table above
+        # was stale since the r5 min-rank propagation rewrite — this
+        # race always measures the live kernels)
+        "winner": race["winner"],
+        "winner_method": race["winner_method"],
+    }
 
 
-def main() -> None:
-    import jax
-
-    from duplexumiconsensusreads_tpu.bucketing import build_buckets, stack_buckets
-    from duplexumiconsensusreads_tpu.parallel import make_mesh
-    from duplexumiconsensusreads_tpu.parallel.sharded import (
-        presharded_pipeline,
-        shard_stacked,
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tune_ssc.py",
+        description="offline ssc-method race (fused pipeline, live "
+        "kernels) — prints a table and a final JSON line with the "
+        "winner; the journal in this file's docstring records past "
+        "hardware rounds",
     )
-    from duplexumiconsensusreads_tpu.runtime.executor import partition_buckets
-    from duplexumiconsensusreads_tpu.simulate import SimConfig, simulate_batch
-    from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
-
-    gp = GroupingParams(strategy="adjacency", paired=True)
-    cp = ConsensusParams(mode="duplex", error_model="cycle", min_duplex_reads=1)
-    cfg = SimConfig(
-        n_molecules=22_000,
-        read_len=150,
-        n_positions=460,
-        mean_family_size=4,
-        umi_error=0.01,
-        duplex=True,
-        seed=7,
+    ap.add_argument(
+        "--json", metavar="PATH", default=None,
+        help="also write the result JSON here (stdout always carries "
+        "it as the LAST line, the bench stdout contract)",
     )
-    batch, _ = simulate_batch(cfg)
-    n_reads = int(np.asarray(batch.valid).sum())
-    buckets = build_buckets(batch, capacity=2048, grouping=gp)
-    mesh = make_mesh(len(jax.devices()))
+    ap.add_argument(
+        "--reps", type=int, default=6,
+        help="timed repetitions per method (default 6)",
+    )
+    ap.add_argument(
+        "--molecules", type=int, default=22_000,
+        help="simulated molecules for the race workload (default 22000)",
+    )
+    ap.add_argument(
+        "--capacity", type=int, default=2048,
+        help="bucket capacity of the race geometry (default 2048)",
+    )
+    ap.add_argument(
+        "--methods", default="matmul,blockseg,runsum,segment",
+        help="comma-separated ssc methods to race",
+    )
+    ap.add_argument(
+        "--blockseg-t", default="64,128,256,512",
+        help="blockseg tile heights to sweep (comma-separated)",
+    )
+    args = ap.parse_args(argv)
 
-    plans = [("matmul", None)] + [
-        ("blockseg", t) for t in (64, 128, 256, 512)
-    ] + [("runsum", None), ("segment", None)]
-    import dataclasses as _dc
+    from duplexumiconsensusreads_tpu.tuning import race_ssc_methods
 
-    for method, t in plans:
-        jax.clear_caches()
-        part = partition_buckets(buckets, gp, cp, method)
-        classes = [
-            (
-                cspec if t is None else _dc.replace(cspec, blockseg_t=t),
-                shard_stacked(stack_buckets(cb, multiple_of=1), mesh),
-            )
-            for cb, cspec in part
-        ]
-        jax.block_until_ready([c[1] for c in classes])
-
-        def run_all():
-            return [presharded_pipeline(args, cspec, mesh) for cspec, args in classes]
-
-        for o in run_all():
-            np.asarray(o["n_families"])  # compile + sync
-        reps = 6
-        t0 = time.monotonic()
-        outs = [run_all() for _ in range(reps)]
-        for rep_outs in outs:
-            for o in rep_outs:
-                np.asarray(o["n_families"])
-        dt = (time.monotonic() - t0) / reps
-        label = method if t is None else f"{method}(T={t})"
-        print(f"{label:16s} step={dt:.3f}s  {n_reads/dt/1e6:.3f}M reads/s")
+    race = race_ssc_methods(
+        methods=tuple(m for m in args.methods.split(",") if m),
+        blockseg_ts=tuple(
+            int(t) for t in args.blockseg_t.split(",") if t
+        ),
+        reps=args.reps,
+        n_molecules=args.molecules,
+        capacity=args.capacity,
+    )
+    for label, row in race["methods"].items():
+        print(
+            f"{label:16s} step={row['step_s']:.3f}s  "
+            f"{row['reads_per_sec'] / 1e6:.3f}M reads/s",
+            file=sys.stderr,
+        )
+    print(
+        f"winner: {race['winner']} ({race['backend']} backend)",
+        file=sys.stderr,
+    )
+    result = build_result(race)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f)
+    print(json.dumps(result), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    import os as _os
+
+    sys.path.insert(
+        0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    )
+    raise SystemExit(main())

@@ -70,7 +70,11 @@ def main(argv: list[str] | None = None) -> int:
     disk_problems, disk_ok = ledger.output_check(
         records, out_path=args.out, totals=totals
     )
-    ok = sum_ok and disk_ok
+    fill = ledger.fill_stats(records)
+    # the padding sum-check mirrors the byte one: fill rows recorded
+    # per dispatch must reproduce the summary counters exactly
+    fill_ok = fill.get("sum_check_ok", True)
+    ok = sum_ok and disk_ok and fill_ok
     n_xfer = sum(t["n"] for t in totals.values())
 
     if args.json:
@@ -81,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
             "wire_floor": ledger.wire_floor(records, totals=totals),
             "packing": ledger.packing_stats(records, totals=totals),
             "chunks": ledger.per_chunk_bytes(records),
+            "fill": fill,
             "summary_bytes": ledger.summary_bytes(records),
             "sum_check": {"ok": sum_ok, "rows": rows},
             "output_check": {"ok": disk_ok, "problems": disk_problems},
@@ -94,7 +99,7 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"{'chunk':>6} {'h2d_logical':>12} {'h2d_wire':>12} "
             f"{'d2h_logical':>12} {'d2h_wire':>12} "
-            f"{'shard_raw':>12} {'shard_wire':>12}  note"
+            f"{'shard_raw':>12} {'shard_wire':>12} {'fill':>6}  note"
         )
         for i, (chunk, row) in enumerate(chunks.items()):
             if i >= _TABLE_ROWS:
@@ -105,13 +110,19 @@ def main(argv: list[str] | None = None) -> int:
             d2h = row.get("d2h", {})
             shard = row.get("shard", {})
             note = "resumed" if shard.get("resumed") else ""
+            # per-chunk bucket fill factor (the tuner's audit column);
+            # "-" on pre-tuner captures and resume-reused chunks
+            cfill = (
+                f"{h2d['rows_real'] / h2d['rows_pad']:.2f}"
+                if h2d.get("rows_pad") else "-"
+            )
             print(
                 f"{chunk:>6} {_fmt_bytes(h2d.get('logical', 0)):>12} "
                 f"{_fmt_bytes(h2d.get('wire', 0)):>12} "
                 f"{_fmt_bytes(d2h.get('logical', 0)):>12} "
                 f"{_fmt_bytes(d2h.get('wire', 0)):>12} "
                 f"{_fmt_bytes(shard.get('logical', 0)):>12} "
-                f"{_fmt_bytes(shard.get('wire', 0)):>12}  {note}"
+                f"{_fmt_bytes(shard.get('wire', 0)):>12} {cfill:>6}  {note}"
             )
         print()
         for direction in ledger.KNOWN_XFER_DIRS:
@@ -124,6 +135,13 @@ def main(argv: list[str] | None = None) -> int:
             print(
                 f"{direction:<6} n={t['n']:<5} logical={t['logical']:,} "
                 f"wire={t['wire']:,} busy={t['busy_s']:.3f}s{extra}"
+            )
+        if fill:
+            verdict = "" if fill_ok else "  SUM-CHECK FAIL"
+            print(
+                f"fill: rows_real={fill['rows_real']:,} "
+                f"rows_pad={fill['rows_pad']:,} "
+                f"fill_factor={fill['fill_factor']}{verdict}"
             )
         pack = ledger.packing_stats(records, totals=totals)
         if pack:
