@@ -295,6 +295,20 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
     h2d_attrs, _ = str_tuple_assign(
         corpus.trees[trace_path], "KNOWN_H2D_XFER_ATTRS"
     )
+    # fleet timeline registries (telemetry/fleet.py): segment/gap kinds
+    # the cross-daemon stitcher constructs and the SLO/prom surfaces key
+    # on — absent in pre-fleet corpora, where the checks simply skip
+    fleet_path = corpus.find("telemetry/fleet.py")
+    seg_kinds: list[str] = []
+    gap_kinds: list[str] = []
+    fleet_reg_line = 1
+    if fleet_path is not None:
+        seg_kinds, fleet_reg_line = str_tuple_assign(
+            corpus.trees[fleet_path], "FLEET_SEGMENT_KINDS"
+        )
+        gap_kinds, _ = str_tuple_assign(
+            corpus.trees[fleet_path], "FLEET_GAP_KINDS"
+        )
     if not stages:
         yield Finding(
             rule="phase-registry",
@@ -398,6 +412,33 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
                     "KNOWN_XFER_DIRS (and the ledger analysis + "
                     "ARCHITECTURE.md schema)",
                 )
+            if name == "seg_rec" and seg_kinds and lit not in seg_kinds:
+                # fleet timeline records: an unregistered segment kind
+                # forks the stitched-timeline schema the SLO gates and
+                # the Perfetto export key on — same drift class as a
+                # typo'd span stage (the constructor also refuses at
+                # runtime; this catches it at lint time)
+                yield Finding(
+                    rule="phase-registry",
+                    path=path,
+                    line=node.lineno,
+                    message=f"fleet segment recorded under unknown kind "
+                    f"{lit!r}",
+                    hint="register the kind in telemetry.fleet."
+                    "FLEET_SEGMENT_KINDS (and ARCHITECTURE.md's fleet "
+                    "observability schema)",
+                )
+            if name == "gap_rec" and gap_kinds and lit not in gap_kinds:
+                yield Finding(
+                    rule="phase-registry",
+                    path=path,
+                    line=node.lineno,
+                    message=f"fleet gap recorded under unknown kind "
+                    f"{lit!r}",
+                    hint="register the kind in telemetry.fleet."
+                    "FLEET_GAP_KINDS (and ARCHITECTURE.md's fleet "
+                    "observability schema)",
+                )
             if name == "xfer" and lit == "h2d" and h2d_attrs:
                 # h2d records carry the packing/fill audit attrs; an
                 # unregistered keyword is a silent schema fork — the
@@ -417,6 +458,40 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
                             "KNOWN_H2D_XFER_ATTRS (and the xfer schema "
                             "golden + ARCHITECTURE.md)",
                         )
+
+    # dead-registry detection, the fault-registry rule's second
+    # direction: a fleet kind nothing in the stitcher ever produces is
+    # a schema entry consumers will wait on forever. Literals inside
+    # the registry tuples themselves don't count as use.
+    if fleet_path is not None and (seg_kinds or gap_kinds):
+        skip_nodes = set()
+        for node in ast.walk(corpus.trees[fleet_path]):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in (
+                    "FLEET_SEGMENT_KINDS", "FLEET_GAP_KINDS"
+                )
+            ):
+                skip_nodes.update(id(n) for n in ast.walk(node))
+        used = {
+            lit
+            for node in ast.walk(corpus.trees[fleet_path])
+            if id(node) not in skip_nodes
+            and (lit := str_const(node)) is not None
+        }
+        for kind in list(seg_kinds) + list(gap_kinds):
+            if kind not in used:
+                yield Finding(
+                    rule="phase-registry",
+                    path=fleet_path,
+                    line=fleet_reg_line,
+                    message=f"fleet kind {kind!r} is registered but the "
+                    f"stitcher never produces it",
+                    hint="emit it in telemetry/fleet.py or prune the "
+                    "registry entry",
+                )
 
     # the RunReport streaming-seconds golden in tests == stages + derived
     golden_path = corpus.find("tests/test_telemetry.py")

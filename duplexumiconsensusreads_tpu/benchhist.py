@@ -67,6 +67,12 @@ CANONICAL_METRICS = (
     # never gated (they characterise defense policy, not throughput)
     ("serve_quarantine_after_crashes", False, False),
     ("serve_watchdog_detect_latency_s", False, False),
+    # fleet flight recorder (tools/fleet_report.py): e2e p95 and the
+    # takeover recovery gap measured from the serve_fleet leg's OWN
+    # stitched captures — informational, never gated (single-host
+    # in-process fleets measure scheduling, not production latency)
+    ("fleet_e2e_p95_s", False, False),
+    ("fleet_takeover_gap_s", False, False),
     # scatter-gather sharding (serve/shard/): single-host fleets share
     # one device, so the K=4/K=1 ratio characterises scheduling +
     # pipeline-overlap headroom, not device scaling — informational,

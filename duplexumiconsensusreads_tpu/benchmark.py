@@ -82,6 +82,7 @@ COMPACT_KEYS = (
     "serve_amortised_speedup", "serve_fleet_takeover_latency_s",
     "serve_quarantine_after_crashes", "serve_watchdog_detect_latency_s",
     "serve_shard_speedup", "serve_shard_merge_s",
+    "fleet_e2e_p95_s", "fleet_takeover_gap_s",
 )
 
 
@@ -615,6 +616,7 @@ def run_serve_fleet_bench(n_daemons: int) -> dict:
     victim = ConsensusService(
         spool, chunk_budget=0, poll_s=0.02, lease_s=5.0,
         daemon_id="fleet-victim",
+        trace_path=os.path.join(spool, "service.fleet-victim.trace.jsonl"),
     )
     orig_run_slice = victim.worker.run_slice
 
@@ -651,8 +653,13 @@ def run_serve_fleet_bench(n_daemons: int) -> dict:
 
     t0 = time.monotonic()
     survivors = [
-        ConsensusService(spool, chunk_budget=0, poll_s=0.02, lease_s=5.0,
-                         daemon_id=f"fleet-survivor-{i}")
+        ConsensusService(
+            spool, chunk_budget=0, poll_s=0.02, lease_s=5.0,
+            daemon_id=f"fleet-survivor-{i}",
+            trace_path=os.path.join(
+                spool, f"service.fleet-survivor-{i}.trace.jsonl"
+            ),
+        )
         for i in range(1, n_daemons)
     ]
     sthreads = [
@@ -713,6 +720,36 @@ def run_serve_fleet_bench(n_daemons: int) -> dict:
         }
     except (OSError, ValueError):
         pass  # metrics snapshot is best-effort observability
+    # the leg measures its OWN observability layer: stitch the victim's
+    # (unclean, SIGKILL-modelled) and the survivors' captures plus the
+    # journal into cross-daemon timelines and report the fleet-level
+    # e2e p95 and the takeover recovery gap — the same numbers
+    # tools/fleet_report.py would print for this spool, and a CPU
+    # sanity check that the stitcher's sum-check stays green under a
+    # real takeover (a FAILED stitch is worth seeing in the trajectory:
+    # the key goes absent and bench_history flags the hole)
+    try:
+        from duplexumiconsensusreads_tpu.telemetry import fleet
+
+        caps = fleet.load_captures(fleet.discover_service_captures(spool))
+        stitched = fleet.stitch(
+            caps, journal=fleet.load_journal(os.path.join(spool, "queue.json"))
+        )
+        fm = fleet.fleet_metrics(
+            stitched, metrics_docs=fleet.load_metrics_docs(spool)
+        )
+        out["serve_fleet_stitch_ok"] = stitched["ok"]
+        if stitched["ok"]:
+            if isinstance(fm.get("e2e_p95_s"), (int, float)):
+                out["fleet_e2e_p95_s"] = round(fm["e2e_p95_s"], 3)
+            if isinstance(fm.get("takeover_gap_max_s"), (int, float)):
+                out["fleet_takeover_gap_s"] = round(
+                    fm["takeover_gap_max_s"], 3
+                )
+        else:
+            out["serve_fleet_stitch_problems"] = stitched["problems"][:5]
+    except Exception as e:  # noqa: BLE001 — the bench must still report
+        out["serve_fleet_stitch_error"] = repr(e)[:200]
     return out
 
 

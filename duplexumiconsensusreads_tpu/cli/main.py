@@ -87,6 +87,14 @@ def build_parser() -> argparse.ArgumentParser:
         "'still running' from 'dead'",
     )
     c.add_argument(
+        "--json", action="store_true",
+        help="with --status/--wait: print a NORMALIZED machine-readable "
+        "status document on stdout (state, reason, shards rollup, "
+        "relative timestamps) and nothing on stderr — external monitors "
+        "should parse this, not scrape the human messages. Exit codes "
+        "are unchanged (0 done, 1 terminal failure, 3 wait timeout)",
+    )
+    c.add_argument(
         "--deadline", type=float, default=None, metavar="SECONDS",
         help="--submit wall budget from admission: past it the daemon "
         "journals the job terminal 'expired' (a running job aborts at "
@@ -607,8 +615,18 @@ def _cmd_call(args) -> int:
             st = client.wait(
                 spool, args.wait, timeout_s=args.wait_timeout
             )
-        print(json.dumps(st, sort_keys=True))
         state = st.get("state")
+        if args.json:
+            # the machine contract: one normalized document on stdout,
+            # NOTHING on stderr — monitors parse this and branch on the
+            # exit code, instead of scraping the human messages below
+            print(json.dumps(client.status_document(st), sort_keys=True))
+            if st.get("timed_out"):
+                return 3
+            return 1 if state in (
+                "failed", "rejected", "expired", "quarantined", "unknown"
+            ) else 0
+        print(json.dumps(st, sort_keys=True))
         if state in ("rejected", "expired", "quarantined") and st.get("error"):
             # the reason a job never ran (or was given up on) must be
             # one --status away, not buried in the daemon's journal:
@@ -645,6 +663,11 @@ def _cmd_call(args) -> int:
             "failed", "rejected", "expired", "quarantined", "unknown"
         )
         return 1 if bad else 0
+    if args.json:
+        # the normalized status document only exists for the client
+        # verbs; on --submit or a direct run the flag would be
+        # silently inert (refuse-don't-drop, like --deadline)
+        raise SystemExit("--json applies to --status/--wait")
     if args.input is None or args.output is None:
         raise SystemExit("call needs INPUT and -o OUTPUT (unless --status/--wait)")
 

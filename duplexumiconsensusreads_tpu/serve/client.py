@@ -92,6 +92,45 @@ def status(spool_dir: str, job_id: str) -> dict:
     return SpoolQueue(spool_dir).status(job_id)
 
 
+def status_document(st: dict) -> dict:
+    """Normalize a :func:`status`/:func:`wait` answer into the stable
+    machine-readable document ``call --status/--wait --json`` prints:
+    state + reason + shards rollup + RELATIVE timestamps. The journal's
+    ``*_m`` stamps are raw CLOCK_MONOTONIC readings that mean nothing
+    off this host — external monitors get ages/countdowns instead
+    (``admitted_age_s``, ``deadline_in_s``, ``progress_age_s``,
+    ``lease_expires_in_s``), computed against the same clock."""
+    now = time.monotonic()
+    doc: dict = {
+        "job_id": st.get("job_id"),
+        "state": st.get("state"),
+        "reason": st.get("error"),
+    }
+    for key in ("priority", "slices", "chunks_done", "token",
+                "crash_count", "shed", "compacted", "timed_out",
+                "phase", "parent", "shard_idx", "n_shards", "shards",
+                "result"):
+        if key in st:
+            doc[key] = st[key]
+    ts: dict = {}
+    for src, dst, sign in (
+        ("admitted_m", "admitted_age_s", -1),
+        ("progress_m", "progress_age_s", -1),
+        ("deadline_m", "deadline_in_s", +1),
+    ):
+        v = st.get(src)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            ts[dst] = round(sign * (float(v) - now), 3)
+    lease = st.get("lease")
+    if isinstance(lease, dict):
+        doc["lease_owner"] = lease.get("owner")
+        exp = lease.get("expires_m")
+        if isinstance(exp, (int, float)) and not isinstance(exp, bool):
+            ts["lease_expires_in_s"] = round(float(exp) - now, 3)
+    doc["timestamps"] = ts
+    return doc
+
+
 def wait(
     spool_dir: str, job_id: str, timeout_s: float = 0.0, poll_s: float = 0.5
 ) -> dict:

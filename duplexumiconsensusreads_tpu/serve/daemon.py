@@ -120,7 +120,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--trace", default=None, metavar="TRACE_JSONL",
-        help="service capture path (default: SPOOL/service.trace.jsonl)",
+        help="service capture path (default: "
+        "SPOOL/service.<daemon_id>.trace.jsonl — per-daemon on purpose: "
+        "fleet members must not rotate each other's live captures, and "
+        "tools/fleet_report.py stitches all of a spool's captures)",
     )
     p.add_argument(
         "--no-trace", action="store_true",
@@ -164,11 +167,6 @@ def main(argv: list[str] | None = None) -> int:
     from duplexumiconsensusreads_tpu.serve.service import ConsensusService
 
     os.makedirs(args.spool, exist_ok=True)
-    trace_path = None
-    if not args.no_trace:
-        trace_path = args.trace or os.path.join(
-            args.spool, "service.trace.jsonl"
-        )
     service = ConsensusService(
         args.spool,
         chunk_budget=args.chunk_budget,
@@ -176,7 +174,7 @@ def main(argv: list[str] | None = None) -> int:
         workers=args.workers,
         poll_s=args.poll,
         heartbeat_s=args.heartbeat,
-        trace_path=trace_path,
+        trace_path=None if args.no_trace else args.trace,
         n_devices=args.devices,
         lease_s=args.lease if args.lease is not None else LEASE_DEFAULT_S,
         class_depths=class_depths,
@@ -186,6 +184,16 @@ def main(argv: list[str] | None = None) -> int:
         max_crashes=args.max_crashes,
         min_free_bytes=args.min_free_mb << 20,
     )
+    if service.trace_path is None and not args.no_trace:
+        # the default capture path is PER-DAEMON (it needs the resolved
+        # daemon id, which the service generates): a shared default
+        # would have every new fleet member rotate the previous one's
+        # LIVE capture to .prev — with three daemons, the rotation
+        # destroys a capture. The fleet stitcher discovers every
+        # service*.trace.jsonl on the spool.
+        service.trace_path = os.path.join(
+            args.spool, f"service.{service.daemon_id}.trace.jsonl"
+        )
 
     def _drain(signum, _frame):
         print(

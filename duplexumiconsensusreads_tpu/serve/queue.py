@@ -845,8 +845,23 @@ class SpoolQueue:
         trace = (entry.get("spec") or {}).get("trace")
         if trace:
             candidates.append(trace)
-        svc_trace = os.path.join(self.root, "service.trace.jsonl")
-        candidates += [svc_trace + ".prev", svc_trace]
+        # service captures are per-daemon (service.<id>.trace.jsonl +
+        # rotated .prev) since the fleet recorder; the legacy shared
+        # name still matters for --trace overrides and old spools.
+        # Newest-mtime first, so the most recent capture naming a fault
+        # site — the one that saw THIS job's last crash — wins the
+        # setdefault/break scan below over stale history.
+        from duplexumiconsensusreads_tpu.telemetry.fleet import (
+            discover_service_captures,
+        )
+
+        svc = []
+        for p in discover_service_captures(self.root):
+            try:
+                svc.append((os.path.getmtime(p), p))
+            except OSError:
+                continue
+        candidates += [p for _, p in sorted(svc, reverse=True)]
         for path in candidates:
             lines = _trace_tail(path, max_bytes=65536, max_lines=512)
             if not lines:

@@ -269,13 +269,26 @@ class ConsensusService:
 
     def stats(self) -> dict:
         with self._lock:
+            # tuner verdict traffic: slices that reused a stored
+            # bucket-shape verdict over all verdict resolutions (reuses
+            # + fresh persists) — the counters have existed since the
+            # tuner landed but never rode the live line
+            v_hits = self.worker.n_verdict_hits
+            v_total = v_hits + self.worker.n_verdict_puts
             snap = {
                 "elapsed_s": round(time.monotonic() - self._t0, 1),
+                # short-form fleet identity on every heartbeat line and
+                # metrics snapshot: N daemons interleave on one stderr
+                # and one spool, and an anonymous beat is unattributable
+                "daemon": self.daemon_id[:12],
                 "queue_depth": self.queue.queue_depth(),
                 "jobs_inflight": self._n_running,
                 **self.counters,
                 "slices": self.worker.n_slices,
                 "compile_hit_rate": round(self.worker.compile_hit_rate(), 3),
+                "verdict_hit_rate": (
+                    round(v_hits / v_total, 3) if v_total else 0.0
+                ),
             }
         return snap
 
@@ -351,25 +364,38 @@ class ConsensusService:
         in flight, per-job phase seconds, compile-cache hit rate, and
         the per-class latency percentiles — readable by ops/`call
         --status` while the daemon runs. Fleet note: every daemon
-        snapshots the same path (private tmp, atomic replace — never
-        torn); last writer wins and names itself in ``daemon_id``."""
+        snapshots the same legacy path (private tmp, atomic replace —
+        never torn); last writer wins and names itself in ``daemon_id``.
+        Each daemon ALSO owns ``metrics/<daemon_id>.json`` — the
+        per-daemon snapshot the fleet aggregator (telemetry/fleet.py,
+        tools/fleet_report.py) merges, which additionally carries the
+        RAW bounded latency sample FIFOs (``class_latency_samples``):
+        fleet-level percentiles need the samples, because percentiles
+        of percentiles are not percentiles."""
         import json
 
         with self._lock:
-            payload = json.dumps(
-                {
-                    **snap,
-                    "daemon_id": self.daemon_id,
-                    "lease_s": self.lease_s,
-                    "job_seconds": self._job_seconds,
-                    "job_bytes": self._job_bytes_snapshot_locked(),
-                    "class_latency": self._class_latency_locked(),
+            doc = {
+                **snap,
+                "daemon_id": self.daemon_id,
+                "lease_s": self.lease_s,
+                "job_seconds": self._job_seconds,
+                "job_bytes": self._job_bytes_snapshot_locked(),
+                "class_latency": self._class_latency_locked(),
+                "class_latency_samples": {
+                    str(pri): {k: list(v) for k, v in kinds.items()}
+                    for pri, kinds in self._lat.items()
                 },
-                sort_keys=True,
-            ).encode()
+            }
+            payload = json.dumps(doc, sort_keys=True).encode()
         path = os.path.join(self.queue.root, "metrics.json")
+        mine = os.path.join(
+            self.queue.root, "metrics", f"{self.daemon_id}.json"
+        )
         try:
+            os.makedirs(os.path.dirname(mine), exist_ok=True)
             write_durable(path, payload, tmp=unique_tmp(path))
+            write_durable(mine, payload, tmp=unique_tmp(mine))
         except OSError:
             pass  # the snapshot is observability, never worth a crash
 
@@ -426,7 +452,12 @@ class ConsensusService:
         wd = None
         try:
             if self.trace_path:
-                tr = TraceRecorder(self.trace_path, kind="service")
+                # the meta header names this daemon: every record in
+                # the capture is this daemon's testimony, and the fleet
+                # stitcher (telemetry/fleet.py) attributes run slices
+                # to daemons by exactly this attr
+                tr = TraceRecorder(self.trace_path, kind="service",
+                                   meta={"daemon_id": self.daemon_id})
                 self._tr = tr
                 if telemetry.get_active() is None:
                     # the service capture doubles as the switchboard
@@ -811,16 +842,20 @@ class ConsensusService:
             tr.event("tuner_verdict", job=job_id, lane=f"job-{job_id}",
                      **attrs)
 
-    def _fenced(self, job_id: str, lane: str, detail: str) -> None:
+    def _fenced(self, job_id: str, lane: str, detail: str,
+                token: int | None = None) -> None:
         """A slice lost its lease: count it, record it, commit nothing.
         Not a failure — the reclaiming daemon owns the job and will
-        produce the identical bytes."""
+        produce the identical bytes. ``token`` names the STALE lease
+        the zombie slice held, so the stitcher can tie the fence back
+        to the slice it voids."""
         tr = self._tr
         with self._lock:
             self.counters["jobs_fenced"] += 1
+        attrs = {} if token is None else {"token": token}
         if tr is not None:
             tr.event("job_fenced", job=job_id, lane=lane,
-                     detail=detail[:200])
+                     detail=detail[:200], **attrs)
 
     def _fenced_renew(self, job_id: str, token: int) -> None:
         """Fence check + lease renewal in one flock'd txn — the planner
@@ -851,11 +886,13 @@ class ConsensusService:
         except JobFenced as f:
             # the job died HERE but was already reclaimed: the new
             # owner decides its fate; this daemon records nothing
-            self._fenced(job_id, lane, str(f))
+            self._fenced(job_id, lane, str(f), token=token)
             return
         if tr is not None:
+            # token: the slice's lease identity — the stitcher pairs
+            # this terminal with its job_started on the same token
             tr.event("job_failed", job=job_id, lane=lane,
-                     error=repr(e)[:200], enospc=enospc)
+                     error=repr(e)[:200], enospc=enospc, token=token)
 
     def _run_split(self, spec, token: int) -> None:
         """The parent's split stage: scan the input's chunk grid, plan
@@ -917,7 +954,7 @@ class ConsensusService:
                 f"job {job_id} shard registration",
             )
         except JobFenced as e:
-            self._fenced(job_id, lane, str(e))
+            self._fenced(job_id, lane, str(e), token=token)
             return
         except Exception as e:  # noqa: BLE001 — job-scoped failure
             self._fail_job(job_id, lane, e, token)
@@ -926,7 +963,7 @@ class ConsensusService:
             self.counters["jobs_split"] += 1
         if tr is not None:
             tr.event(
-                "job_split", job=job_id, lane=lane,
+                "job_split", job=job_id, lane=lane, token=token,
                 n_shards=len(dicts), n_chunks=plan.n_chunks,
                 n_records=plan.n_records,
                 wall_s=round(time.monotonic() - t0, 3),
@@ -978,7 +1015,7 @@ class ConsensusService:
                 self.counters["jobs_done"] += 1
                 self.counters["jobs_merged"] += 1
         except JobFenced as e:
-            self._fenced(job_id, lane, str(e))
+            self._fenced(job_id, lane, str(e), token=token)
             return
         except Exception as e:  # noqa: BLE001 — job-scoped failure
             self._fail_job(job_id, lane, e, token)
@@ -986,12 +1023,13 @@ class ConsensusService:
         wall = round(time.monotonic() - t0, 3)
         if tr is not None:
             tr.event(
-                "job_merged", job=job_id, lane=lane,
+                "job_merged", job=job_id, lane=lane, token=token,
                 n_shards=len(shard_paths), merge_s=wall,
                 output_bytes=result["sharded"]["output_bytes"],
             )
             tr.event(
                 "job_completed", job=job_id, lane=lane, wall_s=wall,
+                token=token,
                 n_chunks=result.get("n_chunks", 0),
                 n_consensus=result.get("n_consensus", 0),
                 warm=False, seconds=result.get("seconds", {}),
@@ -1088,7 +1126,7 @@ class ConsensusService:
                 lease=lease,
             )
         except JobFenced as e:
-            self._fenced(job_id, lane, str(e))
+            self._fenced(job_id, lane, str(e), token=token)
             return
         except JobDeadlineExceeded as e:
             # deadline abort at a chunk boundary: terminal `expired`
@@ -1105,14 +1143,14 @@ class ConsensusService:
                     f"job {job_id} deadline expiry",
                 )
             except JobFenced as f:
-                self._fenced(job_id, lane, str(f))
+                self._fenced(job_id, lane, str(f), token=token)
                 return
             with self._lock:
                 self.counters["jobs_expired"] += 1
             if tr is not None:
                 tr.event("job_expired", job=job_id, lane=lane,
                          reason=str(e)[:200],
-                         chunks_done=e.chunks_done)
+                         chunks_done=e.chunks_done, token=token)
             return
         except Exception as e:  # noqa: BLE001 — job-scoped failure
             self._fail_job(job_id, lane, e, token)
@@ -1134,12 +1172,13 @@ class ConsensusService:
                     })
                     jb = dict(self._job_bytes.get(job_id, {}))
             except JobFenced as f:
-                self._fenced(job_id, lane, str(f))
+                self._fenced(job_id, lane, str(f), token=token)
                 return
             if tr is not None:
                 wire = jb.get("h2d_bytes", 0) + jb.get("d2h_bytes", 0)
                 tr.event(
                     "job_completed", job=job_id, lane=lane, wall_s=wall,
+                    token=token,
                     n_chunks=result.get("n_chunks", 0),
                     n_consensus=result.get("n_consensus", 0),
                     warm=warm, seconds=result.get("seconds", {}),
@@ -1173,7 +1212,7 @@ class ConsensusService:
             try:
                 _io_retry("serve.preempt", _requeue, f"job {job_id} requeue")
             except JobFenced as f:
-                self._fenced(job_id, lane, str(f))
+                self._fenced(job_id, lane, str(f), token=token)
                 return
             with self._lock:
                 self.counters["preemptions"] += 1
@@ -1181,4 +1220,5 @@ class ConsensusService:
                 tr.event(
                     "job_preempted", job=job_id, lane=lane,
                     chunks_done=chunks_done, reason=reason, wall_s=wall,
+                    token=token,
                 )

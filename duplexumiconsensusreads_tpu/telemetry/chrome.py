@@ -16,6 +16,7 @@ unit the format requires.
 from __future__ import annotations
 
 import json
+import os
 
 # one synthetic process for the whole capture
 _PID = 1
@@ -120,3 +121,79 @@ def write_chrome(records, out_path: str) -> int:
     with open(out_path, "w") as f:
         json.dump(doc, f)
     return len(doc["traceEvents"])
+
+
+# ------------------------------------------------------------ fleet view
+
+def fleet_to_chrome(stitched: dict, run_captures=()) -> dict:
+    """Render stitched fleet timelines (telemetry/fleet.py) as Chrome
+    trace events: ONE LANE PER DAEMON whose slices are named by job id
+    (Perfetto colors slices by name hash, so each job keeps its color
+    as it hops lanes — a takeover or a shard fan-out is visible as the
+    same color resuming on another daemon's track), plus one lane per
+    job carrying its full admission→terminal decomposition (segments
+    AND attributed gaps). Per-job run captures, when provided
+    (``--trace`` jobs), add their per-chunk spans on a ``run:`` lane
+    aligned by their own ``epoch_m``."""
+    jobs = stitched["jobs"]
+    # one shared origin so Perfetto's clock starts near zero
+    t0s = []
+    for tl in jobs.values():
+        if tl["admission_us"] is not None:
+            t0s.append(tl["admission_us"])
+        t0s += [s["t0_us"] for s in tl["segments"]]
+    origin = min(t0s) if t0s else 0
+
+    lanes = sorted(stitched["daemons"])
+    job_lanes = [f"job {j}" for j in sorted(jobs)]
+    run_lanes = [f"run:{os.path.basename(c['path'])}" for c in run_captures]
+    tid = {}
+    events = [{
+        "name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
+        "args": {"name": "duplexumi fleet"},
+    }]
+    for i, lane in enumerate(
+        [f"daemon {d}" for d in lanes] + job_lanes + run_lanes
+    ):
+        tid[lane] = i + 1
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": _PID, "tid": i + 1,
+            "args": {"name": lane},
+        })
+        events.append({
+            "name": "thread_sort_index", "ph": "M", "pid": _PID,
+            "tid": i + 1, "args": {"sort_index": i + 1},
+        })
+
+    def _x(name, t0_us, t1_us, lane, cat, args):
+        events.append({
+            "name": name, "cat": cat, "ph": "X",
+            "ts": round((t0_us - origin), 3),
+            "dur": round((t1_us - t0_us), 3),
+            "pid": _PID, "tid": tid[lane], "args": args,
+        })
+
+    for job_id in sorted(jobs):
+        tl = jobs[job_id]
+        for s in tl["segments"]:
+            args = {k: v for k, v in s.items() if k not in ("t0_us", "t1_us")}
+            lane = f"daemon {s['daemon']}"
+            if lane in tid:
+                _x(job_id, s["t0_us"], s["t1_us"], lane, "segment", args)
+            _x(f"{s['kind']} ({s['daemon'][:12]})", s["t0_us"], s["t1_us"],
+               f"job {job_id}", "segment", args)
+        for g in tl["gaps"]:
+            _x(f"gap:{g['kind']}", g["t0_us"], g["t1_us"],
+               f"job {job_id}", "gap", {})
+    for cap in run_captures:
+        lane = f"run:{os.path.basename(cap['path'])}"
+        epoch = cap["epoch_us"] or 0
+        for rec in cap["records"]:
+            if not isinstance(rec, dict) or rec.get("type") != "span":
+                continue
+            t0 = epoch + round(float(rec.get("t", 0)) * 1e6)
+            t1 = t0 + round(float(rec.get("dur", 0)) * 1e6)
+            args = {k: v for k, v in rec.items()
+                    if k not in ("type", "stage", "t", "dur")}
+            _x(rec.get("stage", "?"), t0, t1, lane, "stage", args)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

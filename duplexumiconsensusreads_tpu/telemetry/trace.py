@@ -167,13 +167,17 @@ class TraceRecorder:
     """
 
     def __init__(
-        self, path: str, max_events: int = 1_000_000, kind: str = "run"
+        self, path: str, max_events: int = 1_000_000, kind: str = "run",
+        meta: dict | None = None,
     ):
         """``kind`` tags the capture's meta header: "run" (a streaming
         executor capture, the default) or "service" (a serve/ daemon
         capture — job-lifecycle events instead of per-chunk spans).
         Consumers (tools/check_trace.py) key their extra checks on it;
-        pre-kind captures read as "run"."""
+        pre-kind captures read as "run". ``meta`` adds extra attrs to
+        the meta header (the service stamps its ``daemon_id`` so a
+        capture names its writer — telemetry/fleet.py keys cross-daemon
+        stitching on it)."""
         if max_events < 1:
             raise ValueError(f"max_events must be >= 1 (got {max_events})")
         if kind not in ("run", "service"):
@@ -196,9 +200,28 @@ class TraceRecorder:
                 os.replace(path, path + ".prev")
         except OSError:
             pass
-        self._f = open(path, "w")
+        # service captures are LINE-buffered: a SIGKILLed daemon's
+        # capture is exactly the evidence the fleet stitcher
+        # (telemetry/fleet.py) post-mortems the takeover from, and at
+        # block buffering a short-lived daemon's whole capture can die
+        # in the 8KB userspace buffer (a real-SIGKILL drive produced a
+        # 0-byte file). Event rate is per job lifecycle, so the
+        # per-line write cost is noise. Run captures keep block
+        # buffering (per-chunk spans at scale) — their kill story is
+        # the in-process finally/close path, which flushes.
+        self._f = open(path, "w", buffering=1 if kind == "service" else -1)
+        # epoch_m: this recorder's epoch as a RAW machine-wide
+        # CLOCK_MONOTONIC reading. Record times stay epoch-relative
+        # (NTP-proof as documented above), but the epoch itself makes
+        # captures from N processes on one host alignable onto one
+        # timeline (epoch_m + t), which is what the fleet stitcher
+        # reconstructs cross-daemon job timelines from — the same
+        # one-host scope flock and the lease clock already impose on a
+        # spool.
         self._line({"type": "meta", "version": TRACE_VERSION,
-                    "kind": kind, "clock": "monotonic-relative"})
+                    "kind": kind, "clock": "monotonic-relative",
+                    "epoch_m": round(self._t0, 6), **(meta or {})})
+        self._f.flush()  # the header must survive any crash
 
     # ------------------------------------------------------- internals
 

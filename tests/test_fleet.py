@@ -1,0 +1,716 @@
+"""telemetry/fleet.py + tools/fleet_report.py: the fleet flight
+recorder.
+
+Two layers of pinning:
+
+  * synthetic captures (the deterministic 2-daemon scenario the
+    committed ``tests/data/fleet.fixture.*.trace.jsonl`` files hold —
+    a SIGKILL takeover mid-slice and a K=2 sharded parent) exercise
+    the stitcher's segment/gap/sum-check mechanics, the tamper exits,
+    the SLO gates, and the prom/Perfetto exports without touching jax;
+  * live drives (real 2-daemon in-process fleets running real consensus
+    jobs on this host) prove the chaos acceptance: a daemon SIGKILLed
+    mid-slice and a K=4 sharded parent both stitch to exactly-once
+    timelines with every admission→terminal sum-check green, straight
+    off the captures + journal the real protocol produced.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from duplexumiconsensusreads_tpu.io import simulated_bam
+from duplexumiconsensusreads_tpu.runtime import faults
+from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+from duplexumiconsensusreads_tpu.serve import ConsensusService, client
+from duplexumiconsensusreads_tpu.simulate import SimConfig
+from duplexumiconsensusreads_tpu.telemetry import chrome, fleet
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEET_REPORT = os.path.join(REPO, "tools", "fleet_report.py")
+
+CONFIG = dict(grouping="adjacency", mode="duplex", capacity=128, chunk_reads=90)
+GP = GroupingParams(strategy="adjacency", paired=True)
+CP = ConsensusParams(mode="duplex")
+
+
+@pytest.fixture(scope="module")
+def sim(tmp_path_factory):
+    """(input path, reference bytes) — same tiny workload as
+    tests/test_serve.py: ~7 chunks, room for takeovers to land."""
+    from duplexumiconsensusreads_tpu.serve.job import serve_provenance
+
+    d = tmp_path_factory.mktemp("fleetsim")
+    path = str(d / "in.bam")
+    cfg = SimConfig(n_molecules=70, n_positions=9, umi_error=0.02, seed=31)
+    simulated_bam(cfg, path=path, sort=True)
+    ref = str(d / "ref.bam")
+    stream_call_consensus(
+        path, ref, GP, CP, capacity=128, chunk_reads=90,
+        provenance_cl=serve_provenance(CONFIG),
+    )
+    with open(ref, "rb") as f:
+        return path, f.read()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.uninstall()
+
+
+# ------------------------------------------------- synthetic fixtures
+#
+# The generator below IS the committed tests/data fixture content — a
+# pin test regenerates and compares byte-for-byte, so the files the CI
+# gate (tools/ci_check.sh) stitches can never drift from what this
+# suite proved about them.
+
+def _ev(name, t, job, **attrs):
+    return {"type": "event", "name": name, "t": t,
+            "lane": f"job-{job}", "job": job, **attrs}
+
+
+def fixture_records():
+    """The canonical synthetic scenario: daemon fleet-a completes
+    job-aa, starts job-bb and dies holding its lease (capture ends
+    without a summary — the SIGKILL marker); daemon fleet-b takes
+    job-bb over and completes it, and runs a K=2 sharded parent
+    (split → two child runs → merge) end to end. Returns
+    (records_a, records_b)."""
+    a = [
+        {"type": "meta", "version": 1, "kind": "service",
+         "clock": "monotonic-relative", "epoch_m": 1000.0,
+         "daemon_id": "fleet-a"},
+        _ev("job_accepted", 0.1, "job-aa", priority=1, seq=0,
+            queue_depth=1),
+        _ev("job_accepted", 0.15, "job-bb", priority=0, seq=1,
+            queue_depth=2),
+        _ev("job_started", 0.2, "job-aa", slice=1, warm=False,
+            resumed=False, token=1),
+        _ev("job_completed", 1.2, "job-aa", wall_s=1.0, token=1,
+            n_chunks=3, n_consensus=5, warm=False, seconds={}),
+        _ev("job_started", 1.3, "job-bb", slice=1, warm=True,
+            resumed=False, token=1),
+        # no end event and no summary: fleet-a died here
+    ]
+    b = [
+        {"type": "meta", "version": 1, "kind": "service",
+         "clock": "monotonic-relative", "epoch_m": 1000.5,
+         "daemon_id": "fleet-b"},
+        _ev("job_accepted", 0.1, "job-pp", priority=1, seq=2,
+            queue_depth=1),
+        _ev("job_started", 0.3, "job-pp", slice=1, stage="split",
+            token=1),
+        _ev("job_split", 0.5, "job-pp", token=1, n_shards=2, n_chunks=6,
+            n_records=100, wall_s=0.2),
+        _ev("job_started", 0.7, "job-pp.s000", slice=1, warm=False,
+            resumed=False, token=1, parent="job-pp", shard_idx=0),
+        _ev("job_completed", 1.0, "job-pp.s000", wall_s=0.3, token=1,
+            n_chunks=3, n_consensus=2, warm=False, seconds={}),
+        _ev("job_started", 1.1, "job-pp.s001", slice=1, warm=True,
+            resumed=False, token=1, parent="job-pp", shard_idx=1),
+        _ev("job_completed", 1.4, "job-pp.s001", wall_s=0.3, token=1,
+            n_chunks=3, n_consensus=2, warm=True, seconds={}),
+        _ev("lease_takeover", 1.6, "job-bb", reason="dead-owner",
+            prev_owner="fleet-a", by="fleet-b"),
+        _ev("job_started", 1.7, "job-bb", slice=2, warm=True,
+            resumed=True, token=2),
+        _ev("job_completed", 2.7, "job-bb", wall_s=1.0, token=2,
+            n_chunks=3, n_consensus=5, warm=True, seconds={}),
+        _ev("job_started", 2.8, "job-pp", slice=2, stage="merge",
+            token=2),
+        _ev("job_merged", 3.2, "job-pp", token=2, n_shards=2,
+            merge_s=0.4, output_bytes=1234),
+        _ev("job_completed", 3.25, "job-pp", wall_s=0.45, token=2,
+            n_chunks=6, n_consensus=4, warm=False, seconds={}),
+        {"type": "event", "name": "heartbeat", "t": 3.3, "lane": "main",
+         "queue_depth": 0, "jobs_inflight": 0},
+    ]
+    b.append({"type": "summary", "t": 3.4, "n_events": len(b) - 1,
+              "n_dropped": 0, "counters": {"jobs_done": 4}})
+    return a, b
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r, separators=(",", ":")) + "\n")
+
+
+def _fixture_paths(tmp_path):
+    a, b = fixture_records()
+    pa = str(tmp_path / "service.fleet-a.trace.jsonl")
+    pb = str(tmp_path / "service.fleet-b.trace.jsonl")
+    _write_jsonl(pa, a)
+    _write_jsonl(pb, b)
+    return pa, pb
+
+
+def test_committed_fixtures_pin_the_generator():
+    """The CI gate stitches tests/data/fleet.fixture.*.trace.jsonl;
+    those files must be exactly what :func:`fixture_records` produces
+    (and what this suite proves green/tamper-red below)."""
+    for name, recs in zip(("a", "b"), fixture_records()):
+        path = os.path.join(REPO, "tests", "data",
+                            f"fleet.fixture.{name}.trace.jsonl")
+        want = "".join(
+            json.dumps(r, separators=(",", ":")) + "\n" for r in recs
+        )
+        with open(path) as f:
+            assert f.read() == want, f"{path} drifted from the generator"
+
+
+# ---------------------------------------------------- stitcher (unit)
+
+class TestStitch:
+    def stitched(self, tmp_path):
+        pa, pb = _fixture_paths(tmp_path)
+        caps = fleet.load_captures([pa, pb])
+        assert caps["problems"] == []
+        return fleet.stitch(caps)
+
+    def test_takeover_timeline_exact_sum_check(self, tmp_path):
+        st = self.stitched(tmp_path)
+        assert st["ok"], st["problems"]
+        bb = st["jobs"]["job-bb"]
+        assert bb["state"] == "done" and bb["sum_check_ok"]
+        kinds = [(s["kind"], s["daemon"], s["end"]) for s in bb["segments"]]
+        assert kinds == [("run", "fleet-a", "takeover"),
+                         ("run", "fleet-b", "completed")]
+        gaps = [g["kind"] for g in bb["gaps"]]
+        assert gaps == ["queue_wait", "takeover"]
+        # exactness: microsecond-integer tiling of admission→terminal
+        total = sum(s["t1_us"] - s["t0_us"] for s in bb["segments"])
+        total += sum(g["t1_us"] - g["t0_us"] for g in bb["gaps"])
+        assert total == bb["wall_us"] == bb["terminal_us"] - bb["admission_us"]
+
+    def test_sharded_parent_split_fanned_merge(self, tmp_path):
+        st = self.stitched(tmp_path)
+        pp = st["jobs"]["job-pp"]
+        assert pp["sum_check_ok"]
+        assert [s["kind"] for s in pp["segments"]] == ["split", "merge"]
+        assert [g["kind"] for g in pp["gaps"]] == ["queue_wait", "fanned"]
+        # children stitched exactly once, attributed to their daemon
+        for cid in ("job-pp.s000", "job-pp.s001"):
+            c = st["jobs"][cid]
+            assert c["state"] == "done"
+            assert len(c["segments"]) == 1
+            assert c["segments"][0]["daemon"] == "fleet-b"
+
+    def test_unclean_capture_is_lenient_one_sided(self, tmp_path):
+        # fleet-a died: its open job-bb slice is closed at the reclaim
+        # with a warning, never a problem — the one-sided policy
+        st = self.stitched(tmp_path)
+        assert st["ok"] and st["problems"] == []
+
+    def test_dropped_start_in_clean_capture_is_drift(self, tmp_path):
+        a, b = fixture_records()
+        b2 = [r for r in b
+              if not (r.get("name") == "job_started"
+                      and r.get("job") == "job-pp.s001")]
+        b2[-1]["n_events"] -= 1  # a "smart" tamper fixes the count too
+        pa = str(tmp_path / "sa.trace.jsonl")
+        pb = str(tmp_path / "sb.trace.jsonl")
+        _write_jsonl(pa, a)
+        _write_jsonl(pb, b2)
+        st = fleet.stitch(fleet.load_captures([pa, pb]))
+        assert not st["ok"]
+        assert any("no matching job_started" in p for p in st["problems"])
+
+    def test_dropped_end_in_clean_capture_is_drift(self, tmp_path):
+        a, b = fixture_records()
+        b2 = [r for r in b
+              if not (r.get("name") == "job_completed"
+                      and r.get("job") == "job-pp.s000")]
+        b2[-1]["n_events"] -= 1
+        pa = str(tmp_path / "sa.trace.jsonl")
+        pb = str(tmp_path / "sb.trace.jsonl")
+        _write_jsonl(pa, a)
+        _write_jsonl(pb, b2)
+        st = fleet.stitch(fleet.load_captures([pa, pb]))
+        assert not st["ok"]
+        assert any("never closed in a clean capture" in p
+                   for p in st["problems"])
+
+    def test_duplicate_terminal_is_drift(self, tmp_path):
+        a, b = fixture_records()
+        dup = _ev("job_completed", 1.25, "job-aa", wall_s=1.0, token=1)
+        a2 = a + [dup]
+        pa = str(tmp_path / "sa.trace.jsonl")
+        pb = str(tmp_path / "sb.trace.jsonl")
+        _write_jsonl(pa, a2)
+        _write_jsonl(pb, b)
+        st = fleet.stitch(fleet.load_captures([pa, pb]))
+        assert any("duplicate terminal" in p for p in st["problems"])
+
+    def test_multi_capture_stitch_requires_epoch(self, tmp_path):
+        a, b = fixture_records()
+        del a[0]["epoch_m"]
+        pa = str(tmp_path / "sa.trace.jsonl")
+        pb = str(tmp_path / "sb.trace.jsonl")
+        _write_jsonl(pa, a)
+        _write_jsonl(pb, b)
+        caps = fleet.load_captures([pa, pb])
+        assert any("epoch_m" in p for p in caps["problems"])
+
+    def test_restarted_daemon_prev_capture_is_history_not_duplicate(
+        self, tmp_path
+    ):
+        # a restart rotates service.<id>.trace.jsonl to .prev: same
+        # daemon_id, DIFFERENT recorder epoch. That is legitimate fleet
+        # history the spool discovery deliberately feeds the stitcher —
+        # it must stitch green, never exit 1 as a "duplicate capture"
+        a, b = fixture_records()
+        a2 = [
+            {"type": "meta", "version": 1, "kind": "service",
+             "clock": "monotonic-relative", "epoch_m": 1005.0,
+             "daemon_id": "fleet-a"},
+            _ev("job_accepted", 0.1, "job-cc", priority=1, seq=3,
+                queue_depth=1),
+            _ev("job_started", 0.2, "job-cc", slice=1, warm=False,
+                resumed=False, token=1),
+            _ev("job_completed", 0.9, "job-cc", wall_s=0.7, token=1,
+                n_chunks=3, n_consensus=5, warm=False, seconds={}),
+        ]
+        a2.append({"type": "summary", "t": 1.0, "n_events": len(a2) - 1,
+                   "n_dropped": 0})
+        live = str(tmp_path / "service.fleet-a.trace.jsonl")
+        prev = str(tmp_path / "service.fleet-a.trace.jsonl.prev")
+        pb = str(tmp_path / "service.fleet-b.trace.jsonl")
+        _write_jsonl(prev, a)   # first life: died holding job-bb
+        _write_jsonl(live, a2)  # second life: clean
+        _write_jsonl(pb, b)
+        caps = fleet.load_captures(
+            fleet.discover_service_captures(str(tmp_path))
+        )
+        assert caps["problems"] == []
+        st = fleet.stitch(caps)
+        assert st["ok"], st["problems"]
+        assert st["jobs"]["job-cc"]["state"] == "done"
+        assert st["jobs"]["job-bb"]["sum_check_ok"] is True
+        # one balance row for fleet-a; its unclean first life marks it
+        assert st["daemons"]["fleet-a"]["clean"] is False
+
+    def test_same_recorder_life_passed_twice_is_duplicate(self, tmp_path):
+        a, b = fixture_records()
+        pa = str(tmp_path / "sa.trace.jsonl")
+        pa2 = str(tmp_path / "sa-copy.trace.jsonl")
+        pb = str(tmp_path / "sb.trace.jsonl")
+        _write_jsonl(pa, a)
+        _write_jsonl(pa2, a)  # identical copy: same daemon_id AND epoch
+        _write_jsonl(pb, b)
+        caps = fleet.load_captures([pa, pa2, pb])
+        assert any("duplicate capture" in p for p in caps["problems"])
+
+    def test_seg_and_gap_constructors_refuse_unknown_kinds(self):
+        with pytest.raises(ValueError, match="segment kind"):
+            fleet.seg_rec("warp", 0, 1, "d")
+        with pytest.raises(ValueError, match="gap kind"):
+            fleet.gap_rec("warp", 0, 1)
+
+    def test_journal_slice_count_cross_check(self, tmp_path):
+        # clean captures + a journal claiming more slices than captured
+        # job_started events = a missing/tampered capture
+        a, b = fixture_records()
+        a.append({"type": "summary", "t": 1.4,
+                  "n_events": len(a) - 1, "n_dropped": 0})
+        # drop job-bb from a so its story is clean-but-partial
+        a = [r for r in a if r.get("job") != "job-bb"]
+        a[-1]["n_events"] -= 2
+        pa = str(tmp_path / "sa.trace.jsonl")
+        pb = str(tmp_path / "sb.trace.jsonl")
+        _write_jsonl(pa, a)
+        _write_jsonl(pb, b)
+        journal = {"job-aa": {"state": "done", "slices": 3, "priority": 1}}
+        st = fleet.stitch(fleet.load_captures([pa, pb]), journal=journal)
+        assert any("journal says 3 slices" in p for p in st["problems"])
+
+
+# ------------------------------------------------- metrics / SLO / prom
+
+class TestFleetMetrics:
+    def metrics(self, tmp_path):
+        pa, pb = _fixture_paths(tmp_path)
+        st = fleet.stitch(fleet.load_captures([pa, pb]))
+        return fleet.fleet_metrics(st)
+
+    def test_metric_surface_is_exactly_the_registry(self, tmp_path):
+        m = self.metrics(tmp_path)
+        extra = {"classes", "daemons", "sum_check_ok", "n_problems"}
+        assert set(m) == set(fleet.FLEET_METRIC_KEYS) | extra
+
+    def test_totals_and_percentiles(self, tmp_path):
+        m = self.metrics(tmp_path)
+        assert m["fleet_jobs"] == 5 and m["fleet_done"] == 5
+        assert m["fleet_takeovers"] == 1
+        assert m["takeover_gap_max_s"] == pytest.approx(0.1)
+        assert m["e2e_p95_s"] > m["e2e_p50_s"] > 0
+        # class tables: job-bb is priority 0, the rest priority 1
+        assert set(m["classes"]) == {"0", "1"}
+        # daemon balance: both daemons ran slices; fleet-a is unclean
+        assert m["daemons"]["fleet-a"]["clean"] is False
+        assert m["daemons"]["fleet-b"]["n_slices"] == 5
+
+    def test_slo_gates_fail_and_pass(self, tmp_path):
+        m = self.metrics(tmp_path)
+        rows, ok = fleet.check_slo(m, {"e2e_p95_s": {"max": 0.01}})
+        assert not ok and rows[0]["verdict"] == "fail"
+        rows, ok = fleet.check_slo(m, {
+            "e2e_p95_s": {"max": 60.0},
+            "queue_wait_p95_s": {"max": 60.0, "class": "1"},
+        })
+        assert ok and all(r["verdict"] == "pass" for r in rows)
+
+    def test_slo_unknown_metric_fails_no_data_skips(self, tmp_path):
+        m = self.metrics(tmp_path)
+        rows, ok = fleet.check_slo(m, {"not_a_metric": {"max": 1.0}})
+        assert not ok and rows[0]["verdict"] == "error"
+        rows, ok = fleet.check_slo(m, {"deadline_hit_rate": {"min": 0.9}})
+        assert ok and rows[0]["verdict"] == "skipped"
+
+    def test_prom_exposition(self, tmp_path):
+        text = fleet.render_prom(self.metrics(tmp_path))
+        assert "dut_fleet_fleet_done 5" in text
+        assert 'dut_fleet_daemon_n_slices{daemon="fleet-b"} 5' in text
+        assert 'class="0"' in text
+        # absent metrics are omitted, never zeroed
+        assert "ttfc_p95_s" not in text
+
+    def test_ttfc_merged_from_raw_samples(self, tmp_path):
+        pa, pb = _fixture_paths(tmp_path)
+        st = fleet.stitch(fleet.load_captures([pa, pb]))
+        docs = [
+            {"daemon_id": "fleet-a",
+             "class_latency_samples": {"1": {"ttfc": [0.5, 0.7]}}},
+            {"daemon_id": "fleet-b",
+             "class_latency_samples": {"1": {"ttfc": [0.9]}}},
+        ]
+        m = fleet.fleet_metrics(st, metrics_docs=docs)
+        assert m["ttfc_p50_s"] == pytest.approx(0.7)
+        assert m["classes"]["1"]["n_ttfc"] == 3
+
+    def test_chrome_fleet_lanes(self, tmp_path):
+        pa, pb = _fixture_paths(tmp_path)
+        st = fleet.stitch(fleet.load_captures([pa, pb]))
+        doc = chrome.fleet_to_chrome(st)
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert "daemon fleet-a" in names and "daemon fleet-b" in names
+        assert "job job-bb" in names
+        # the takeover reads as the same job name on two daemon lanes
+        lanes_of_bb = set()
+        tid_to_name = {
+            e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "thread_name"
+        }
+        for e in doc["traceEvents"]:
+            if e.get("ph") == "X" and e["name"] == "job-bb":
+                lanes_of_bb.add(tid_to_name[e["tid"]])
+        assert lanes_of_bb == {"daemon fleet-a", "daemon fleet-b"}
+        # gaps render on the job's own lane
+        assert any(
+            e.get("ph") == "X" and e["name"] == "gap:takeover"
+            for e in doc["traceEvents"]
+        )
+
+
+# ----------------------------------------------------------- CLI shell
+
+class TestFleetReportCli:
+    def test_exit_0_and_json_over_fixture_captures(self, tmp_path):
+        pa, pb = _fixture_paths(tmp_path)
+        p = subprocess.run(
+            [sys.executable, FLEET_REPORT, pa, pb, "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0, p.stderr
+        doc = json.loads(p.stdout)
+        assert doc["ok"] and doc["metrics"]["fleet_done"] == 5
+        assert doc["jobs"]["job-bb"]["sum_check_ok"] is True
+
+    def test_tampered_capture_exits_1(self, tmp_path):
+        a, b = fixture_records()
+        b = [r for r in b
+             if not (r.get("name") == "job_started"
+                     and r.get("job") == "job-pp.s001")]
+        b[-1]["n_events"] -= 1
+        pa = str(tmp_path / "sa.trace.jsonl")
+        pb = str(tmp_path / "sb.trace.jsonl")
+        _write_jsonl(pa, a)
+        _write_jsonl(pb, b)
+        p = subprocess.run(
+            [sys.executable, FLEET_REPORT, pa, pb],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 1
+        assert "FLEET TIMELINE DRIFT" in p.stderr
+
+    def test_check_slo_exits_both_directions(self, tmp_path):
+        pa, pb = _fixture_paths(tmp_path)
+        tight = tmp_path / "tight.toml"
+        tight.write_text('[e2e_p95_s]\nmax = 0.01\n')
+        loose = tmp_path / "loose.toml"
+        loose.write_text('[e2e_p95_s]\nmax = 60.0\n')
+        for slo, rc in ((tight, 1), (loose, 0)):
+            p = subprocess.run(
+                [sys.executable, FLEET_REPORT, pa, pb,
+                 "--slo", str(slo), "--check-slo"],
+                capture_output=True, text=True, timeout=120,
+            )
+            assert p.returncode == rc, (slo, p.stdout, p.stderr)
+
+
+# -------------------------------------------------------- live drives
+
+def _drain_fleet(spool, traces, n_daemons=2, **kw):
+    """Run ``n_daemons`` concurrent services until the spool is idle;
+    returns the services."""
+    svcs = [
+        ConsensusService(
+            spool, chunk_budget=2, poll_s=0.02, trace_path=traces[i],
+            daemon_id=f"live-{i}", **kw,
+        )
+        for i in range(n_daemons)
+    ]
+    threads = [
+        threading.Thread(target=s.run_until_idle, daemon=True)
+        for s in svcs
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    assert not any(t.is_alive() for t in threads)
+    return svcs
+
+
+def _stitch_spool(spool):
+    caps = fleet.load_captures(fleet.discover_service_captures(spool))
+    journal = fleet.load_journal(os.path.join(spool, "queue.json"))
+    return fleet.stitch(caps, journal=journal)
+
+
+class TestFleetLive:
+    """The acceptance drives: real jobs, real protocol, stitched."""
+
+    def test_sigkill_takeover_stitches_exactly_once(self, sim, tmp_path):
+        """Daemon A dies mid-slice (InjectedKill — the modelled
+        SIGKILL, lease still journaled, capture left summary-less the
+        way a real kill leaves it); daemon B takes the job over and
+        finishes everything. The stitched timelines must show the
+        victim's slice closed at the reclaim, an attributed takeover
+        gap, exactly one terminal per job, and every sum-check green —
+        and fleet_report over the spool must exit 0."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        jobs = []
+        for i in range(3):
+            out = str(tmp_path / f"out{i}.bam")
+            jobs.append((client.submit(spool, in_path, out,
+                                       config=dict(CONFIG)), out))
+        victim = ConsensusService(
+            spool, chunk_budget=0, poll_s=0.02, lease_s=5.0,
+            daemon_id="live-victim",
+            trace_path=os.path.join(spool, "service.live-victim.trace.jsonl"),
+        )
+        orig = victim.worker.run_slice
+
+        def dying_run_slice(spec, budget, should_yield, drain_event,
+                            lease=None):
+            def die():
+                raise faults.InjectedKill("fleet test: victim killed")
+
+            return orig(spec, 1, die, drain_event, lease=lease)
+
+        victim.worker.run_slice = dying_run_slice
+
+        def run_victim():
+            # run() re-raises the InjectedKill; the daemon is dead
+            # either way — exactly what the stitcher must cope with
+            try:
+                victim.run_until_idle()
+            except faults.InjectedKill:
+                pass
+
+        vt = threading.Thread(target=run_victim, daemon=True)
+        vt.start()
+        vt.join(timeout=600)
+        assert not vt.is_alive()
+        survivor = ConsensusService(
+            spool, chunk_budget=0, poll_s=0.02, lease_s=5.0,
+            daemon_id="live-B",
+            trace_path=os.path.join(spool, "service.live-B.trace.jsonl"),
+        )
+        survivor.run_until_idle()
+        for jid, out in jobs:
+            assert client.status(spool, jid)["state"] == "done"
+            with open(out, "rb") as f:
+                assert f.read() == ref_bytes
+
+        st = _stitch_spool(spool)
+        assert st["ok"], st["problems"]
+        timelines = st["jobs"]
+        assert len(timelines) == 3
+        n_takeover_segs = 0
+        for jid, _ in jobs:
+            tl = timelines[jid]
+            assert tl["state"] == "done"
+            assert tl["sum_check_ok"] is True
+            ends = [s["end"] for s in tl["segments"]]
+            assert ends.count("completed") == 1  # exactly-once terminal
+            n_takeover_segs += ends.count("takeover")
+        assert n_takeover_segs == 1  # the victim held exactly one lease
+        # the takeover gap is attributed and the metrics see it
+        m = fleet.fleet_metrics(
+            st, metrics_docs=fleet.load_metrics_docs(spool)
+        )
+        assert m["fleet_takeovers"] == 1
+        assert m["takeover_gap_max_s"] is not None
+        assert m["fleet_done"] == 3 and m["e2e_p95_s"] > 0
+        # the CLI agrees, writes the durable artifact, exits 0
+        p = subprocess.run(
+            [sys.executable, FLEET_REPORT, spool],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert os.path.exists(os.path.join(spool, "fleet_metrics.json"))
+
+    def test_sharded_parent_k4_stitches_exactly_once(self, sim, tmp_path):
+        """A K=4 sharded parent through a 2-daemon fleet: the stitched
+        parent timeline decomposes into split → fanned → merge, every
+        child runs exactly once somewhere, and all sum-checks are
+        green against the real journal."""
+        in_path, ref_bytes = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "sharded.bam")
+        parent = client.submit(spool, in_path, out, config=dict(CONFIG),
+                               shards=4)
+        traces = [
+            os.path.join(spool, f"service.live-{i}.trace.jsonl")
+            for i in (0, 1)
+        ]
+        _drain_fleet(spool, traces)
+        assert client.status(spool, parent)["state"] == "done"
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+
+        st = _stitch_spool(spool)
+        assert st["ok"], st["problems"]
+        tl = st["jobs"][parent]
+        assert tl["state"] == "done" and tl["sum_check_ok"] is True
+        kinds = [s["kind"] for s in tl["segments"]]
+        assert kinds[0] == "split" and kinds[-1] == "merge"
+        assert "fanned" in [g["kind"] for g in tl["gaps"]]
+        children = [j for j in st["jobs"] if j.startswith(parent + ".s")]
+        assert len(children) == 4
+        for cid in children:
+            c = st["jobs"][cid]
+            assert c["state"] == "done"
+            assert c["sum_check_ok"] is True  # journal admitted_m anchors
+            assert [s["end"] for s in c["segments"]].count("completed") == 1
+        m = fleet.fleet_metrics(st)
+        assert m["fleet_splits"] == 1 and m["fleet_merges"] == 1
+
+
+# ------------------------------------------------- satellite contracts
+
+class TestStatusJson:
+    """`call --status/--wait --json`: the machine-readable status
+    document (satellite: external monitors stop scraping stderr)."""
+
+    def test_status_json_document(self, sim, tmp_path):
+        in_path, _ = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "out.bam")
+        jid = client.submit(spool, in_path, out, config=dict(CONFIG))
+        ConsensusService(spool, chunk_budget=0).run_until_idle()
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        p = subprocess.run(
+            [sys.executable, "-m", "duplexumiconsensusreads_tpu", "call",
+             "--status", jid, "--spool", spool, "--json"],
+            capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+        )
+        assert p.returncode == 0, p.stderr
+        assert p.stderr == ""  # machine mode: stdout only
+        doc = json.loads(p.stdout)
+        assert doc["state"] == "done" and doc["job_id"] == jid
+        assert "timestamps" in doc and "reason" in doc
+        assert doc["timestamps"]["admitted_age_s"] >= 0
+
+    def test_wait_json_on_unknown_job_exits_1(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        os.makedirs(os.path.join(spool, "inbox"), exist_ok=True)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        p = subprocess.run(
+            [sys.executable, "-m", "duplexumiconsensusreads_tpu", "call",
+             "--wait", "job-nope", "--spool", spool, "--json"],
+            capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+        )
+        assert p.returncode == 1
+        doc = json.loads(p.stdout)
+        assert doc["state"] == "unknown" and p.stderr == ""
+
+    def test_json_refused_off_the_client_verbs(self, tmp_path):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        p = subprocess.run(
+            [sys.executable, "-m", "duplexumiconsensusreads_tpu", "call",
+             "in.bam", "-o", "out.bam", "--json"],
+            capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+        )
+        assert p.returncode != 0
+        assert "--json applies to --status/--wait" in p.stderr
+
+    def test_shard_rollup_rides_the_document(self):
+        doc = client.status_document({
+            "job_id": "job-p", "state": "fanned",
+            "shards": {"n_shards": 4, "done": 2, "running": 1,
+                       "queued": 1, "failed": 0},
+            "admitted_m": time.monotonic() - 5.0,
+            "deadline_m": time.monotonic() + 30.0,
+        })
+        assert doc["shards"]["done"] == 2
+        assert doc["timestamps"]["admitted_age_s"] == pytest.approx(5.0, abs=1.0)
+        assert doc["timestamps"]["deadline_in_s"] == pytest.approx(30.0, abs=1.0)
+
+
+class TestHeartbeatIdentity:
+    """Satellite: the live heartbeat line + metrics.json carry the
+    daemon's short id and the tuner verdict hit rate."""
+
+    def test_stats_carry_daemon_and_verdict_hit_rate(self, tmp_path):
+        svc = ConsensusService(str(tmp_path / "spool"),
+                               daemon_id="beat-me-12345678")
+        snap = svc.stats()
+        assert snap["daemon"] == "beat-me-1234"  # short form
+        assert snap["verdict_hit_rate"] == 0.0
+        svc.worker.n_verdict_hits = 3
+        svc.worker.n_verdict_puts = 1
+        assert svc.stats()["verdict_hit_rate"] == 0.75
+
+    def test_per_daemon_metrics_file_with_samples(self, sim, tmp_path):
+        in_path, _ = sim
+        spool = str(tmp_path / "spool")
+        out = str(tmp_path / "out.bam")
+        client.submit(spool, in_path, out, config=dict(CONFIG))
+        svc = ConsensusService(spool, chunk_budget=0, daemon_id="metrics-d")
+        svc.run_until_idle()
+        mine = os.path.join(spool, "metrics", "metrics-d.json")
+        with open(mine) as f:
+            doc = json.load(f)
+        assert doc["daemon_id"] == "metrics-d"
+        assert doc["daemon"] == "metrics-d"[:12]
+        assert "verdict_hit_rate" in doc
+        samples = doc["class_latency_samples"]
+        assert samples["1"]["queue_wait"] and samples["1"]["ttfc"]
+        # the merged fleet view reads these docs
+        docs = fleet.load_metrics_docs(spool)
+        assert any(d.get("daemon_id") == "metrics-d" for d in docs)

@@ -362,6 +362,16 @@ TRACE_XFER_ATTRS = """
     KNOWN_XFER_DIRS = ("h2d", "d2h", "shard")
     KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap")
     """
+FLEET_OK = """
+    FLEET_SEGMENT_KINDS = ("run", "split")
+    FLEET_GAP_KINDS = ("queue_wait", "takeover")
+
+    def stitch():
+        kind = "run" if True else "split"
+        pending = "queue_wait"
+        pending = "takeover"
+        return kind, pending
+    """
 
 
 class TestPhaseRegistry:
@@ -499,6 +509,59 @@ class TestPhaseRegistry:
             """,
         })
         assert legacy.ok
+
+    def test_fires_on_unregistered_fleet_kind(self):
+        res = self.base(**{
+            "pkg/telemetry/fleet.py": FLEET_OK,
+            "pkg/telemetry/other.py": """
+            from pkg.telemetry.fleet import gap_rec, seg_rec
+
+            def build():
+                return [seg_rec("warp", 0, 1, "d"),
+                        gap_rec("limbo", 0, 1)]
+            """,
+        })
+        msgs = " | ".join(f.message for f in res.findings)
+        assert "warp" in msgs and "limbo" in msgs
+
+    def test_passes_on_registered_fleet_kinds_and_pre_fleet_corpora(self):
+        ok = self.base(**{
+            "pkg/telemetry/fleet.py": FLEET_OK,
+            "pkg/telemetry/other.py": """
+            from pkg.telemetry.fleet import gap_rec, seg_rec
+
+            def build():
+                return [seg_rec("run", 0, 1, "d"),
+                        gap_rec("takeover", 0, 1)]
+            """,
+        })
+        assert ok.ok
+        # no fleet.py at all (pre-fleet trees): literal kinds unpinnable
+        legacy = self.base(**{
+            "pkg/telemetry/other.py": """
+            def build(gap_rec):
+                return gap_rec("anything", 0, 1)
+            """,
+        })
+        assert legacy.ok
+
+    def test_fires_on_dead_fleet_registry_entry(self):
+        res = self.base(**{
+            "pkg/telemetry/fleet.py": """
+            FLEET_SEGMENT_KINDS = ("run",)
+            FLEET_GAP_KINDS = ("queue_wait", "never_emitted")
+
+            def stitch():
+                kind = "run"
+                pending = "queue_wait"
+                return kind, pending
+            """,
+        })
+        msgs = " | ".join(f.message for f in res.findings)
+        assert "never_emitted" in msgs and "never produces" in msgs
+        # the registry tuple's own literal does not count as use, but
+        # honest use anywhere else in fleet.py does
+        assert "queue_wait" not in msgs.replace("'never_emitted'", "")
 
 
 class TestLockDiscipline:
@@ -1601,6 +1664,9 @@ class TestShippedTree:
             # the byte-ledger / bench-trajectory tools carry the same
             # schema obligations as the trace tools they sit beside
             "tools/wirestat.py", "tools/bench_history.py",
+            # the fleet flight recorder: its CLI carries the same
+            # schema/sum-check obligations as wirestat/trace_report
+            "tools/fleet_report.py",
             # the profiling/tuning tools carry the same clock +
             # durability obligations as the report tools; anchoring
             # them here means clock/durability drift in any tool is

@@ -977,10 +977,15 @@ class TestFleet:
         assert st["state"] == "done" and st["token"] == 2
         with open(out, "rb") as f:
             assert f.read() == ref_bytes
-        # B's capture (A's rotated to .prev) holds the takeover and the
-        # single completion
-        recs, ev = _events(os.path.join(spool, "service.trace.jsonl"))
+        # each daemon owns service.<daemon_id>.trace.jsonl (per-daemon
+        # default since the fleet flight recorder — members must not
+        # rotate each other's live captures); B's holds the takeover
+        # and the single completion
+        b_trace = os.path.join(spool, "service.sub-B.trace.jsonl")
+        recs, ev = _events(b_trace)
         assert trace_report.validate_service_trace(recs) == []
+        # the capture names its writer — the stitcher's correlation key
+        assert recs[0]["daemon_id"] == "sub-B" and "epoch_m" in recs[0]
         assert len([e for e in ev if e["name"] == "job_completed"]) == 1
         tk = [e for e in ev if e["name"] == "lease_takeover"]
         assert len(tk) == 1 and tk[0]["reason"] == "dead-owner"
@@ -988,7 +993,7 @@ class TestFleet:
         # and serve_report surfaces the takeover (not just the raw event)
         p3 = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "serve_report.py"),
-             os.path.join(spool, "service.trace.jsonl"), "--json"],
+             b_trace, "--json"],
             capture_output=True, text=True, timeout=120,
         )
         assert p3.returncode == 0, p3.stderr
@@ -1339,7 +1344,9 @@ class TestWatchdog:
         assert st["crash_count"] >= 1
         with open(out, "rb") as f:
             assert f.read() == ref_bytes
-        recs, ev = _events(os.path.join(spool, "service.trace.jsonl"))
+        recs, ev = _events(
+            os.path.join(spool, "service.stop-B.trace.jsonl")
+        )
         assert trace_report.validate_service_trace(recs) == []
         wd = [e for e in ev if e["name"] == "watchdog_fired"]
         assert len(wd) >= 1 and wd[0]["job"] == jid

@@ -3,7 +3,7 @@
 #
 #   sh tools/ci_check.sh
 #
-# Two legs, both exit-1 on violation:
+# Three legs, all exit-1 on violation:
 #
 #   1. dutlint --strict over the whole default set (package + tools/ +
 #      test anchors): every invariant rule active, zero non-allowlisted
@@ -15,6 +15,12 @@
 #      so a schema change that would reject healthy runs (or a
 #      validator regression that accepts torn ones) fails here, not in
 #      production triage.
+#   3. fleet_report over the committed 2-daemon fixture captures
+#      (tests/data/fleet.fixture.{a,b}.trace.jsonl — a SIGKILL
+#      takeover + a sharded parent): the cross-daemon stitcher must
+#      reconstruct every timeline with the admission→terminal
+#      sum-check green, so a stitching/schema regression fails at
+#      commit time, not when a production fleet needs post-morteming.
 #
 # tests/test_lint.py runs this script as a tier-1 test, so the gate
 # cannot rot out of CI.
@@ -30,5 +36,10 @@ echo "[ci_check] dutlint --strict (all rules, stale-allowlist fatal)" >&2
 echo "[ci_check] check_trace --require-summary (fixture capture)" >&2
 "$py" "$root/tools/check_trace.py" \
     "$root/tests/data/run.fixture.trace.jsonl" --require-summary
+
+echo "[ci_check] fleet_report (2-daemon fixture captures, sum-check)" >&2
+"$py" "$root/tools/fleet_report.py" \
+    "$root/tests/data/fleet.fixture.a.trace.jsonl" \
+    "$root/tests/data/fleet.fixture.b.trace.jsonl" >/dev/null
 
 echo "[ci_check] OK" >&2
