@@ -295,6 +295,12 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
     h2d_attrs, _ = str_tuple_assign(
         corpus.trees[trace_path], "KNOWN_H2D_XFER_ATTRS"
     )
+    # literal-lane registry (mesh execution's dev-N device lanes, the
+    # service's job-<id> lanes; absent in pre-mesh corpora, where the
+    # lane check simply skips)
+    lane_prefixes, _ = str_tuple_assign(
+        corpus.trees[trace_path], "KNOWN_LANE_PREFIXES"
+    )
     # fleet timeline registries (telemetry/fleet.py): segment/gap kinds
     # the cross-daemon stitcher constructs and the SLO/prom surfaces key
     # on — absent in pre-fleet corpora, where the checks simply skip
@@ -439,6 +445,36 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
                     "FLEET_GAP_KINDS (and ARCHITECTURE.md's fleet "
                     "observability schema)",
                 )
+            if name in ("span", "event", "emit_event", "xfer") and lane_prefixes:
+                # literal lane families must be registered: a typo'd
+                # lane= ("gpu-0", f"chip{i}") silently forks the
+                # grouping key wirestat's device table, the fleet
+                # stitcher and the chrome export all key on. Dynamic
+                # lanes (current_lane(), a variable) are thread-derived
+                # and stay out of scope; an f-string is checked by its
+                # leading literal, so a placeholder-first lane is
+                # unpinnable and flagged too.
+                for kw in node.keywords or ():
+                    if kw.arg != "lane":
+                        continue
+                    head = _lane_head(kw.value)
+                    if head is None:
+                        continue
+                    ok = head == "main" or any(
+                        p.endswith("-") and head.startswith(p)
+                        for p in lane_prefixes
+                    )
+                    if not ok:
+                        yield Finding(
+                            rule="phase-registry",
+                            path=path,
+                            line=node.lineno,
+                            message=f"literal lane {head!r}... is not "
+                            f"registered",
+                            hint="lane literals must be 'main' or start "
+                            "with a telemetry.trace.KNOWN_LANE_PREFIXES "
+                            "entry (dev-/job-/...)",
+                        )
             if name == "xfer" and lit == "h2d" and h2d_attrs:
                 # h2d records carry the packing/fill audit attrs; an
                 # unregistered keyword is a silent schema fork — the
@@ -519,6 +555,26 @@ def check_phase_registry(corpus: Corpus) -> Iterator[Finding]:
                     hint="extend test_streaming_seconds_keys_golden in the "
                     "same change that adds the stage",
                 )
+
+
+def _lane_head(v) -> str | None:
+    """Leading literal of a ``lane=`` argument: the full string for a
+    plain literal, the pre-placeholder prefix for an f-string (""
+    when the f-string STARTS with a placeholder — an unpinnable lane
+    family, flagged), None for dynamic expressions (thread-derived
+    lanes like ``current_lane()`` or a variable — out of scope)."""
+    lit = str_const(v)
+    if lit is not None:
+        return lit
+    if isinstance(v, ast.JoinedStr):
+        if (
+            v.values
+            and isinstance(v.values[0], ast.Constant)
+            and isinstance(v.values[0].value, str)
+        ):
+            return v.values[0].value
+        return ""
+    return None
 
 
 def _phase_dict_keys(tree: ast.Module) -> tuple[set[str] | None, int]:

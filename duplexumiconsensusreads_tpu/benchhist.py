@@ -79,6 +79,12 @@ CANONICAL_METRICS = (
     # never gated
     ("serve_shard_speedup", True, False),
     ("serve_shard_merge_s", False, False),
+    # mesh-sharded execution (real multi-device consensus): the e2e
+    # leg's resolved device count and the K-vs-1 wall ratio of the
+    # mesh-scaling A/B — informational, never gated (simulated CPU
+    # devices share the host's cores; judge scaling on real silicon)
+    ("e2e_mesh_devices", False, False),
+    ("e2e_mesh_scaling", True, False),
 )
 
 _NUM = r"-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?"

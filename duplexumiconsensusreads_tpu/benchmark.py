@@ -22,6 +22,9 @@ wall-clock reads/s including ingest + write.
 Env knobs: DUT_BENCH_READS (default 600000), DUT_BENCH_CAPACITY (2048),
 DUT_BENCH_CPU_SAMPLE (3000), DUT_BENCH_REPS (10),
 DUT_BENCH_DRAIN_WORKERS (streaming drain pool size, default 2),
+DUT_BENCH_MESH (streaming mesh size for the e2e legs + the K-vs-1
+mesh-scaling A/B; 0/unset = executor default; simulate devices on CPU
+with XLA_FLAGS=--xla_force_host_platform_device_count=8),
 DUT_BENCH_E2E_READS (default 10000000; 0 disables the e2e phase),
 DUT_BENCH_E2E_AB (A/B leg size, default 2000000; 0 disables),
 DUT_BENCH_AB_BUDGET_S (A/B wall budget the legs shrink to fit, 480),
@@ -79,6 +82,7 @@ COMPACT_KEYS = (
     "e2e_bytes_per_read", "e2e_packed_speedup", "e2e_d2h_packed_speedup",
     "e2e_h2d_bits_per_cycle", "e2e_prefetch_depth",
     "e2e_fill_factor", "tuner_predicted_speedup", "e2e_vs_cpu_e2e",
+    "e2e_mesh_devices", "e2e_mesh_scaling",
     "serve_amortised_speedup", "serve_fleet_takeover_latency_s",
     "serve_quarantine_after_crashes", "serve_watchdog_detect_latency_s",
     "serve_shard_speedup", "serve_shard_merge_s",
@@ -211,7 +215,7 @@ def _e2e_input(n_target: int) -> tuple[str, float]:
 
 def run_e2e(
     n_target: int, packed: str = "auto", prefix: str = "e2e",
-    d2h_packed: str = "auto",
+    d2h_packed: str = "auto", n_devices: int | None = None,
 ) -> dict:
     """Stream a cached large simulated BAM through the full pipeline;
     return wall-clock metrics including ingest and write. packed="off"
@@ -234,12 +238,19 @@ def run_e2e(
         trace_path = os.path.join(cache, f"{prefix}_trace.jsonl")
     gp, cp = _e2e_params()
     prefetch_depth = int(os.environ.get("DUT_BENCH_PREFETCH_DEPTH", 2))
+    # mesh size for this leg: the explicit kwarg (the scaling A/B), or
+    # DUT_BENCH_MESH (0/unset = the executor default, all local
+    # devices — on CPU simulate a mesh with
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8)
+    if n_devices is None:
+        n_devices = int(os.environ.get("DUT_BENCH_MESH", 0)) or None
     t0 = time.monotonic()
     rep = stream_call_consensus(
         in_path,
         out_path,
         gp,
         cp,
+        n_devices=n_devices,
         capacity=int(os.environ.get("DUT_BENCH_CAPACITY", 2048)),
         chunk_reads=E2E_CHUNK_READS,
         max_inflight=E2E_MAX_INFLIGHT,
@@ -353,6 +364,10 @@ def run_e2e(
         f"{prefix}_phases": {k: v for k, v in rep.seconds.items() if k != "total"},
         f"{prefix}_drain_workers": rep.n_drain_workers,
         f"{prefix}_prefetch_depth": prefetch_depth,
+        # the leg's resolved mesh (devices the bucket batch sharded
+        # over) and the padding it cost — the scaling leg's context
+        f"{prefix}_mesh_devices": rep.n_devices,
+        f"{prefix}_mesh_pad_buckets": rep.n_mesh_pad_buckets,
     }
 
 
@@ -1434,6 +1449,36 @@ def main() -> None:
                 / d2h_off["e2e_d2h_unpacked_reads_per_sec"],
                 3,
             )
+            # mesh-scaling A/B (DUT_BENCH_MESH=K, needs K devices —
+            # simulated on CPU via XLA_FLAGS, real chips on a pod):
+            # the same leg at K devices vs 1, same warm caches. On the
+            # simulated-device CPU path the ratio is informational
+            # (virtual devices share the host's cores); on real
+            # silicon it IS the K-way scaling headline.
+            n_mesh = int(os.environ.get("DUT_BENCH_MESH", 0))
+            if n_mesh > 1:
+                import jax as _jax
+
+                if len(_jax.devices()) >= n_mesh:
+                    mesh_leg = run_e2e(
+                        n_ab, prefix="e2e_meshk", n_devices=n_mesh
+                    )
+                    result.update(mesh_leg)
+                    mesh_one = run_e2e(
+                        n_ab, prefix="e2e_mesh1", n_devices=1
+                    )
+                    result.update(mesh_one)
+                    result["e2e_mesh_devices"] = n_mesh
+                    result["e2e_mesh_scaling"] = round(
+                        mesh_one["e2e_mesh1_wall_s"]
+                        / max(mesh_leg["e2e_meshk_wall_s"], 1e-9),
+                        3,
+                    )
+                else:
+                    result["e2e_mesh_error"] = (
+                        f"DUT_BENCH_MESH={n_mesh} but only "
+                        f"{len(_jax.devices())} devices visible"
+                    )
         # serve_n_jobs: small jobs through the in-process daemon vs a
         # cold one-shot subprocess — the serving layer's compile
         # amortisation, measured (DUT_BENCH_SERVE_JOBS=0 disables).

@@ -173,6 +173,20 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--capacity", type=int, default=None, help="bucket read capacity")
     c.add_argument("--devices", type=int, default=None, help="mesh size (default: all)")
     c.add_argument(
+        "--mesh",
+        default=None,
+        metavar="{auto,1,2,4,8,..}",
+        help="streaming mesh size: shard each chunk's bucket batch "
+        "across this many devices ('auto' = all local devices). "
+        "Output bytes are identical at ANY device count — chunk order "
+        "is the commit order and mesh-pad buckets emit nothing — so "
+        "this is a pure throughput knob, A/B-tested like "
+        "--drain-workers. Carried by --submit jobs (the daemon "
+        "resolves 'auto' against its own device pool); requires "
+        "--chunk-reads. Simulate devices on CPU with "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8",
+    )
+    c.add_argument(
         "--cycle-shards",
         type=int,
         default=None,
@@ -561,7 +575,7 @@ def _load_config_file(path: str) -> dict:
     allowed = {
         "backend", "grouping", "mode", "error_model", "max_hamming",
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
-        "min_input_qual", "capacity", "devices", "cycle_shards",
+        "min_input_qual", "capacity", "devices", "mesh", "cycle_shards",
         "chunk_reads", "max_inflight", "drain_workers", "packed",
         "prefetch_depth", "bucket_ladder", "config",
         "mate_aware", "max_reads",
@@ -719,6 +733,23 @@ def _cmd_call(args) -> int:
     packed = opt("packed", "auto")
     prefetch_depth = opt("prefetch_depth", 2)
     bucket_ladder = opt("bucket_ladder", "off")
+    mesh = opt("mesh", "auto")
+    if mesh != "auto":
+        # config-file values arrive as ints or strings; both normalise
+        try:
+            mesh = int(mesh)
+        except (TypeError, ValueError):
+            raise SystemExit(
+                f"--mesh must be 'auto' or an int >= 1 (got {mesh!r})"
+            )
+        if mesh < 1:
+            raise SystemExit(f"--mesh must be >= 1 (got {mesh})")
+        if devices is not None and devices != mesh:
+            # two knobs, one mesh size: agreeing values are fine
+            # (presets), disagreeing ones must not silently race
+            raise SystemExit(
+                f"--mesh {mesh} conflicts with --devices {devices}"
+            )
     from duplexumiconsensusreads_tpu.tuning import normalize_bucket_ladder
 
     try:
@@ -867,6 +898,7 @@ def _cmd_call(args) -> int:
             "drain_workers": drain_workers,
             "packed": packed,
             "prefetch_depth": prefetch_depth,
+            "mesh": mesh,
             "bucket_ladder": (
                 list(ladder_norm) if isinstance(ladder_norm, tuple)
                 else ladder_norm
@@ -933,6 +965,15 @@ def _cmd_call(args) -> int:
         raise SystemExit(
             "--packed/--prefetch-depth require the streaming executor "
             "(--chunk-reads N)"
+        )
+    if chunk_reads <= 0 and (args.mesh is not None or mesh != "auto"):
+        # the mesh knob steers the STREAMING dispatch path (per-device
+        # H2D lanes, per-shard D2H compaction); the whole-file executor
+        # has its own --devices — refuse-don't-drop, like --packed, and
+        # like there the RESOLVED value covers config-file keys
+        raise SystemExit(
+            "--mesh requires the streaming executor (--chunk-reads N); "
+            "whole-file runs size the mesh with --devices"
         )
     if chunk_reads <= 0 and (
         args.bucket_ladder is not None or ladder_norm != "off"
@@ -1044,7 +1085,7 @@ def _cmd_call(args) -> int:
             num_processes=args.n_hosts,
             capacity=capacity,
             chunk_reads=chunk_reads,
-            n_devices=devices,
+            n_devices=mesh if mesh != "auto" else devices,
             max_inflight=max_inflight,
             drain_workers=drain_workers,
             packed=packed,
@@ -1079,7 +1120,7 @@ def _cmd_call(args) -> int:
             cp,
             capacity=capacity,
             chunk_reads=chunk_reads,
-            n_devices=devices,
+            n_devices=mesh if mesh != "auto" else devices,
             max_inflight=max_inflight,
             drain_workers=drain_workers,
             packed=packed,

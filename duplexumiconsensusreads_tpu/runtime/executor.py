@@ -89,6 +89,12 @@ class RunReport:
     # fill factor = n_rows_real / n_rows_padded, the tuner's audit trail
     n_rows_real: int = 0
     n_rows_padded: int = 0
+    # mesh padding (streaming): empty buckets appended per dispatch to
+    # round each class's bucket count to a device-count multiple so the
+    # mesh shards evenly (proven n_out == 0 on device; they ride the
+    # wire and the GEMM, which is why every one is ledgered — the
+    # per-record mesh_pad attrs must sum to exactly this counter)
+    n_mesh_pad_buckets: int = 0
     # resolved bucket ladder of the run ([] = single-capacity): explicit
     # rungs verbatim, or the tuner verdict an auto run settled on
     bucket_ladder: list = dataclasses.field(default_factory=list)
@@ -170,11 +176,12 @@ def busy_wall_table(
         if _num(v) is None:
             lines.append(f"{k:<18} {'-':>9} {wall:9.3f} {'-':>9}  (non-numeric)")
             continue
-        if k == "dispatch":
+        if k in ("dispatch", "mesh_h2d"):
             # dispatch normally runs on the xfer pool, but materialize's
             # retry path re-dispatches on drain workers too — the
             # canary threshold must cover both or retry-heavy runs trip
-            # a false accounting bug
+            # a false accounting bug. mesh_h2d (the per-device H2D put
+            # loop inside dispatch) runs on exactly the same threads.
             pool = XFER_WORKERS + drain_workers
         else:
             pool = drain_workers if k in DRAIN_PHASES else 1
@@ -408,6 +415,21 @@ def fetch_outputs(out: dict) -> dict:
 # drain workers, chaos site fetch.unpack) reconstructs the exact
 # unpacked FETCH_KEYS arrays at every position the scatter reads, so
 # output bytes are bit-identical with the rung on or off.
+#
+# MESH: the compaction runs PER SHARD (``n_shards`` = the mesh's data
+# axis; the bucket axis is padded to a multiple of it, so each shard
+# owns a contiguous (B/S)-bucket block). This is not an optimisation
+# but a liveness requirement: a global cumsum/searchsorted over the
+# bucket-sharded axis compiles to cross-device collectives
+# (AllReduce/AllGather on XLA:CPU and TPU alike), and two sharded
+# programs dispatched concurrently from different transfer threads —
+# exactly what the streaming executor's async overlap does — deadlock
+# the per-device collective rendezvous. The vmapped per-shard form
+# keeps every lane device-local (zero collectives, the same property
+# parallel/mesh.py documents for the pipeline itself), at the cost of
+# padding each shard's compact rows to one shared static k_pad. The
+# wire layout is therefore (S * k_pad, ...) row-blocks, one block per
+# shard; host unpack re-splits on the same n_shards.
 
 PACKED_FETCH_KEYS = (
     "n_families",
@@ -431,6 +453,61 @@ class D2hCompactionOverflow(RuntimeError):
 _PACK_D2H = None
 
 
+def _shard_pack_body(
+    n_out_s, base_s, qual_s, valid_s, mate_s, end_s, dmax_s, dmin_s,
+    pair_s, *, k_pad: int,
+):
+    """ONE shard's compaction, on (per, ...) blocks: every index below
+    is shard-local, so both callers — the single-device vmap and the
+    mesh's shard_map — run it with zero cross-shard traffic. One body
+    on purpose: the wire layout (k_pad rows per shard, shard-major)
+    must be identical whichever form produced it, because the host
+    unpack cannot tell them apart."""
+    import jax.numpy as jnp
+
+    from duplexumiconsensusreads_tpu.constants import N_REAL_BASES
+    from duplexumiconsensusreads_tpu.kernels.encoding import pack_2bit
+
+    per, f = valid_s.shape
+    offs = jnp.cumsum(n_out_s)
+    starts = offs - n_out_s
+    k = jnp.arange(k_pad, dtype=jnp.int32)
+    b = jnp.minimum(
+        jnp.searchsorted(offs, k, side="right"), per - 1
+    ).astype(jnp.int32)
+    j = jnp.clip(k - starts[b], 0, f - 1)
+    live = k < offs[-1]
+
+    def g(a):
+        mask = live.reshape((-1,) + (1,) * (a.ndim - 2))
+        return jnp.where(mask, a[b, j], 0)
+
+    base = g(base_s)  # (K, L) u8
+    qual = g(qual_s)  # (K, L) u8
+    # the N marker: called quals are >= 2 by the kernels' clip, so 0
+    # is free — and BASE_N rows always carry NO_CALL_QUAL, so dropping
+    # their qual loses nothing
+    qb = jnp.where(base >= N_REAL_BASES, 0, qual).astype(jnp.uint8)
+    flags = (
+        g(valid_s.astype(jnp.uint8)) | (g(mate_s) << 1) | (g(end_s) << 2)
+    ).astype(jnp.uint8)
+    return {
+        "cons_q": qb,
+        "cons_b2": pack_2bit(base & 3),
+        "cons_flags": flags,
+        "cons_dstats": jnp.stack(
+            [g(dmax_s), g(dmin_s)], axis=1
+        ).astype(jnp.uint16),
+        "cons_pair": g(pair_s),
+    }
+
+
+_PACK_FIELDS = (
+    "cons_base", "cons_qual", "cons_valid", "cons_mate", "cons_end",
+    "depth_max", "depth_min_pos", "cons_pair",
+)
+
+
 def _pack_d2h_fn():
     global _PACK_D2H
     if _PACK_D2H is None:
@@ -439,39 +516,26 @@ def _pack_d2h_fn():
         import jax
         import jax.numpy as jnp
 
-        from duplexumiconsensusreads_tpu.constants import N_REAL_BASES
-        from duplexumiconsensusreads_tpu.kernels.encoding import pack_2bit
-
-        @partial(jax.jit, static_argnames=("duplex", "k_pad"))
-        def _pack(out, duplex, k_pad):
+        @partial(jax.jit, static_argnames=("duplex", "k_pad", "n_shards"))
+        def _pack(out, duplex, k_pad, n_shards):
             n_b, f = out["cons_valid"].shape
+            per = n_b // n_shards  # stack pads B to a mesh multiple
+
+            def sh(a):  # (B, ...) -> (S, B/S, ...): contiguous blocks
+                return a.reshape((n_shards, per) + a.shape[1:])
+
             n_out = jnp.clip(
                 out["n_molecules" if duplex else "n_families"], 0, f
             )
-            offs = jnp.cumsum(n_out)
-            starts = offs - n_out
-            k = jnp.arange(k_pad, dtype=jnp.int32)
-            b = jnp.minimum(
-                jnp.searchsorted(offs, k, side="right"), n_b - 1
-            ).astype(jnp.int32)
-            j = jnp.clip(k - starts[b], 0, f - 1)
-            live = k < offs[-1]
-
-            def g(a):
-                mask = live.reshape((-1,) + (1,) * (a.ndim - 2))
-                return jnp.where(mask, a[b, j], 0)
-
-            base = g(out["cons_base"])  # (K, L) u8
-            qual = g(out["cons_qual"])  # (K, L) u8
-            # the N marker: called quals are >= 2 by the kernels' clip,
-            # so 0 is free — and BASE_N rows always carry NO_CALL_QUAL,
-            # so dropping their qual loses nothing
-            qb = jnp.where(base >= N_REAL_BASES, 0, qual).astype(jnp.uint8)
-            flags = (
-                g(out["cons_valid"].astype(jnp.uint8))
-                | (g(out["cons_mate"]) << 1)
-                | (g(out["cons_end"]) << 2)
-            ).astype(jnp.uint8)
+            packed = jax.vmap(
+                lambda *a: _shard_pack_body(*a, k_pad=k_pad)
+            )(sh(n_out), *(sh(out[k]) for k in _PACK_FIELDS))
+            # wire layout: per-shard k_pad row-blocks concatenated —
+            # (S * k_pad, ...); host unpack re-splits on n_shards
+            packed = {
+                k: v.reshape((n_shards * k_pad,) + v.shape[2:])
+                for k, v in packed.items()
+            }
             ids = out["molecule_id" if duplex else "family_id"]
             return {
                 "n_families": out["n_families"],
@@ -479,17 +543,54 @@ def _pack_d2h_fn():
                 # F <= capacity < 2**16, so the shared u16 lane
                 # convention applies
                 "ids16": ids_to_u16(ids),
-                "cons_q": qb,
-                "cons_b2": pack_2bit(base & 3),
-                "cons_flags": flags,
-                "cons_dstats": jnp.stack(
-                    [g(out["depth_max"]), g(out["depth_min_pos"])], axis=1
-                ).astype(jnp.uint16),
-                "cons_pair": g(out["cons_pair"]),
+                **packed,
             }
 
         _PACK_D2H = _pack
     return _PACK_D2H
+
+
+# (mesh, duplex, k_pad) -> jitted shard_map epilogue (the multi-device
+# form; Mesh hashes by device ids + axis names, so per-run mesh
+# objects share compiles exactly like parallel.sharded._SHMAP_CACHE)
+_PACK_D2H_SHMAP: dict = {}
+
+
+def _pack_d2h_shmap(mesh, duplex: bool, k_pad: int):
+    """shard_map form of the packed-D2H epilogue: each device compacts
+    ITS bucket block locally — zero collectives by construction, the
+    same liveness argument as parallel.sharded._shmap_pipeline (a
+    GSPMD-partitioned epilogue materialises AllGather/AllReduce from
+    the cross-shard cumsum, and concurrent launches deadlock the
+    rendezvous). Wire layout identical to the vmap form."""
+    key = (mesh, duplex, k_pad)
+    fn = _PACK_D2H_SHMAP.get(key)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def local(nf, nm, ids, *fields):
+            f = fields[2].shape[1]  # cons_valid: (per, F)
+            n_out = jnp.clip(nm if duplex else nf, 0, f)
+            packed = _shard_pack_body(n_out, *fields, k_pad=k_pad)
+            return {
+                "n_families": nf,
+                "n_molecules": nm,
+                "ids16": ids_to_u16(ids),
+                **packed,
+            }
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh,
+                in_specs=P("data"), out_specs=P("data"),
+                check_rep=False,
+            )
+        )
+        _PACK_D2H_SHMAP[key] = fn
+    return fn
 
 
 def d2h_pack_ok(capacity: int, per_base_tags: bool) -> bool:
@@ -599,34 +700,79 @@ def pack_ids_u16(out: dict, duplex: bool) -> dict:
     return d
 
 
-def d2h_k_pad(cbuckets, spec) -> int:
-    """Static row bound of the compacted consensus transfer: per
-    bucket, output units are bounded by mult * n_unique (the invariant
-    spec_for_buckets' f_max/m_max sizing already rests on), summed over
-    the class and rounded to a power of two so the epilogue's compile
-    count stays bounded. The host-side unpack re-checks the fetched
-    counts against this bound and fails loudly on violation."""
-    from duplexumiconsensusreads_tpu.ops.pipeline import _pow2
-
+def d2h_unit_bound(spec) -> tuple[int, int]:
+    """(mult, f) of the per-bucket output-unit bound ``min(mult *
+    n_unique, f)`` — the grouping invariant both the k_pad sizing and
+    the host unpack's overflow check rest on."""
     g, duplex = spec.grouping, spec.consensus.mode == "duplex"
     if duplex:
         mult = 2 if (g.mate_aware and g.paired) else 1
-        f = spec.m_max or cbuckets[0].capacity
+        f = spec.m_max or 0
     else:
         mult = (2 if g.paired else 1) * (2 if g.mate_aware else 1)
-        f = spec.f_max or cbuckets[0].capacity
-    bound = sum(min(mult * bk.n_unique_umi, f) for bk in cbuckets)
-    # the B*f cap is compile-churn-free even though it isn't a power of
-    # two: the vmapped pipeline's jit is already keyed on the class's
-    # (B, f) shapes, so a k_pad equal to B*f introduces no compile key
-    # the dispatch didn't pay for anyway
-    return min(_pow2(max(bound, 1)), len(cbuckets) * f)
+        f = spec.f_max or 0
+    return mult, f
 
 
-def pack_fetch_outputs(out: dict, spec, k_pad: int) -> dict:
+def d2h_k_pad(cbuckets, spec, n_shards: int = 1) -> int:
+    """Static PER-SHARD row bound of the compacted consensus transfer:
+    per bucket, output units are bounded by mult * n_unique (the
+    invariant spec_for_buckets' f_max/m_max sizing already rests on),
+    summed over each mesh shard's contiguous bucket block (real
+    buckets sit in slots [0, len(cbuckets)); mesh-pad buckets beyond
+    them are empty and bound 0) and rounded to a power of two so the
+    epilogue's compile count stays bounded. The host-side unpack
+    re-checks the fetched counts against this bound per shard and
+    fails loudly on violation."""
+    from duplexumiconsensusreads_tpu.ops.pipeline import _pow2
+
+    mult, f = d2h_unit_bound(spec)
+    f = f or cbuckets[0].capacity
+    n_stacked = len(cbuckets) + (-len(cbuckets)) % max(n_shards, 1)
+    per = max(n_stacked // max(n_shards, 1), 1)
+    bound = 0
+    for s in range(max(n_shards, 1)):
+        bound = max(
+            bound,
+            sum(
+                min(mult * bk.n_unique_umi, f)
+                for bk in cbuckets[s * per : (s + 1) * per]
+            ),
+        )
+    # the per*f cap is compile-churn-free even though it isn't a power
+    # of two: the vmapped pipeline's jit is already keyed on the
+    # class's (B, f) shapes, so a k_pad equal to (B/S)*f introduces no
+    # compile key the dispatch didn't pay for anyway
+    return min(_pow2(max(bound, 1)), per * f)
+
+
+def pack_fetch_outputs(
+    out: dict, spec, k_pad: int, n_shards: int = 1, mesh=None
+) -> dict:
     """Apply the packed-D2H epilogue to a sharded pipeline output dict;
-    returns the compact device dict (PACKED_FETCH_KEYS)."""
-    return _pack_d2h_fn()(out, spec.consensus.mode == "duplex", k_pad)
+    returns the compact device dict (PACKED_FETCH_KEYS). ``n_shards``
+    is the mesh's data-axis size: the compaction runs per shard (see
+    the module comment — a cross-shard compaction deadlocks concurrent
+    sharded dispatches) and the compact rows come back as S blocks of
+    ``k_pad`` rows each. Pass the live ``mesh`` on multi-device runs:
+    the epilogue then compiles as a shard_map (guaranteed
+    collective-free); without it the vmap form is used — identical
+    wire bytes, only safe when programs never run concurrently across
+    devices (single device, or the whole-file executor's sequential
+    dispatch)."""
+    duplex = spec.consensus.mode == "duplex"
+    if (
+        mesh is not None
+        and mesh.devices.size > 1
+        and "cycle" not in mesh.axis_names
+    ):
+        fn = _pack_d2h_shmap(mesh, duplex, k_pad)
+        return fn(
+            out["n_families"], out["n_molecules"],
+            out["molecule_id" if duplex else "family_id"],
+            *(out[k] for k in _PACK_FIELDS),
+        )
+    return _pack_d2h_fn()(out, duplex, k_pad, n_shards)
 
 
 def _unpack_2bit_np(packed: np.ndarray, l: int) -> np.ndarray:
@@ -636,13 +782,14 @@ def _unpack_2bit_np(packed: np.ndarray, l: int) -> np.ndarray:
     return codes.reshape(*packed.shape[:-1], -1)[..., :l].astype(np.uint8)
 
 
-def unpack_fetch_outputs(fetched: dict, cbuckets, spec) -> dict:
+def unpack_fetch_outputs(fetched: dict, cbuckets, spec, n_shards: int = 1) -> dict:
     """Host-side reconstruction of the exact unpacked FETCH_KEYS arrays
     from a packed-D2H fetch (dtypes included — byte identity of the
     final output rests on the scatter seeing indistinguishable inputs).
     Rows past each bucket's n_out reconstruct as zeros/invalid; the
     scatter's keep mask never reads them. A dict without the packed
-    marker key passes through untouched."""
+    marker key passes through untouched. ``n_shards`` must match the
+    pack side's: the wire rows arrive as S per-shard k_pad blocks."""
     from duplexumiconsensusreads_tpu.constants import BASE_N, NO_CALL_QUAL
 
     if "cons_q" not in fetched:
@@ -662,28 +809,42 @@ def unpack_fetch_outputs(fetched: dict, cbuckets, spec) -> dict:
     nf = np.asarray(fetched["n_families"])
     nm = np.asarray(fetched["n_molecules"])
     n_b = nf.shape[0]
-    k_pad, l = fetched["cons_q"].shape
+    rows_wire, l = fetched["cons_q"].shape
+    if n_b % max(n_shards, 1) or rows_wire % max(n_shards, 1):
+        raise D2hCompactionOverflow(
+            f"packed d2h shard mismatch: {n_b} buckets / {rows_wire} "
+            f"wire rows not divisible by n_shards={n_shards}"
+        )
+    per = n_b // n_shards
+    k_pad = rows_wire // n_shards
     n_out = np.clip(nm if duplex else nf, 0, f)
-    offs = np.concatenate([[0], np.cumsum(n_out)])
-    total = int(offs[-1])
-    if total > k_pad:
+    shard_totals = n_out.reshape(n_shards, per).sum(axis=1)
+    if (shard_totals > k_pad).any():
         # the grouping invariant the bound rests on was violated —
         # rows were dropped on device; this must never be silent
+        s_bad = int(np.argmax(shard_totals > k_pad))
         raise D2hCompactionOverflow(
-            f"packed d2h compaction overflow: {total} output rows > "
-            f"bound {k_pad} (grouping invariant violated)"
+            f"packed d2h compaction overflow: shard {s_bad} produced "
+            f"{int(shard_totals[s_bad])} output rows > bound {k_pad} "
+            f"(grouping invariant violated)"
         )
-    q = np.asarray(fetched["cons_q"])[:total]
-    b2 = _unpack_2bit_np(np.asarray(fetched["cons_b2"])[:total], l)
+    offs = np.concatenate([[0], np.cumsum(n_out)])
+    total = int(offs[-1])
+    b_of = np.repeat(np.arange(n_b), n_out)
+    j_of = np.arange(total) - offs[b_of]
+    # wire source row of each live output row: its shard's k_pad block
+    # base plus the bucket-run offset WITHIN the shard
+    shard_of = b_of // per
+    src = shard_of * k_pad + np.arange(total) - offs[shard_of * per]
+
+    q = np.asarray(fetched["cons_q"])[src]
+    b2 = _unpack_2bit_np(np.asarray(fetched["cons_b2"])[src], l)
     none = q == 0
     base_rows = np.where(none, np.uint8(BASE_N), b2)
     qual_rows = np.where(none, np.uint8(NO_CALL_QUAL), q)
-    flags = np.asarray(fetched["cons_flags"])[:total]
-    dstats = np.asarray(fetched["cons_dstats"])[:total].astype(np.int32)
-    pair_rows = np.asarray(fetched["cons_pair"])[:total]
-
-    b_of = np.repeat(np.arange(n_b), n_out)
-    j_of = np.arange(total) - offs[b_of]
+    flags = np.asarray(fetched["cons_flags"])[src]
+    dstats = np.asarray(fetched["cons_dstats"])[src].astype(np.int32)
+    pair_rows = np.asarray(fetched["cons_pair"])[src]
     cons_base = np.zeros((n_b, f, l), np.uint8)
     cons_qual = np.zeros((n_b, f, l), np.uint8)
     cons_valid = np.zeros((n_b, f), bool)

@@ -979,6 +979,13 @@ def stream_call_consensus(
     # merged shard outputs are byte-identical to the unsharded run
     first_read: int | None = None,  # record count of the first raw read
     # (shard chunk-grid realignment; see iter_batch_chunks)
+    devices=None,  # local-device INDEX subset to build the mesh from
+    # (dut-serve --devices pinning: a fleet of daemons on one host can
+    # each own a disjoint device set). None = all local devices;
+    # n_devices then counts within the subset. Output bytes are
+    # identical for any subset/count — device count is a wire/compute
+    # topology knob, never a result knob (the mesh byte-identity
+    # contract, A/B-tested like --drain-workers).
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -1030,6 +1037,7 @@ def stream_call_consensus(
             tr=tr, heartbeat_s=heartbeat_s, hb_box=hb_box,
             provenance_cl=provenance_cl,
             chunk_base=chunk_base, first_read=first_read,
+            devices=devices,
         )
     finally:
         for hb in hb_box:
@@ -1075,6 +1083,7 @@ def _stream_call(
     provenance_cl: str | None = None,
     chunk_base: int = 0,
     first_read: int | None = None,
+    devices=None,
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -1207,9 +1216,20 @@ def _stream_call(
 
     # local devices: the executors are host-local programs (each host
     # streams its own input partition), so under an initialized
-    # multi-controller runtime the mesh must never span other hosts
-    n_dev = n_devices or len(jax.local_devices())
-    mesh = make_mesh(n_dev, cycle_shards=cycle_shards, devices=jax.local_devices())
+    # multi-controller runtime the mesh must never span other hosts.
+    # ``devices`` narrows the pool to an index subset (daemon pinning);
+    # n_devices then counts within it.
+    pool = jax.local_devices()
+    if devices:
+        bad = [i for i in devices if not (0 <= int(i) < len(pool))]
+        if bad:
+            raise ValueError(
+                f"devices={list(devices)} out of range: this host has "
+                f"{len(pool)} local devices"
+            )
+        pool = [pool[int(i)] for i in devices]
+    n_dev = n_devices or len(pool)
+    mesh = make_mesh(n_dev, cycle_shards=cycle_shards, devices=pool)
     n_data = max(n_dev // max(cycle_shards, 1), 1)
     rep.n_devices = n_dev
     header_out: BamHeader | None = None
@@ -1254,6 +1274,7 @@ def _stream_call(
     # exactly (the trace_report sum-check).
     phase = {
         "ingest": 0.0, "bucketing": 0.0, "dispatch": 0.0,
+        "mesh_h2d": 0.0,
         "device_wait_fetch": 0.0, "scatter": 0.0, "deflate": 0.0,
         "shard_write": 0.0, "ckpt": 0.0, "finalise": 0.0,
         "main_loop_stall": 0.0, "prefetch_stall": 0.0,
@@ -1311,6 +1332,60 @@ def _stream_call(
     # scanning stopped for the rest of the run)
     alpha_seen: set | None = set()
 
+    # the mesh's per-device H2D path needs the device list in data-axis
+    # order and the raw array-key set (parallel/sharded.py owns both)
+    from duplexumiconsensusreads_tpu.parallel.sharded import (
+        _ARRAY_KEYS,
+        presharded_pipeline,
+    )
+
+    mesh_devs = list(mesh.devices.flat)
+    # per-device telemetry lanes exist ONLY on the 1-D multi-device
+    # mesh: on the ('data', 'cycle') mesh a data-axis shard spans
+    # cycle_shards physical devices, so a dev-N lane would name no
+    # real chip — both the h2d and d2h ledger splits key on this
+    dev_lanes_on = n_data > 1 and cycle_shards <= 1
+
+    def _mesh_put(stacked, buckets, bucket_rows, chunk):
+        """Per-device H2D of one dispatch on a multi-device 1-D mesh:
+        slice the stacked arrays into the mesh's contiguous per-device
+        bucket blocks and device_put each block on its own device
+        (timed per device — the "mesh_h2d" spans on dev-N lanes).
+        Value-identical to shard_stacked's one NamedSharding
+        device_put; what it adds is per-device attribution — wire
+        bytes, fill rows and mesh-pad buckets per device — so
+        wirestat/trace_report can say WHICH device's share of the
+        tunnel a slow chunk paid. ``bucket_rows`` is the caller's
+        one-pass per-bucket valid-read counts (recomputing the masks
+        here would rescan every bucket on the hot xfer path). Returns
+        (per-key device buffers, per-device ledger stats); the caller
+        assembles the global arrays inside its own timed window."""
+        n_stacked = int(stacked["pos"].shape[0])
+        per = n_stacked // n_data
+        cap = buckets[0].capacity
+        bufs: dict[str, list] = {k: [] for k in _ARRAY_KEYS}
+        stats = []
+        for di, dev in enumerate(mesh_devs):
+            td = time.monotonic()
+            wire_d = 0
+            for key in _ARRAY_KEYS:
+                sl = stacked[key][di * per : (di + 1) * per]
+                bufs[key].append(jax.device_put(sl, dev))
+                wire_d += sl.nbytes
+            dtd = time.monotonic() - td
+            with phase_lock:
+                phase["mesh_h2d"] += dtd
+            if tr is not None:
+                tr.span("mesh_h2d", td, dtd, chunk=chunk, lane=f"dev-{di}")
+            sub_rows = bucket_rows[di * per : (di + 1) * per]
+            stats.append({
+                "t0": td, "dt": dtd, "wire": wire_d,
+                "rows_real": sum(sub_rows),
+                "rows_pad": per * cap,
+                "mesh_pad": per - len(sub_rows),
+            })
+        return bufs, stats
+
     def dispatch(buckets, spec, chunk=None):
         t0 = time.monotonic()
         # runs on a transfer worker; a fault here surfaces through the
@@ -1338,9 +1413,39 @@ def _stream_call(
         # this class's dispatch (mesh-pad empties included — they ride
         # the wire and the GEMM alike); retried dispatches re-count,
         # exactly like the byte ledger counts wire traffic
-        rows_pad = int(stacked["pos"].shape[0]) * buckets[0].capacity
-        rows_real = sum(int(bk.valid.sum()) for bk in buckets)
-        out = sharded_pipeline(stacked, spec, mesh)
+        n_stacked = int(stacked["pos"].shape[0])
+        rows_pad = n_stacked * buckets[0].capacity
+        # ONE pass over the valid masks: the per-device stats and the
+        # dispatch totals both read these counts
+        bucket_rows = [int(bk.valid.sum()) for bk in buckets]
+        rows_real = sum(bucket_rows)
+        mesh_pad = n_stacked - len(buckets)
+        # multi-device 1-D mesh: the per-device put path (value-
+        # identical, per-device-attributed). The 2-D (data, cycle)
+        # mesh shards bases/quals along cycles too, so its transfers
+        # stay on shard_stacked's one NamedSharding put — and its
+        # ledger records stay unlaned (a data-axis "shard" there spans
+        # several physical devices, so dev-N lanes would lie).
+        dev_stats = None
+        if dev_lanes_on:
+            t_pre = time.monotonic() - t0  # stack + pack, "dispatch"
+            bufs, dev_stats = _mesh_put(
+                stacked, buckets, bucket_rows, chunk
+            )
+            t0b = time.monotonic()
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(mesh, P("data"))
+            args = {
+                key: jax.make_array_from_single_device_arrays(
+                    stacked[key].shape, sh, bufs[key]
+                )
+                for key in _ARRAY_KEYS
+            }
+            out = presharded_pipeline(args, spec, mesh)
+        else:
+            t_pre, t0b = None, t0
+            out = sharded_pipeline(stacked, spec, mesh)
         # the run-level d2h decision re-checked against the CLASS
         # capacity (one pure helper — executor.d2h_rung_for_class — so
         # the gate matrix is unit-tested without a device): jumbo
@@ -1360,9 +1465,15 @@ def _stream_call(
             # packed consensus-only return path: compact + pack the
             # output rows ON DEVICE before any copy starts (still at
             # dispatch time, so the async overlap is intact), then
-            # start the d2h copies of the compact set
+            # start the d2h copies of the compact set. The compaction
+            # runs PER MESH SHARD (n_data) — a cross-shard compaction
+            # compiles to collectives that deadlock concurrent
+            # dispatches (see the executor's packed-D2H comment)
             out = start_fetch(
-                pack_fetch_outputs(out, spec, d2h_k_pad(buckets, spec)),
+                pack_fetch_outputs(
+                    out, spec, d2h_k_pad(buckets, spec, n_data),
+                    n_data, mesh=mesh,
+                ),
                 keys=PACKED_FETCH_KEYS,
             )
         elif rung == "ids16":
@@ -1383,17 +1494,37 @@ def _stream_call(
                 out,
                 extra=("cons_depth", "cons_err") if per_base_tags else (),
             )
-        dt = time.monotonic() - t0
+        dt_post = time.monotonic() - t0b
+        # dispatch busy time excludes the per-device put loop: the
+        # "mesh_h2d" stage owns it. Each stage's spans carry exactly
+        # the dt its phase accumulator receives (the sum-check
+        # contract); the stats/emission slivers between the windows
+        # are deliberately unattributed rather than misattributed.
+        disp_dt = dt_post if t_pre is None else t_pre + dt_post
         with phase_lock:  # dict += from concurrent workers would race
-            phase["dispatch"] += dt
+            phase["dispatch"] += disp_dt
             rep.bytes_h2d += h2d
             rep.n_rows_real += rows_real
             rep.n_rows_padded += rows_pad
+            rep.n_mesh_pad_buckets += mesh_pad
             if tr is not None:
                 led["h2d_logical"] += logical
                 led["h2d_wire"] += h2d
         if tr is not None:
-            tr.span("dispatch", t0, dt, chunk=chunk, n_buckets=len(buckets))
+            if t_pre is None:
+                tr.span(
+                    "dispatch", t0, disp_dt, chunk=chunk,
+                    n_buckets=len(buckets),
+                )
+            else:
+                # mesh path: the stack/pack prologue and the pipeline
+                # epilogue are separate dispatch spans bracketing the
+                # per-device mesh_h2d spans emitted between them
+                tr.span(
+                    "dispatch", t0, t_pre, chunk=chunk,
+                    n_buckets=len(buckets),
+                )
+                tr.span("dispatch", t0b, dt_post, chunk=chunk)
             # retried dispatches emit again on purpose: the ledger
             # counts wire traffic, and a retry really crossed the wire.
             # bpc = wire bits per base/qual cycle of this class's rung
@@ -1405,12 +1536,31 @@ def _stream_call(
             )
             # rows_real/rows_pad + the class capacity: the per-rung
             # fill-factor audit trail (wirestat's fill column and the
-            # tuner acceptance both read these)
-            tr.xfer(
-                "h2d", logical, h2d, t0, dt, chunk=chunk, bpc=bpc,
-                rows_real=rows_real, rows_pad=rows_pad,
-                cap=buckets[0].capacity,
-            )
+            # tuner acceptance both read these). mesh_pad: the mesh-
+            # alignment pad buckets this dispatch shipped — summed per
+            # device on the mesh path, where each record carries ITS
+            # device's slice on the dev-N lane, logical split exactly
+            # (every stacked array's bucket axis divides by n_data).
+            if dev_stats is not None:
+                log_d = logical // n_data
+                for di, st in enumerate(dev_stats):
+                    tr.xfer(
+                        "h2d",
+                        logical - log_d * (n_data - 1)
+                        if di == n_data - 1 else log_d,
+                        st["wire"], st["t0"], st["dt"], chunk=chunk,
+                        lane=f"dev-{di}", bpc=bpc,
+                        rows_real=st["rows_real"],
+                        rows_pad=st["rows_pad"],
+                        cap=buckets[0].capacity,
+                        mesh_pad=st["mesh_pad"],
+                    )
+            else:
+                tr.xfer(
+                    "h2d", logical, h2d, t0, disp_dt, chunk=chunk,
+                    bpc=bpc, rows_real=rows_real, rows_pad=rows_pad,
+                    cap=buckets[0].capacity, mesh_pad=mesh_pad,
+                )
         return out
 
     def unpack(raw, cbuckets, cspec):
@@ -1423,7 +1573,9 @@ def _stream_call(
         wire = sum(v.nbytes for v in raw.values() if hasattr(v, "nbytes"))
         full = _io_retry(
             "fetch.unpack",
-            lambda: unpack_fetch_outputs(raw, cbuckets, cspec),
+            lambda: unpack_fetch_outputs(
+                raw, cbuckets, cspec, n_shards=n_data
+            ),
             "packed d2h unpack",
         )
         return full, wire, d2h_logical_nbytes(raw, cbuckets, cspec)
@@ -1588,8 +1740,25 @@ def _stream_call(
                 # consensus-only fetch moved, logical what the full
                 # padded FETCH_KEYS arrays would have — the d2h
                 # logical-vs-wire gap the ROADMAP's wire item asked the
-                # ledger to close (equal when the rung is off)
-                tr.xfer("d2h", d2h_logical, d2h_wire, t0, dt, chunk=k)
+                # ledger to close (equal when the rung is off). On a
+                # multi-device mesh the fetch splits into one record
+                # per device lane: every fetched array's leading axis
+                # is bucket- (or per-shard-row-) aligned, so the byte
+                # split is exact; the (t0, dt) window is shared — the
+                # async copies all land inside this one wait.
+                if (
+                    dev_lanes_on
+                    and d2h_wire % n_data == 0
+                    and d2h_logical % n_data == 0
+                ):
+                    for di in range(n_data):
+                        tr.xfer(
+                            "d2h", d2h_logical // n_data,
+                            d2h_wire // n_data, t0, dt, chunk=k,
+                            lane=f"dev-{di}",
+                        )
+                else:
+                    tr.xfer("d2h", d2h_logical, d2h_wire, t0, dt, chunk=k)
             t0 = time.monotonic()
             # chaos site drain.scatter rides the same bounded-retry
             # ladder as the host I/O steps (scatter is pure compute, so
@@ -2121,6 +2290,11 @@ def _stream_call(
                 # against the per-record rows_real/rows_pad sums)
                 "n_rows_real": rep.n_rows_real,
                 "n_rows_padded": rep.n_rows_padded,
+                # mesh-alignment pad buckets shipped (device-count
+                # rounding): the per-record mesh_pad attrs must
+                # reproduce this exactly (wirestat's mesh sum-check)
+                "n_mesh_pad_buckets": rep.n_mesh_pad_buckets,
+                "n_devices": rep.n_devices,
             },
             bytes={
                 **led,

@@ -59,8 +59,15 @@ def build_parser() -> argparse.ArgumentParser:
         "device, one job at a time — the scheduler owns arbitration)",
     )
     p.add_argument(
-        "--devices", type=int, default=None,
-        help="devices per job slice (default: all local)",
+        "--devices", default=None,
+        help="device topology per job slice: a COUNT ('4' — the first "
+        "N local devices; the legacy meaning of a bare integer) or a "
+        "comma-separated local-device INDEX subset ('0,1' — pin this "
+        "daemon to those chips, so a fleet on one host can partition "
+        "the devices and the scatter-gather fan-out drives daemons "
+        "that each own real silicon; a SINGLE index needs the "
+        "trailing comma: '2,' pins chip 2, where '2' means a count "
+        "of two). Default: all local devices",
     )
     p.add_argument(
         "--lease", type=float, default=None, metavar="SECONDS",
@@ -137,6 +144,43 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def parse_devices(value: str | None) -> tuple[int | None, list[int] | None]:
+    """``--devices`` → (n_devices, device_indices): a bare integer
+    keeps the legacy COUNT meaning; anything with a comma is an INDEX
+    subset (duplicates/negatives refused), so a single-chip pin is the
+    one-element list ``'2,'`` — a bare '2' cannot be both, and the
+    count reading wins for compatibility (the --help text and the
+    count error below both name the trailing-comma form so a mis-typed
+    single index is discoverable). One helper so the CLI and tests
+    cannot drift on the syntax."""
+    if value is None:
+        return None, None
+    parts = [p.strip() for p in str(value).split(",")]
+    try:
+        nums = [int(p) for p in parts if p != ""]
+    except ValueError:
+        raise ValueError(
+            f"--devices must be a count or a comma-separated index "
+            f"list (got {value!r})"
+        )
+    if not nums:
+        raise ValueError("--devices got an empty list")
+    if len(parts) == 1:
+        if nums[0] < 1:
+            raise ValueError(
+                f"--devices count must be >= 1 (got {nums[0]}; to PIN "
+                f"a single device by index, use the one-element list "
+                f"form '{nums[0]},')"
+            )
+        return nums[0], None
+    if any(n < 0 for n in nums) or len(set(nums)) != len(nums):
+        raise ValueError(
+            f"--devices index list must be unique non-negative indices "
+            f"(got {value!r})"
+        )
+    return None, nums
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.chunk_budget < 0:
@@ -166,6 +210,10 @@ def main(argv: list[str] | None = None) -> int:
     from duplexumiconsensusreads_tpu.serve.queue import LEASE_DEFAULT_S
     from duplexumiconsensusreads_tpu.serve.service import ConsensusService
 
+    try:
+        n_devices, device_indices = parse_devices(args.devices)
+    except ValueError as e:
+        raise SystemExit(str(e))
     os.makedirs(args.spool, exist_ok=True)
     service = ConsensusService(
         args.spool,
@@ -175,7 +223,8 @@ def main(argv: list[str] | None = None) -> int:
         poll_s=args.poll,
         heartbeat_s=args.heartbeat,
         trace_path=None if args.no_trace else args.trace,
-        n_devices=args.devices,
+        n_devices=n_devices,
+        device_indices=device_indices,
         lease_s=args.lease if args.lease is not None else LEASE_DEFAULT_S,
         class_depths=class_depths,
         daemon_id=args.daemon_id,

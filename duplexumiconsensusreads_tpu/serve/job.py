@@ -39,6 +39,7 @@ CONFIG_DEFAULTS = {
     "packed": "auto",
     "prefetch_depth": 2,
     "bucket_ladder": "off",
+    "mesh": "auto",
     "mate_aware": "auto",
     "max_reads": 0,
     "per_base_tags": False,
@@ -134,6 +135,17 @@ def validate_spec(d: dict) -> JobSpec:
                 "prefetch_depth"):
         if not isinstance(merged[key], int) or merged[key] < 1:
             raise ValueError(f"config {key} must be an int >= 1")
+    mesh = merged["mesh"]
+    if mesh != "auto" and (
+        not isinstance(mesh, int) or isinstance(mesh, bool) or mesh < 1
+    ):
+        # the job's mesh size (devices its slices shard over): "auto" =
+        # the daemon's device pool; an int is validated against the
+        # pool only at slice time (submission hosts may not see the
+        # daemon's devices)
+        raise ValueError(
+            f"config mesh must be 'auto' or an int >= 1 (got {mesh!r})"
+        )
     ladder = _normalized_ladder(merged)  # raises ValueError on a bad value
     if isinstance(ladder, tuple) and ladder[-1] != merged["capacity"]:
         # an explicit ladder's top rung REPLACES the capacity in the
@@ -259,6 +271,10 @@ def job_params(spec: JobSpec):
         packed=c["packed"],
         prefetch_depth=c["prefetch_depth"],
         bucket_ladder=_normalized_ladder(c),
+        # "auto" -> None: the worker resolves the mesh within its own
+        # device pool (run_slice pops this key; it is not a
+        # stream_call_consensus kwarg)
+        mesh=None if c["mesh"] == "auto" else int(c["mesh"]),
         mate_aware=c["mate_aware"],
         max_reads=c["max_reads"],
         per_base_tags=bool(c["per_base_tags"]),
@@ -284,6 +300,14 @@ def serve_provenance(config: dict) -> str:
     for key, default in CONFIG_DEFAULTS.items():  # canonical flag order
         val = merged[key]
         if val == default:
+            continue
+        if key == "mesh":
+            # device count provably cannot change output bytes (the
+            # mesh byte-identity contract: chunk order is commit order
+            # and pad buckets emit nothing), and the daemon may resolve
+            # it against ITS device pool — embedding it in the @PG CL
+            # would make job bytes depend on serving topology, breaking
+            # bytes == f(input, config). Excluded like bucket_ladder.
             continue
         if key == "bucket_ladder":
             # the ladder is a SHAPE knob that provably cannot change
@@ -325,8 +349,12 @@ def spec_signature(spec: JobSpec) -> str:
         ladder = c["bucket_ladder"]
     if isinstance(ladder, (list, tuple)):
         ladder = ",".join(str(x) for x in ladder)
+    # mesh joins the compile identity: GSPMD partitions the same
+    # program differently per device count, so jobs only share XLA
+    # executables when their mesh agrees ("auto" jobs share the
+    # daemon's resolved pool, hence the auto token)
     return "|".join(
         str(c[k])
         for k in ("capacity", "grouping", "mode", "error_model",
                   "per_base_tags")
-    ) + f"|ladder={ladder}"
+    ) + f"|ladder={ladder}|mesh={c['mesh']}"

@@ -132,6 +132,7 @@ class ConsensusService:
         heartbeat_s: float = 0.0,
         trace_path: str | None = None,
         n_devices: int | None = None,
+        device_indices: list[int] | None = None,
         lease_s: float = LEASE_DEFAULT_S,
         class_depths: dict | None = None,
         daemon_id: str | None = None,
@@ -168,7 +169,13 @@ class ConsensusService:
         self.queue.admission_policy = (
             lambda jobs, spec: self.sched.shed_reason(jobs, spec.priority)
         )
-        self.worker = WarmWorker(n_devices=n_devices)
+        # device_indices pins this daemon's slices to a local-device
+        # subset (dut-serve --devices 0,1): a fleet on one host can
+        # partition the chips so each daemon's jobs own real devices —
+        # mesh size then resolves within the subset
+        self.worker = WarmWorker(
+            n_devices=n_devices, devices=device_indices
+        )
         # fleet-shared tuner verdicts (tuning/store.py): auto-ladder
         # jobs consult/persist per-input-profile bucket-shape verdicts
         # through the spool, so every daemon serving this traffic mix

@@ -157,8 +157,11 @@ def _ckpt_done_count(out_path: str) -> int:
 class WarmWorker:
     """Executes slices; owns the warm-compile bookkeeping."""
 
-    def __init__(self, n_devices: int | None = None):
+    def __init__(self, n_devices: int | None = None, devices=None):
         self.n_devices = n_devices
+        # local-device index subset this worker's slices run on
+        # (dut-serve --devices pinning); None = all local devices
+        self.devices = list(devices) if devices else None
         self._lock = threading.Lock()
         self._warm_specs: set[str] = set()
         self._job_plans: dict[str, faults.FaultPlan] = {}
@@ -280,6 +283,14 @@ class WarmWorker:
         )
 
         gp, cp, kwargs = job_params(spec)
+        # job-level mesh (config "mesh": device count, "auto" = None):
+        # an explicit job mesh wins over the daemon's default count;
+        # both resolve within the daemon's pinned device subset, and an
+        # over-subscription (mesh 8 on a 2-device daemon) fails the job
+        # with the executor's clear requested-vs-have error. Mesh size
+        # never changes job bytes (the mesh byte-identity contract), so
+        # serve_provenance deliberately excludes it from the @PG CL.
+        job_mesh = kwargs.pop("mesh", None)
         if spec.shard is not None:
             # shard sub-job (serve/shard/): run the planner's range on
             # the parent's whole-file chunk grid. The overrides ride
@@ -415,7 +426,8 @@ class WarmWorker:
                 spec.output,
                 gp,
                 cp,
-                n_devices=self.n_devices,
+                n_devices=job_mesh or self.n_devices,
+                devices=self.devices,
                 resume=True,
                 progress=progress,
                 commit_guard=commit_guard,

@@ -63,6 +63,7 @@ __all__ = [
     "KNOWN_XFER_DIRS", "SUMMARY_BYTE_KEYS", "xfer_records", "byte_totals",
     "bandwidth_stats", "wire_floor", "packing_stats", "per_chunk_bytes",
     "summary_bytes", "sum_check_bytes", "output_check", "fill_stats",
+    "device_lanes",
 ]
 
 # summary["bytes"] keys the executor embeds (all integers; *_logical
@@ -274,6 +275,38 @@ def per_chunk_bytes(records: list[dict]) -> dict[int, dict]:
     return dict(sorted(out.items()))
 
 
+def device_lanes(records: list[dict]) -> dict[str, dict]:
+    """Per-device wire attribution of a mesh run: h2d/d2h wire and
+    logical byte sums plus mesh-pad bucket counts grouped by the
+    ``dev-N`` lanes the mesh-aware dispatch emits its per-device
+    ledger records on. {} for single-device (or pre-mesh) captures —
+    their records ride thread lanes, not device lanes."""
+    out: dict[str, dict] = {}
+    for rec in xfer_records(records):
+        lane = rec.get("lane", "")
+        if not isinstance(lane, str) or not lane.startswith("dev-"):
+            continue
+        d = out.setdefault(
+            lane,
+            {"h2d_wire": 0, "h2d_logical": 0, "d2h_wire": 0,
+             "d2h_logical": 0, "mesh_pad": 0, "n": 0},
+        )
+        direction = rec.get("dir")
+        if direction not in ("h2d", "d2h"):
+            continue
+        d["n"] += 1
+        d[f"{direction}_wire"] += int(rec.get("wire", 0))
+        if _is_num(rec.get("logical")):
+            d[f"{direction}_logical"] += int(rec["logical"])
+        if direction == "h2d" and _is_num(rec.get("mesh_pad")):
+            d["mesh_pad"] += int(rec["mesh_pad"])
+    # lanes sort numerically (dev-10 after dev-9)
+    return dict(
+        sorted(out.items(), key=lambda kv: int(kv[0].split("-", 1)[1])
+               if kv[0].split("-", 1)[1].isdigit() else 1 << 30)
+    )
+
+
 def fill_stats(records: list[dict]) -> dict:
     """Bucket fill-factor view of a capture (the padding the tuner
     exists to cut): real read rows vs padded row-slots summed from the
@@ -282,11 +315,15 @@ def fill_stats(records: list[dict]) -> dict:
     integer equality, one-sided under recorder truncation, skipped on
     captures whose summary predates the counters. Returns {} for
     pre-tuner captures (no rows attrs anywhere)."""
-    rows_real = rows_pad = 0
+    rows_real = rows_pad = mesh_pad = 0
+    saw_mesh = False
     for rec in xfer_records(records):
         if rec.get("dir") == "h2d" and _is_num(rec.get("rows_pad")):
             rows_real += int(rec.get("rows_real", 0))
             rows_pad += int(rec["rows_pad"])
+            if _is_num(rec.get("mesh_pad")):
+                saw_mesh = True
+                mesh_pad += int(rec["mesh_pad"])
     if not rows_pad:
         return {}
     out = {
@@ -294,6 +331,8 @@ def fill_stats(records: list[dict]) -> dict:
         "rows_pad": rows_pad,
         "fill_factor": round(rows_real / rows_pad, 4),
     }
+    if saw_mesh:
+        out["mesh_pad_buckets"] = mesh_pad
     s = summary_record(records) or {}
     counters = s.get("counters") or {}
     want_real = counters.get("n_rows_real")
@@ -304,6 +343,16 @@ def fill_stats(records: list[dict]) -> dict:
             ok = rows_real <= int(want_real) and rows_pad <= int(want_pad)
         else:
             ok = rows_real == int(want_real) and rows_pad == int(want_pad)
+        # the mesh-pad twin of the fill check: per-record mesh_pad
+        # attrs vs the summary's n_mesh_pad_buckets counter — exact,
+        # one-sided under truncation, skipped on pre-mesh captures
+        # (no counter or no attrs anywhere)
+        want_mesh = counters.get("n_mesh_pad_buckets")
+        if saw_mesh and _is_num(want_mesh):
+            ok &= (
+                mesh_pad <= int(want_mesh) if dropped
+                else mesh_pad == int(want_mesh)
+            )
         out["sum_check_ok"] = ok
     return out
 

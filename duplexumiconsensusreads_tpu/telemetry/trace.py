@@ -59,6 +59,10 @@ KNOWN_STAGES = (
     "ingest",  # rolling BGZF read + native inflate + chunk parse (main)
     "bucketing",  # build_buckets on the parsed chunk (main)
     "dispatch",  # stack/pack/device_put (xfer worker; drain on retry)
+    "mesh_h2d",  # per-device H2D puts of a multi-device dispatch: one
+    # span per device on its "dev-N" lane, emitted from inside the
+    # dispatch body (same threads as "dispatch", whose busy time
+    # excludes these windows); 0 on single-device runs
     "device_wait_fetch",  # device execution wait + d2h materialise (drain)
     "scatter",  # scatter-back to batch coordinates (drain)
     "deflate",  # BGZF-compress the shard's record stream (drain)
@@ -140,7 +144,21 @@ KNOWN_XFER_DIRS = (
 #   rows_real  real read rows in the dispatch (bucket fill numerator)
 #   rows_pad   padded row-slots dispatched (capacity x padded buckets)
 #   cap        the dispatch class's bucket capacity (its ladder rung)
-KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap")
+#   mesh_pad   mesh-alignment pad buckets in this dispatch (slice):
+#              empty buckets appended so the class's bucket count is a
+#              device-count multiple — they cross the wire, so they are
+#              ledgered; the per-record sums must reproduce the summary
+#              counter n_mesh_pad_buckets exactly (wirestat checks)
+KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap", "mesh_pad")
+
+# Literal lane ids/prefixes a recording site may pass as ``lane=``.
+# Most lanes derive from thread names (current_lane: main / xfer-N /
+# drain-N) and are never literals; the two literal families are the
+# service's per-job lanes and the mesh dispatch's per-device lanes.
+# dutlint's phase-registry rule pins every literal ``lane=`` argument
+# (f-string prefixes included) to this registry, so a typo'd lane
+# family cannot silently fork the capture schema consumers group by.
+KNOWN_LANE_PREFIXES = ("main", "xfer-", "drain-", "job-", "dev-")
 
 
 def current_lane() -> str:

@@ -382,7 +382,13 @@ def race_ssc_methods(
         classes = [
             (
                 cspec if t is None else _dc.replace(cspec, blockseg_t=t),
-                shard_stacked(stack_buckets(cb, multiple_of=1), mesh),
+                # pad each class's bucket count to the mesh size, the
+                # same discipline as the executors' dispatch — an
+                # uneven count is a sharding error on a real mesh
+                shard_stacked(
+                    stack_buckets(cb, multiple_of=mesh.devices.size),
+                    mesh,
+                ),
             )
             for cb, cspec in part
         ]

@@ -1,14 +1,29 @@
-"""Test env: force CPU platform with 8 virtual devices BEFORE backend init.
+"""Test env: force CPU platform with VIRTUAL DEVICES before backend init.
 
-This mirrors the driver's multi-chip dry-run: all sharding tests run on
-a virtual 8-device CPU mesh; the same code paths hit real TPU chips in
-production (see parallel/mesh.py).
+This mirrors the driver's multi-chip dry-run: the suite runs on a
+virtual 2-device CPU mesh, so every streaming/serve/chaos test
+exercises REAL mesh-sharded execution (per-device H2D puts, per-shard
+packed-D2H compaction, mesh-pad ledgering) — the same code paths hit
+real TPU chips in production (see parallel/mesh.py and
+parallel/sharded.py's shard_map form).
 
-NOTE: this environment pre-imports jax at interpreter startup, so
-setting JAX_PLATFORMS via os.environ here is too late — the config
-default was already captured. jax.config.update still works because the
-backend itself is initialised lazily on first use. Set DUT_TEST_TPU=1
-to run the suite against the real chip instead.
+Two devices, not eight, as the default: 2 is the smallest real mesh
+(every multi-device invariant — even sharding, pad buckets, per-device
+lanes, collective-freedom — is exercised), while 8-way SPMD on a CPU
+multiplies every tiny test's per-dispatch overhead several-fold.
+tests/test_mesh.py covers the 8-device legs of the byte-identity
+matrix (DUT_TEST_DEVICES=8 runs them in-process; its subprocess test
+covers them in the default run), and the driver's multichip entry runs
+the real 8-device consensus.
+
+NOTE: this environment pre-imports jax at interpreter startup, so the
+config must be applied before FIRST BACKEND USE, not first import.
+jax.config.update("jax_platforms") still works because the backend is
+initialised lazily; the device count rides XLA_FLAGS, which the CPU
+client reads at that same lazy init (jax.config's own
+jax_num_cpu_devices knob does not exist on this jax version — it was
+tried here and silently left the suite on one device). Set
+DUT_TEST_TPU=1 to run the suite against the real chip instead.
 """
 
 import os
@@ -16,10 +31,18 @@ import os
 import jax
 
 if not os.environ.get("DUT_TEST_TPU"):
+    n_dev = int(os.environ.get("DUT_TEST_DEVICES", "2"))
+    flag = f"--xla_force_host_platform_device_count={n_dev}"
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag
+        ).strip()
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
     except Exception:
-        # backend already initialised (pre-provisioned via XLA_FLAGS or a
-        # plugin touching jax.devices() first) — run on whatever exists
+        # backend already initialised (pre-provisioned via XLA_FLAGS or
+        # a plugin touching jax.devices() first) — run on whatever
+        # exists
         pass

@@ -362,6 +362,14 @@ TRACE_XFER_ATTRS = """
     KNOWN_XFER_DIRS = ("h2d", "d2h", "shard")
     KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap")
     """
+TRACE_LANES = """
+    KNOWN_STAGES = ("ingest", "finalise")
+    KNOWN_EVENTS = ("retry",)
+    KNOWN_XFER_DIRS = ("h2d", "d2h", "shard")
+    KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap",
+                            "mesh_pad")
+    KNOWN_LANE_PREFIXES = ("main", "xfer-", "drain-", "job-", "dev-")
+    """
 FLEET_OK = """
     FLEET_SEGMENT_KINDS = ("run", "split")
     FLEET_GAP_KINDS = ("queue_wait", "takeover")
@@ -506,6 +514,74 @@ class TestPhaseRegistry:
                 phase = {"ingest": 0.0, "finalise": 0.0}
                 if tr is not None:
                     tr.xfer("h2d", 0, 0, 0.0, 0.0, anything_goes=1)
+            """,
+        })
+        assert legacy.ok
+
+    def test_fires_on_unregistered_mesh_pad_attr(self):
+        """mesh_pad is an h2d schema attr like bpc/rows_*: emitting it
+        against a pre-mesh registry (no mesh_pad entry) is the drift
+        the registry exists to catch; the current registry passes."""
+        emit = """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.xfer("h2d", 0, 0, 0.0, 0.0, chunk=1, bpc=8,
+                            rows_real=5, rows_pad=8, cap=8, mesh_pad=1)
+            """
+        res = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_XFER_ATTRS,  # pre-mesh
+            "pkg/runtime/stream.py": emit,
+        })
+        assert any("mesh_pad" in f.message for f in res.findings)
+        ok = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_LANES,
+            "pkg/runtime/stream.py": emit,
+        })
+        assert ok.ok
+
+    def test_fires_on_unregistered_literal_lane(self):
+        """A literal lane family outside KNOWN_LANE_PREFIXES forks the
+        grouping key the device table / fleet stitcher / chrome export
+        key on — plain literals, f-string prefixes, and unpinnable
+        placeholder-first f-strings all fire."""
+        res = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_LANES,
+            "pkg/runtime/stream.py": """
+            def run(tr, di, x):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.span("ingest", 0.0, 1.0, lane="gpu-0")
+                    tr.xfer("h2d", 0, 0, 0.0, 0.0, lane=f"chip{di}")
+                    tr.event("retry", lane=f"{x}-lane")
+            """,
+        })
+        msgs = " | ".join(f.message for f in res.findings)
+        assert "gpu-0" in msgs and "chip" in msgs
+        assert sum("lane" in f.message for f in res.findings) >= 3
+
+    def test_passes_on_registered_lanes_and_pre_mesh_corpora(self):
+        ok = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_LANES,
+            "pkg/runtime/stream.py": """
+            def run(tr, di, lane):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.span("ingest", 0.0, 1.0, lane="main")
+                    tr.span("ingest", 0.0, 1.0, lane=f"dev-{di}")
+                    tr.event("retry", lane=f"job-{di}")
+                    tr.xfer("h2d", 0, 0, 0.0, 0.0, lane=lane)
+            """,
+        })
+        assert ok.ok
+        # no KNOWN_LANE_PREFIXES registry (pre-mesh trees): skip
+        legacy = self.base(**{
+            "pkg/telemetry/trace.py": TRACE_XFER_ATTRS,
+            "pkg/runtime/stream.py": """
+            def run(tr):
+                phase = {"ingest": 0.0, "finalise": 0.0}
+                if tr is not None:
+                    tr.span("ingest", 0.0, 1.0, lane="anything-goes")
             """,
         })
         assert legacy.ok

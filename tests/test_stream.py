@@ -378,7 +378,10 @@ class TestFaultRecovery:
         ref = str(tmp_path / "ref.bam")
         rep0 = stream_call_consensus(path, ref, gp, cp, **kw)
 
-        real = sharded.sharded_pipeline
+        # presharded_pipeline is THE dispatch seam: the 1-device path
+        # reaches it through sharded_pipeline and the multi-device path
+        # calls it directly after its per-device puts
+        real = sharded.presharded_pipeline
         calls = {"n": 0}
 
         def flaky(*a, **k):
@@ -387,7 +390,7 @@ class TestFaultRecovery:
                 raise RuntimeError("injected device failure")
             return real(*a, **k)
 
-        monkeypatch.setattr(sharded, "sharded_pipeline", flaky)
+        monkeypatch.setattr(sharded, "presharded_pipeline", flaky)
         monkeypatch.setattr(
             "duplexumiconsensusreads_tpu.runtime.stream.time.sleep",
             lambda s: None,
@@ -411,14 +414,16 @@ class TestFaultRecovery:
         path, _, _ = self._sim(tmp_path)
         gp = GroupingParams(strategy="adjacency", paired=True)
         cp = ConsensusParams(mode="duplex")
-        real = sharded.sharded_pipeline
+        real = sharded.presharded_pipeline
 
         def multi_bucket_fails(stacked, spec, mesh, *a, **k):
             if stacked["pos"].shape[0] > 1:
                 raise RuntimeError("injected: stacked dispatch down")
             return real(stacked, spec, mesh, *a, **k)
 
-        monkeypatch.setattr(sharded, "sharded_pipeline", multi_bucket_fails)
+        monkeypatch.setattr(
+            sharded, "presharded_pipeline", multi_bucket_fails
+        )
         monkeypatch.setattr(
             "duplexumiconsensusreads_tpu.runtime.stream.time.sleep",
             lambda s: None,
@@ -443,7 +448,7 @@ class TestFaultRecovery:
         def dead(*a, **k):
             raise RuntimeError("injected: device gone")
 
-        monkeypatch.setattr(sharded, "sharded_pipeline", dead)
+        monkeypatch.setattr(sharded, "presharded_pipeline", dead)
         monkeypatch.setattr(
             "duplexumiconsensusreads_tpu.runtime.stream.time.sleep",
             lambda s: None,

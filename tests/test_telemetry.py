@@ -346,9 +346,12 @@ class TestByteLedger:
             elif r["dir"] == "h2d":
                 # bpc joined with the wire-diet-v2 packing ladder;
                 # rows_real/rows_pad/cap with the bucket auto-tuner's
-                # fill-factor audit trail (wirestat's fill column)
+                # fill-factor audit trail (wirestat's fill column);
+                # mesh_pad with mesh-sharded execution (the alignment
+                # pad buckets this dispatch shipped)
                 assert set(r) == base | {
                     "logical", "bpc", "rows_real", "rows_pad", "cap",
+                    "mesh_pad",
                 }
                 assert r["bpc"] in (16, 8, 7, 5)
                 assert 0 <= r["rows_real"] <= r["rows_pad"]
@@ -834,7 +837,7 @@ class TestReportShape:
             "n_projection_unanchored_reads", "n_umi_corrected",
             "n_dropped_whitelist", "mate_aware", "backend",
             "bytes_h2d", "bytes_d2h", "n_rows_real", "n_rows_padded",
-            "bucket_ladder", "seconds",
+            "n_mesh_pad_buckets", "bucket_ladder", "seconds",
         }
         assert {f.name for f in dataclasses.fields(RunReport)} == golden
 
@@ -844,7 +847,8 @@ class TestReportShape:
         phases dict all key on it)."""
         _, rep, _ = traced
         assert set(rep["seconds"]) == {
-            "ingest", "bucketing", "dispatch", "device_wait_fetch",
+            "ingest", "bucketing", "dispatch", "mesh_h2d",
+            "device_wait_fetch",
             "scatter", "deflate", "shard_write", "ckpt", "finalise",
             "main_loop_stall", "prefetch_stall", "drain_utilization",
             "total",

@@ -86,6 +86,7 @@ def main(argv: list[str] | None = None) -> int:
             "packing": ledger.packing_stats(records, totals=totals),
             "chunks": ledger.per_chunk_bytes(records),
             "fill": fill,
+            "devices": ledger.device_lanes(records),
             "summary_bytes": ledger.summary_bytes(records),
             "sum_check": {"ok": sum_ok, "rows": rows},
             "output_check": {"ok": disk_ok, "problems": disk_problems},
@@ -136,12 +137,29 @@ def main(argv: list[str] | None = None) -> int:
                 f"{direction:<6} n={t['n']:<5} logical={t['logical']:,} "
                 f"wire={t['wire']:,} busy={t['busy_s']:.3f}s{extra}"
             )
+        devs = ledger.device_lanes(records)
+        if devs:
+            # the mesh view: which device's share of the tunnel each
+            # direction paid, and the mesh-alignment padding it shipped
+            print(
+                f"{'device':>8} {'h2d_wire':>12} {'d2h_wire':>12} "
+                f"{'mesh_pad':>9}"
+            )
+            for lane, d in devs.items():
+                print(
+                    f"{lane:>8} {_fmt_bytes(d['h2d_wire']):>12} "
+                    f"{_fmt_bytes(d['d2h_wire']):>12} {d['mesh_pad']:>9}"
+                )
         if fill:
             verdict = "" if fill_ok else "  SUM-CHECK FAIL"
+            mesh = (
+                f" mesh_pad_buckets={fill['mesh_pad_buckets']:,}"
+                if "mesh_pad_buckets" in fill else ""
+            )
             print(
                 f"fill: rows_real={fill['rows_real']:,} "
                 f"rows_pad={fill['rows_pad']:,} "
-                f"fill_factor={fill['fill_factor']}{verdict}"
+                f"fill_factor={fill['fill_factor']}{mesh}{verdict}"
             )
         pack = ledger.packing_stats(records, totals=totals)
         if pack:
