@@ -22,6 +22,7 @@ from duplexumiconsensusreads_tpu.analysis.engine import (
     ancestors,
     call_name,
     enclosing_function,
+    expr_path,
     guarded_not_none,
     inside_lock_body,
     register,
@@ -923,9 +924,21 @@ def check_deadline_discipline(corpus: Corpus) -> Iterator[Finding]:
             line = _monotonic_stamp_assign_line(fn)
             if line is None:
                 continue
+            # a lease-store clock read (``store.now()`` /
+            # ``store.capture_epoch()``) counts as a monotonic
+            # derivation: the store IS the stamp clock (local = machine
+            # monotonic; sharedfs = the calibrated fs clock), and
+            # forcing raw time.monotonic() back into those functions
+            # would undo exactly the domain seam host-locality guards
             mentions = any(
                 (isinstance(n, ast.Attribute) and n.attr == "monotonic")
                 or (isinstance(n, ast.Name) and "monotonic" in n.id)
+                or (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("now", "capture_epoch")
+                    and "store" in (expr_path(n.func.value) or "")
+                )
                 for n in ast.walk(fn)
             )
             if not mentions:
@@ -2042,3 +2055,161 @@ def check_exception_contract(corpus: Corpus) -> Iterator[Finding]:
                         "broad retry handler",
                     )
                     break
+
+
+# ---------------------------------------------- rule: host locality
+
+# The primitives the LOCAL lease backend stands on — pid-liveness
+# probes and raw machine-monotonic readings compared against journal
+# stamps — are exactly the operations that silently lie on a
+# shared-filesystem spool: a pid is only meaningful on the host that
+# spawned it, and two hosts' time.monotonic() epochs are unrelated
+# numbers. The store seam (serve/store.py) exists so those operations
+# have ONE home; this rule keeps them from leaking back into the
+# serving layer, where they would work perfectly in every single-host
+# test and corrupt the first multi-host deployment.
+_XHOST_SITES = ("serve.hb", "serve.store")
+
+
+@register(
+    "host-locality",
+    "pid-liveness probes and raw monotonic-vs-journal-stamp arithmetic "
+    "are confined to the lease-store backend; the cross-host I/O sites "
+    "are registered",
+)
+def check_host_locality(corpus: Corpus) -> Iterator[Finding]:
+    """Four checks, each a way single-host assumptions re-enter serve/:
+
+    (a) PID LIVENESS: ``serve/`` code outside ``serve/store.py`` must
+        not call ``os.kill`` or ``_pid_alive`` — liveness belongs to
+        the store (``store.pid_alive``/``store.observe``), which is the
+        only place that knows whether a pid means anything on this
+        spool (``os.getpid()`` as an identity read stays legal);
+    (b) PID COMPARISON: comparing a journal record's ``"pid"`` field
+        is a liveness/ownership decision in disguise — on a sharedfs
+        spool two hosts can share a pid number, so the comparison
+        must go through the store's reclaim verdict;
+    (c) CLOCK-DOMAIN MIXING: an expression combining a direct
+        ``time.monotonic()`` reading with a ``*_m`` journal-key read
+        compares the local machine clock against the spool's stamp
+        domain — correct locally, garbage cross-host. Stamp
+        arithmetic must use ``store.now()`` (rule 8(b) accepts it as
+        the monotonic derivation);
+    (d) SITE REGISTRY: when the store backend exists, its two durable
+        I/O steps (``serve.hb`` heartbeat write, ``serve.store``
+        liveness scan) must be in runtime/faults.py KNOWN_SITES —
+        registration is what routes them into the chaos blanket that
+        proves the takeover ladders survive injected faults."""
+    scoped = [
+        p for p in corpus.trees
+        if "serve" in p.split("/")[:-1] and p.split("/")[-1] != "store.py"
+    ]
+
+    def _key_reads(node: ast.AST) -> Iterator[str]:
+        # literal dict-key reads: x["k"] subscripts and x.get("k")
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Subscript):
+                s = str_const(sub.slice)
+                if s is not None:
+                    yield s
+            elif (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "get"
+                and sub.args
+            ):
+                s = str_const(sub.args[0])
+                if s is not None:
+                    yield s
+
+    def _reads_monotonic(node: ast.AST) -> bool:
+        return any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "monotonic"
+            for sub in ast.walk(node)
+        )
+
+    for path in scoped:
+        flagged: set[tuple[str, int]] = set()
+        for node in ast.walk(corpus.trees[path]):
+            # (a) pid-liveness probes
+            if isinstance(node, ast.Call):
+                callee = expr_path(node.func)
+                if callee == "os.kill" or call_name(node) == "_pid_alive":
+                    yield Finding(
+                        rule="host-locality",
+                        path=path,
+                        line=node.lineno,
+                        message=f"pid-liveness probe "
+                        f"({callee or call_name(node)}) outside the "
+                        f"lease-store backend",
+                        hint="route liveness through the store seam "
+                        "(store.pid_alive / store.observe / "
+                        "store.reclaim_reason) — a pid only means "
+                        "anything on the host that spawned it",
+                    )
+                continue
+            if not isinstance(node, (ast.Compare, ast.BinOp)):
+                continue
+            keys = set(_key_reads(node))
+            # (b) ownership decisions off a journal "pid" field
+            if (
+                isinstance(node, ast.Compare)
+                and "pid" in keys
+                and ("pid", node.lineno) not in flagged
+            ):
+                flagged.add(("pid", node.lineno))
+                yield Finding(
+                    rule="host-locality",
+                    path=path,
+                    line=node.lineno,
+                    message="comparison against a journal 'pid' field "
+                    "outside the lease-store backend",
+                    hint="pid ownership checks are liveness decisions — "
+                    "they belong to store.reclaim_reason, where the "
+                    "backend knows whether pids are comparable on "
+                    "this spool",
+                )
+            # (c) machine clock vs stamp-domain arithmetic
+            if (
+                _reads_monotonic(node)
+                and any(k.endswith("_m") for k in keys)
+                and ("mono", node.lineno) not in flagged
+            ):
+                flagged.add(("mono", node.lineno))
+                yield Finding(
+                    rule="host-locality",
+                    path=path,
+                    line=node.lineno,
+                    message="time.monotonic() compared/combined with a "
+                    "*_m journal stamp",
+                    hint="journal stamps live in the spool store's clock "
+                    "domain — use store.now() for the other operand "
+                    "(on a sharedfs spool the machine clock is an "
+                    "unrelated epoch)",
+                )
+    # (d) cross-host I/O sites registered (only once the backend exists:
+    # the pre-fleet fixture corpora in tests/test_lint.py have no
+    # serve/store.py and owe no sites)
+    if corpus.find("serve/store.py") is None:
+        return
+    faults_anchor = corpus.find("runtime/faults.py")
+    if faults_anchor is None:
+        return
+    sites, sites_line = str_tuple_assign(
+        corpus.trees[faults_anchor], "KNOWN_SITES"
+    )
+    for site in _XHOST_SITES:
+        if site not in sites:
+            yield Finding(
+                rule="host-locality",
+                path=faults_anchor,
+                line=sites_line or 1,
+                message=f"cross-host fleet site {site!r} is not "
+                f"registered in KNOWN_SITES",
+                hint="register it — the chaos blanket "
+                "(tests/test_chaos.py) exercises every registered "
+                "site, which is what proves the pid-free takeover "
+                "ladders survive injected faults",
+            )

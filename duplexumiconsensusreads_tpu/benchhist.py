@@ -79,6 +79,12 @@ CANONICAL_METRICS = (
     # never gated
     ("serve_shard_speedup", True, False),
     ("serve_shard_merge_s", False, False),
+    # cross-host fleet (sharedfs lease store): takeover latency is
+    # lease-expiry-dominated by design (pid-free detection waits out
+    # the translated lease, never probes a pid) and the recovery count
+    # is a scenario invariant — informational, never gated
+    ("serve_xhost_takeover_latency_s", False, False),
+    ("serve_xhost_recovered", True, False),
     # mesh-sharded execution (real multi-device consensus): the e2e
     # leg's resolved device count and the K-vs-1 wall ratio of the
     # mesh-scaling A/B — informational, never gated (simulated CPU

@@ -86,6 +86,7 @@ COMPACT_KEYS = (
     "serve_amortised_speedup", "serve_fleet_takeover_latency_s",
     "serve_quarantine_after_crashes", "serve_watchdog_detect_latency_s",
     "serve_shard_speedup", "serve_shard_merge_s",
+    "serve_xhost_takeover_latency_s", "serve_xhost_recovered",
     "fleet_e2e_p95_s", "fleet_takeover_gap_s",
 )
 
@@ -765,6 +766,131 @@ def run_serve_fleet_bench(n_daemons: int) -> dict:
             out["serve_fleet_stitch_problems"] = stitched["problems"][:5]
     except Exception as e:  # noqa: BLE001 — the bench must still report
         out["serve_fleet_stitch_error"] = repr(e)[:200]
+    return out
+
+
+def run_serve_xhost_bench() -> dict:
+    """The ``serve_xhost`` sub-leg: the serve_fleet takeover scenario
+    re-run CROSS-HOST — two synthetic hosts on one sharedfs-store
+    spool (distinct host ids, ±1h monotonic epoch skews the probe
+    calibration must cancel), host A killed mid-slice. Detection is
+    translated lease expiry — never a pid probe — so the latency is
+    lease_s-dominated by design; the number characterises the pid-free
+    takeover path, not throughput (informational, non-gating).
+
+      serve_xhost_takeover_latency_s  victim death -> its job running
+                                      (or done) under host B's lease
+      serve_xhost_recovered           takeovers host B journaled
+    """
+    import shutil
+    import threading
+
+    from duplexumiconsensusreads_tpu.runtime import faults
+    from duplexumiconsensusreads_tpu.serve import ConsensusService, client
+    from duplexumiconsensusreads_tpu.serve.queue import SpoolQueue
+    from duplexumiconsensusreads_tpu.serve.store import resolve_store
+
+    cache = os.environ.get("DUT_BENCH_CACHE", ".bench_cache")
+    n_reads = int(os.environ.get("DUT_BENCH_SERVE_READS", 120_000))
+    in_path, _ = _e2e_input(n_reads)
+    config = dict(
+        grouping="adjacency", mode="duplex", error_model="cycle",
+        capacity=int(os.environ.get("DUT_BENCH_CAPACITY", 2048)),
+        chunk_reads=max(n_reads // 4, 10_000),
+    )
+    spool = os.path.join(cache, "serve_xhost_spool")
+    shutil.rmtree(spool, ignore_errors=True)
+    lease_s = 2.0
+    store_a = resolve_store(spool, "sharedfs", pin=True,
+                            host_id="bench-host-A", epoch_skew=3600.0)
+    outs = [
+        os.path.join(cache, f"serve_xhost_out{i}.bam") for i in range(2)
+    ]
+    for o in outs:
+        client.submit(spool, in_path, o, config=config)
+    out: dict = {"serve_xhost_hosts": 2, "serve_xhost_lease_s": lease_s}
+
+    victim = ConsensusService(
+        spool, chunk_budget=0, poll_s=0.02, lease_s=lease_s,
+        daemon_id="xhost-victim", store=store_a,
+        trace_path=os.path.join(
+            spool, "service.xhost-victim.trace.jsonl"
+        ),
+    )
+    orig_run_slice = victim.worker.run_slice
+
+    def dying_run_slice(spec, budget, should_yield, drain_event,
+                        lease=None):
+        # one fresh chunk commits, then the yield check kills host A
+        # with the lease still journaled — the modelled SIGKILL
+        def die():
+            raise faults.InjectedKill("serve_xhost: host A killed")
+
+        return orig_run_slice(spec, 1, die, drain_event, lease=lease)
+
+    victim.worker.run_slice = dying_run_slice
+    t_dead = [0.0]
+
+    def run_victim():
+        try:
+            victim.run_until_idle()
+        except BaseException:  # noqa: BLE001 — the injected death
+            t_dead[0] = time.monotonic()
+
+    vt = threading.Thread(target=run_victim, daemon=True)
+    vt.start()
+    vt.join(timeout=600)
+    if vt.is_alive() or not t_dead[0]:
+        return {**out,
+                "serve_xhost_error": "victim did not die on schedule"}
+    q = SpoolQueue(spool)
+    q.refresh()
+    running = [
+        jid for jid, e in q.jobs.items() if e.get("state") == "running"
+    ]
+    if not running:
+        return {**out,
+                "serve_xhost_error": "victim died holding no lease"}
+    jid0 = running[0]
+
+    store_b = resolve_store(spool, "sharedfs",
+                            host_id="bench-host-B", epoch_skew=-3600.0)
+    survivor = ConsensusService(
+        spool, chunk_budget=0, poll_s=0.02, lease_s=lease_s,
+        daemon_id="xhost-b", store=store_b,
+        trace_path=os.path.join(spool, "service.xhost-b.trace.jsonl"),
+    )
+    st = threading.Thread(target=survivor.run_until_idle, daemon=True)
+    st.start()
+    takeover = None
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        q.refresh()
+        e = q.jobs.get(jid0, {})
+        if e.get("state") == "done" or (
+            e.get("state") == "running"
+            and (e.get("lease") or {}).get("owner") != "xhost-victim"
+        ):
+            takeover = time.monotonic() - t_dead[0]
+            break
+        time.sleep(0.005)
+    st.join(timeout=600)
+    q.refresh()
+    n_done = sum(1 for e in q.jobs.values() if e.get("state") == "done")
+    for o in outs:
+        try:
+            os.remove(o)
+        except OSError:
+            pass
+    if n_done != len(outs):
+        return {**out, "serve_xhost_error":
+                f"fleet finished {n_done}/{len(outs)} jobs"}
+    out.update({
+        "serve_xhost_takeover_latency_s": (
+            round(takeover, 3) if takeover is not None else None
+        ),
+        "serve_xhost_recovered": survivor.counters["jobs_recovered"],
+    })
     return out
 
 
@@ -1499,6 +1625,11 @@ def main() -> None:
             # scatter-gather sub-leg: one large job at K=1 vs K=4
             # across the same fleet (informational, non-gating)
             result.update(run_serve_shard_bench(n_fleet))
+            # cross-host sub-leg: the takeover scenario on the
+            # sharedfs lease store — two synthetic hosts with skewed
+            # epochs; detection is translated lease expiry, never a
+            # pid probe (informational, non-gating)
+            result.update(run_serve_xhost_bench())
         # same pipeline end-to-end on XLA-CPU: the wall-clock >=50x
         # denominator (DUT_BENCH_CPU_E2E_READS=0 disables); runs after
         # every TPU leg so the 1-core box is never shared

@@ -70,6 +70,13 @@ KNOWN_SITES = (
     "serve.renew",  # lease renewal (heartbeat + per-chunk commit)
     "serve.expire",  # expired/dead-owner lease reclaim (takeover)
     "serve.fence",  # fencing-token check before a durable commit
+    # cross-host fleet (serve/store.py sharedfs backend): the durable
+    # liveness-document write and the reclaim sweep's document scan —
+    # the two I/O steps pid-free takeover stands on (both sites also
+    # fire on the local backend as no-op probes, so one chaos blanket
+    # covers both stores)
+    "serve.hb",  # durable per-daemon heartbeat document write
+    "serve.store",  # lease-store liveness scan feeding reclaim verdicts
     # defensive-serving spine: the deadline sweep/expiry commit and the
     # stuck-run watchdog's stall reclaim — both durable journal moves,
     # both chaos-targetable like every other lease-state transition

@@ -96,11 +96,18 @@ def status_document(st: dict) -> dict:
     """Normalize a :func:`status`/:func:`wait` answer into the stable
     machine-readable document ``call --status/--wait --json`` prints:
     state + reason + shards rollup + RELATIVE timestamps. The journal's
-    ``*_m`` stamps are raw CLOCK_MONOTONIC readings that mean nothing
-    off this host — external monitors get ages/countdowns instead
+    ``*_m`` stamps are raw stamp-clock readings that mean nothing off
+    their spool — external monitors get ages/countdowns instead
     (``admitted_age_s``, ``deadline_in_s``, ``progress_age_s``,
-    ``lease_expires_in_s``), computed against the same clock."""
-    now = time.monotonic()
+    ``lease_expires_in_s``), computed against the SAME clock: the
+    ``now_m`` the status read attached (the spool store's now — on a
+    sharedfs spool the client's own monotonic clock is the wrong
+    domain), falling back to local monotonic for pre-store answers."""
+    now_m = st.get("now_m")
+    if isinstance(now_m, (int, float)) and not isinstance(now_m, bool):
+        now = float(now_m)
+    else:
+        now = time.monotonic()
     doc: dict = {
         "job_id": st.get("job_id"),
         "state": st.get("state"),

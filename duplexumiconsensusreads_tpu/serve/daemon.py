@@ -13,13 +13,15 @@ exits 0. Restarting the daemon on the same spool resumes the queue and
 every interrupted job (checkpoint resume skips their committed chunks).
 
 FLEET MODE is just more daemons: start ``dut-serve SPOOL_DIR`` N times
-(same host — the journal's flock + monotonic lease clock scope a spool
-to one machine) and they coordinate through the journal's lease/claim
-protocol — each job runs under exactly one daemon's lease, a SIGKILLed
-daemon's jobs are taken over (immediately when its pid is provably
-dead, within ``--lease`` seconds otherwise) and resumed from their last
-durable checkpoint mark, and a zombie daemon is fenced off by its stale
-token before it can splice a byte.
+(same host under the default ``local`` lease store; N *hosts* sharing
+the spool over a shared filesystem with ``--store sharedfs``) and they
+coordinate through the journal's lease/claim protocol — each job runs
+under exactly one daemon's lease, a SIGKILLed daemon's jobs are taken
+over (``local``: immediately when its pid is provably dead, within
+``--lease`` seconds otherwise; ``sharedfs``: by translated lease
+expiry or a restarted/stale heartbeat document, never by pid) and
+resumed from their last durable checkpoint mark, and a zombie daemon
+is fenced off by its stale token before it can splice a byte.
 
 Submit work with ``duplexumi call IN -o OUT --submit --spool SPOOL_DIR``
 and follow it with ``call --status/--wait`` (or read
@@ -87,6 +89,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--daemon-id", default=None,
         help="fleet identity for lease ownership (default: a unique "
         "pid-derived id; override only for debugging)",
+    )
+    p.add_argument(
+        "--store", default=None, choices=("local", "sharedfs"),
+        help="lease-store backend for the spool: 'local' (flock + "
+        "pid-liveness + machine monotonic clock — one host per spool) "
+        "or 'sharedfs' (filesystem-calibrated shared clock + durable "
+        "heartbeat documents — N hosts may share the spool; takeover "
+        "by translated lease expiry, never by pid). Default: inherit "
+        "the spool's store.json pin, 'local' on a fresh spool. The "
+        "first daemon durably pins the choice; a later conflicting "
+        "--store fails loudly",
     )
     p.add_argument(
         "--deadline", type=float, default=0.0, metavar="SECONDS",
@@ -215,24 +228,31 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         raise SystemExit(str(e))
     os.makedirs(args.spool, exist_ok=True)
-    service = ConsensusService(
-        args.spool,
-        chunk_budget=args.chunk_budget,
-        max_queue=args.max_queue,
-        workers=args.workers,
-        poll_s=args.poll,
-        heartbeat_s=args.heartbeat,
-        trace_path=None if args.no_trace else args.trace,
-        n_devices=n_devices,
-        device_indices=device_indices,
-        lease_s=args.lease if args.lease is not None else LEASE_DEFAULT_S,
-        class_depths=class_depths,
-        daemon_id=args.daemon_id,
-        default_deadline_s=args.deadline,
-        watchdog_s=args.watchdog,
-        max_crashes=args.max_crashes,
-        min_free_bytes=args.min_free_mb << 20,
-    )
+    try:
+        service = ConsensusService(
+            args.spool,
+            chunk_budget=args.chunk_budget,
+            max_queue=args.max_queue,
+            workers=args.workers,
+            poll_s=args.poll,
+            heartbeat_s=args.heartbeat,
+            trace_path=None if args.no_trace else args.trace,
+            n_devices=n_devices,
+            device_indices=device_indices,
+            lease_s=(
+                args.lease if args.lease is not None else LEASE_DEFAULT_S
+            ),
+            class_depths=class_depths,
+            daemon_id=args.daemon_id,
+            default_deadline_s=args.deadline,
+            watchdog_s=args.watchdog,
+            max_crashes=args.max_crashes,
+            min_free_bytes=args.min_free_mb << 20,
+            store=args.store,
+        )
+    except ValueError as e:
+        # e.g. --store conflicting with the spool's store.json pin
+        raise SystemExit(str(e))
     if service.trace_path is None and not args.no_trace:
         # the default capture path is PER-DAEMON (it needs the resolved
         # daemon id, which the service generates): a shared default
@@ -259,6 +279,7 @@ def main(argv: list[str] | None = None) -> int:
         f"[dut-serve] serving {os.path.abspath(args.spool)} "
         f"(workers={args.workers}, chunk_budget={args.chunk_budget}, "
         f"max_queue={args.max_queue}, lease_s={service.lease_s}, "
+        f"store={service.store.kind}, "
         f"daemon_id={service.daemon_id}, pid={os.getpid()})",
         file=sys.stderr,
         flush=True,

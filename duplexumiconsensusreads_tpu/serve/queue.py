@@ -30,19 +30,33 @@ SIGKILLed holder releases it automatically — the lock can never outlive
 a crash the way journal state does.
 
 Leases: a job enters ``running`` only by CLAIMING it — the claiming
-transaction writes a lease entry (daemon id + pid/host, a monotonically
-increasing per-job FENCING TOKEN, and a monotonic-clock expiry) into
-the journal. Leases are renewed from the daemon's heartbeat and from
-every chunk commit; an expired lease — or one whose owner is provably
-dead — lets another daemon reclaim the job (queued again, original
-seq), resuming from the last durable checkpoint mark. The token is
-checked at every durable commit (chunk checkpoint mark via the
-executor's ``commit_guard``, result publish, every journal update by
-the slice), so a zombie daemon that wakes up after its job was
-reclaimed raises :class:`JobFenced` before splicing a single byte.
-Expiry uses ``time.monotonic()`` (machine-wide CLOCK_MONOTONIC), which
-makes lease arithmetic NTP-proof but scopes a spool to one host — the
-same scope flock already imposes.
+transaction writes a lease entry (daemon id + owner identity, a
+monotonically increasing per-job FENCING TOKEN, and a stamp-domain
+expiry) into the journal. Leases are renewed from the daemon's
+heartbeat and from every chunk commit; an expired lease — or one whose
+owner is provably dead — lets another daemon reclaim the job (queued
+again, original seq), resuming from the last durable checkpoint mark.
+The token is checked at every durable commit (chunk checkpoint mark
+via the executor's ``commit_guard``, result publish, every journal
+update by the slice), so a zombie daemon that wakes up after its job
+was reclaimed raises :class:`JobFenced` before splicing a single byte.
+
+WHICH clock stamps ``*_m`` fields and WHAT proves an owner dead are
+the spool's lease-store backend (serve/store.py, pinned per spool in
+``store.json``): ``local`` stamps machine-wide CLOCK_MONOTONIC and
+probes pids — NTP-proof, scoped to one host, today's exact semantics;
+``sharedfs`` stamps a filesystem-calibrated shared clock and reads
+durable heartbeat documents, so N hosts sharing the spool agree on
+expiry without ever probing a pid. Every ``SpoolQueue`` timestamp and
+liveness decision goes through ``self.store``; the fencing token —
+not the liveness oracle — remains the exactly-once authority in both.
+
+The journal lock is acquired with a bounded, jittered poll
+(:class:`JournalLockTimeout` past ``lock_timeout_s``): a wedged
+shared-filesystem flock must surface as a typed error plus a
+``lock_stall`` ledger event, not an invisible forever-block. The
+heartbeat document keeps beating while a transaction waits — beats
+never take the journal lock.
 
 Fault sites: ``serve.accept`` guards the read+parse+validate of each
 submission and ``serve.journal`` every durable journal persist (both
@@ -64,8 +78,7 @@ import contextlib
 import fcntl
 import json
 import os
-import socket
-import threading
+import random
 import time
 
 from duplexumiconsensusreads_tpu.io.durable import (
@@ -74,6 +87,7 @@ from duplexumiconsensusreads_tpu.io.durable import (
     write_durable,
 )
 from duplexumiconsensusreads_tpu.serve.job import JobSpec, validate_spec
+from duplexumiconsensusreads_tpu.serve.store import LeaseStore, resolve_store
 
 # the job state machine — states, legal transitions, and the derived
 # families — lives in serve/states.py (the single declared source of
@@ -120,7 +134,22 @@ DISK_LOW_WATER_BYTES = 64 << 20
 # making progress for this long — a real zombie, not a slow chunk.
 LEASE_DEFAULT_S = 30.0
 
-_HOST = socket.gethostname()
+# journal-lock acquisition bounds: a transaction that cannot take
+# journal.lock within the timeout raises JournalLockTimeout (an
+# OSError — the serving layer's I/O ladders absorb it like any other
+# transient and the heartbeat keeps running); past the stall threshold
+# ONE lock_stall event is ledgered so a wedged shared-filesystem lock
+# is visible long before the timeout fires
+LOCK_TIMEOUT_DEFAULT_S = 30.0
+LOCK_STALL_EVENT_S = 1.0
+
+
+class JournalLockTimeout(OSError):
+    """journal.lock could not be acquired within ``lock_timeout_s``.
+    OSError on purpose: every caller's retry/absorb ladder already
+    handles transient I/O failure, and a wedged lock (a dead NFS
+    client holding flock, a hung filesystem) must degrade the same
+    way — loudly typed, never an invisible forever-block."""
 
 
 class JobFenced(BaseException):
@@ -160,6 +189,36 @@ def _trace_tail(path: str, max_bytes: int = 8192, max_lines: int = 20):
     return [ln[:500] for ln in lines[-max_lines:]] or None
 
 
+def _capture_stitched_end(path: str) -> float | None:
+    """A service capture's END on the stitched fleet timeline:
+    ``meta.epoch_m`` (the recorder's stamp-domain start — the fleet
+    recorder's alignment key) plus the last record's relative ``t``.
+    None when the capture predates the fleet recorder (no numeric
+    epoch_m in the meta line) — those fall back to mtime ordering.
+    Read-only and bounded like :func:`_trace_tail`."""
+    try:
+        with open(path, "rb") as f:
+            head = f.readline(4096)
+        meta = json.loads(head.decode("utf-8", "replace"))
+    except (OSError, ValueError):
+        return None
+    if not isinstance(meta, dict) or meta.get("type") != "meta":
+        return None
+    epoch = meta.get("epoch_m")
+    if not isinstance(epoch, (int, float)) or isinstance(epoch, bool):
+        return None
+    last_t = 0.0
+    for line in _trace_tail(path, max_bytes=65536, max_lines=512) or ():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        t = rec.get("t") if isinstance(rec, dict) else None
+        if isinstance(t, (int, float)) and not isinstance(t, bool):
+            last_t = max(last_t, float(t))
+    return float(epoch) + last_t
+
+
 def _last_fault_site(tail_lines) -> str | None:
     """The last injected-fault site named in a capture tail — the
     poison job's smoking gun when it carries a chaos schedule."""
@@ -178,16 +237,6 @@ def _last_fault_site(tail_lines) -> str | None:
     return site
 
 
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return False
-    except (OSError, OverflowError):
-        return True  # exists but not ours (EPERM), or unprobeable: assume alive
-    return True
-
-
 class SpoolQueue:
     """The admission queue over one spool directory.
 
@@ -203,7 +252,9 @@ class SpoolQueue:
                  max_terminal_kept: int = 256,
                  max_crashes: int = MAX_CRASHES_DEFAULT,
                  default_deadline_s: float = 0.0,
-                 min_free_bytes: int = DISK_LOW_WATER_BYTES):
+                 min_free_bytes: int = DISK_LOW_WATER_BYTES,
+                 store: LeaseStore | str | None = None,
+                 lock_timeout_s: float = LOCK_TIMEOUT_DEFAULT_S):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1 (got {max_queue})")
         if max_terminal_kept < 0:
@@ -240,6 +291,17 @@ class SpoolQueue:
         # string, or None to admit. Purely advisory load shedding —
         # invalid specs and the global bound are still enforced here.
         self.admission_policy = None
+        # the spool's clock/liveness backend: an instance is adopted
+        # as-is (the service injects a pinned store), a string or None
+        # resolves against the spool's store.json marker WITHOUT
+        # pinning it — the client poll path must never decide a
+        # spool's backend, only inherit it
+        if isinstance(store, LeaseStore):
+            self.store = store
+        else:
+            self.store = resolve_store(root, store)
+        # bounded journal-lock acquisition (<=0 disables the bound)
+        self.lock_timeout_s = lock_timeout_s
         self.inbox_dir = os.path.join(root, "inbox")
         self.results_dir = os.path.join(root, "results")
         os.makedirs(self.inbox_dir, exist_ok=True)
@@ -285,6 +347,11 @@ class SpoolQueue:
             return self._status_from_result(job_id)
         out = {"job_id": job_id, **{k: v for k, v in entry.items()
                                     if k != "spec"}}
+        # the reader's "now" in the SPOOL's stamp domain: ages and
+        # expires-in arithmetic against the entry's *_m stamps is only
+        # well-defined on the clock that produced them, which on a
+        # sharedfs spool is not the client's own monotonic clock
+        out["now_m"] = round(self.store.now(), 3)
         if entry.get("children"):
             out["shards"] = self._shard_rollup(entry)
         result_path = os.path.join(self.results_dir, job_id + ".json")
@@ -344,16 +411,63 @@ class SpoolQueue:
 
     @contextlib.contextmanager
     def _txn(self):
-        """One flock'd journal transaction: exclusive lock, fresh load,
-        caller mutates and persists, lock released (incl. on error/kill
-        — the kernel drops flock with the fd)."""
+        """One flock'd journal transaction: exclusive lock (bounded —
+        see :meth:`_flock_bounded`), fresh load, caller mutates and
+        persists, lock released (incl. on error/kill — the kernel
+        drops flock with the fd)."""
         fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o644)
         try:
-            fcntl.flock(fd, fcntl.LOCK_EX)
+            self._flock_bounded(fd)
             self._load()
             yield
         finally:
             os.close(fd)
+
+    def _flock_bounded(self, fd: int) -> None:
+        """Take the exclusive journal flock with a bounded, jittered
+        poll instead of a blocking wait. A healthy lock is free or
+        held for one tmp+fsync+rename, so the fast path is a single
+        non-blocking attempt; contention polls with small jittered
+        backoff (jitter decorrelates N daemons hammering one shared-
+        filesystem lock). Past ``LOCK_STALL_EVENT_S`` one ``lock_stall``
+        event is ledgered; past ``lock_timeout_s`` the transaction
+        fails typed (:class:`JournalLockTimeout`) rather than wedging
+        the daemon invisibly forever."""
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return
+        except OSError:
+            pass
+        start = time.monotonic()
+        stalled = False
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return
+            except OSError:
+                waited = time.monotonic() - start
+                if 0 < self.lock_timeout_s <= waited:
+                    raise JournalLockTimeout(
+                        f"journal.lock on {self.root!r} not acquired "
+                        f"after {waited:.1f}s (lock_timeout_s="
+                        f"{self.lock_timeout_s}): wedged holder?"
+                    )
+                if not stalled and waited >= LOCK_STALL_EVENT_S:
+                    stalled = True
+                    # lazy import: the client poll path must not drag
+                    # the telemetry stack in on every status read
+                    from duplexumiconsensusreads_tpu.telemetry.trace import (
+                        emit_event,
+                    )
+
+                    emit_event(
+                        "lock_stall",
+                        waited_s=round(waited, 3),
+                        spool=self.root,
+                    )
+                # small cap: transactions are sub-ms when healthy, and
+                # the serving tests take this path with real sleeps
+                time.sleep(random.uniform(0.001, 0.005))
 
     def refresh(self) -> None:
         """Re-read the journal so the service's idle check sees other
@@ -535,19 +649,19 @@ class SpoolQueue:
                 "spec": spec.to_dict(),
                 "slices": 0,
                 "chunks_done": 0,
-                # admission timestamp on the shared monotonic clock:
-                # whichever daemon eventually claims the job computes
-                # its queue-wait against this
-                "admitted_m": round(time.monotonic(), 3),
+                # admission timestamp on the spool's shared stamp
+                # clock: whichever daemon eventually claims the job
+                # computes its queue-wait against this
+                "admitted_m": round(self.store.now(), 3),
             }
             # deadline: the job's own budget wins over the daemon-level
-            # default; stamped as a MONOTONIC expiry at admission (the
-            # budget runs from acceptance, queue-wait included), the
-            # one clock domain the whole lease machinery already uses
+            # default; stamped as a stamp-domain expiry at admission
+            # (the budget runs from acceptance, queue-wait included),
+            # the one clock domain the whole lease machinery uses
             deadline_s = spec.deadline_s or self.default_deadline_s
             if deadline_s and deadline_s > 0:
                 entry["deadline_m"] = round(
-                    time.monotonic() + float(deadline_s), 3
+                    self.store.now() + float(deadline_s), 3
                 )
             if spec.shards is not None or spec.shard_bytes is not None:
                 # sharding parent: the phase field decides what a claim
@@ -618,23 +732,15 @@ class SpoolQueue:
                 else "running"
             )
             entry["slices"] = int(entry.get("slices", 0)) + 1
-            entry["lease"] = {
-                "owner": daemon_id,
-                "pid": os.getpid(),
-                "host": _HOST,
-                "expires_m": round(time.monotonic() + lease_s, 3),
-            }
+            entry["lease"] = self.store.lease_doc(daemon_id, lease_s)
             # durable-progress stamp: a fresh claim counts as progress
             # (the watchdog must not declare a just-claimed job stalled
             # while it compiles); every chunk-commit renewal re-stamps
-            entry["progress_m"] = round(time.monotonic(), 3)
+            entry["progress_m"] = round(self.store.now(), 3)
             # bounded claim history: who ran this job under which token
             # — the quarantine diagnosis bundle's lease trail
             hist = entry.setdefault("lease_history", [])
-            hist.append({
-                "owner": daemon_id, "pid": os.getpid(), "token": token,
-                "claimed_m": round(time.monotonic(), 3),
-            })
+            hist.append(self.store.claim_rec(daemon_id, token))
             del hist[:-_LEASE_HISTORY_KEPT]
             self.save()
             return token
@@ -663,8 +769,10 @@ class SpoolQueue:
         exists to catch."""
         with self._txn():
             entry = self._check_fence(job_id, daemon_id, token)
-            entry["lease"]["expires_m"] = round(time.monotonic() + lease_s, 3)
-            entry["progress_m"] = round(time.monotonic(), 3)
+            entry["lease"]["expires_m"] = round(
+                self.store.now() + lease_s, 3
+            )
+            entry["progress_m"] = round(self.store.now(), 3)
             self.save()
 
     def renew_all(self, daemon_id: str, lease_s: float = LEASE_DEFAULT_S) -> int:
@@ -672,7 +780,7 @@ class SpoolQueue:
         daemon holds. Returns the number renewed (0 = nothing to save)."""
         with self._txn():
             renewed = 0
-            deadline = round(time.monotonic() + lease_s, 3)
+            deadline = round(self.store.now() + lease_s, 3)
             for entry in self.jobs.values():
                 lease = entry.get("lease")
                 if (
@@ -686,49 +794,44 @@ class SpoolQueue:
                 self.save()
             return renewed
 
-    def reclaim_dead(self, daemon_id: str, is_live=None) -> list[dict]:
+    def reclaim_dead(
+        self, daemon_id: str, is_live=None, hosts=None
+    ) -> list[dict]:
         """Dead-daemon takeover: requeue every running job whose lease
         no longer protects it — expired (the zombie case: the owner may
         still be alive, which is exactly what the fencing token guards
-        against), owned by a provably dead local pid, or missing
+        against), provably dead by the store's liveness oracle (a dead
+        local pid, a stale/rebooted heartbeat document), or missing
         entirely (a pre-lease journal). Reclaimed jobs keep their
         ORIGINAL seq (they reached the front once already) and their
         token (the NEXT claim bumps it, fencing the previous holder).
 
         ``is_live`` (optional callable daemon_id -> bool) identifies
         live daemons within THIS process — the in-process fleet used by
-        tests and the bench, where every daemon shares one pid.
-        Returns [{job_id, reason, prev_owner, crash_count[,
-        quarantined]}, ...]; the persist rides fault site
-        ``serve.expire``.
+        tests and the bench, where every daemon shares one pid (local
+        store only; the sharedfs backend trusts documents, not process
+        state). ``hosts`` is a heartbeat snapshot from the store's
+        ``observe()`` — the caller takes it under fault site
+        ``serve.store``; None re-observes here. Returns [{job_id,
+        reason, prev_owner, crash_count[, quarantined]}, ...]; the
+        persist rides fault site ``serve.expire``.
 
         Every reclaim here is an abort that was NOT a clean preemption
         (the owner died or went silent holding the lease), so it
         increments the job's ``crash_count``; at ``max_crashes`` the
         job is quarantined instead of requeued (see
         :meth:`_abort_requeue_locked`)."""
-        now = time.monotonic()
+        now = self.store.now()
+        if hosts is None:
+            hosts = self.store.observe()
         with self._txn():
             reclaimed = []
             for job_id, entry in self.jobs.items():
                 if entry.get("state") not in CLAIMED_STATES:
                     continue
-                lease = entry.get("lease")
-                reason = None
-                if lease is None:
-                    reason = "no-lease"
-                elif float(lease.get("expires_m", 0)) <= now:
-                    reason = "expired"
-                elif lease.get("host") == _HOST:
-                    pid = int(lease.get("pid", -1))
-                    if not _pid_alive(pid):
-                        reason = "dead-owner"
-                    elif (
-                        pid == os.getpid()
-                        and is_live is not None
-                        and not is_live(lease.get("owner"))
-                    ):
-                        reason = "dead-owner"
+                reason = self.store.reclaim_reason(
+                    entry.get("lease"), now, is_live=is_live, hosts=hosts
+                )
                 if reason is None:
                     continue
                 reclaimed.append(
@@ -758,7 +861,7 @@ class SpoolQueue:
         quarantine, like takeover."""
         if stall_s is None or stall_s <= 0:
             return []
-        now = time.monotonic()
+        now = self.store.now()
         with self._txn():
             reclaimed = []
             for job_id, entry in self.jobs.items():
@@ -848,20 +951,29 @@ class SpoolQueue:
         # service captures are per-daemon (service.<id>.trace.jsonl +
         # rotated .prev) since the fleet recorder; the legacy shared
         # name still matters for --trace overrides and old spools.
-        # Newest-mtime first, so the most recent capture naming a fault
-        # site — the one that saw THIS job's last crash — wins the
-        # setdefault/break scan below over stale history.
+        # Newest STITCHED END first (meta epoch_m + last relative t —
+        # the clock the journal stamps live on), so the most recent
+        # capture naming a fault site — the one that saw THIS job's
+        # last crash — wins the setdefault/break scan below over stale
+        # history. mtime is meaningless across hosts (skewed wall
+        # clocks, coarse shared-fs timestamps) and only ranks the
+        # pre-fleet captures that carry no epoch — those sort behind
+        # every epoch-bearing capture.
         from duplexumiconsensusreads_tpu.telemetry.fleet import (
             discover_service_captures,
         )
 
         svc = []
         for p in discover_service_captures(self.root):
-            try:
-                svc.append((os.path.getmtime(p), p))
-            except OSError:
-                continue
-        candidates += [p for _, p in sorted(svc, reverse=True)]
+            end = _capture_stitched_end(p)
+            if end is not None:
+                svc.append((1, end, p))
+            else:
+                try:
+                    svc.append((0, os.path.getmtime(p), p))
+                except OSError:
+                    continue
+        candidates += [p for _, _, p in sorted(svc, reverse=True)]
         for path in candidates:
             lines = _trace_tail(path, max_bytes=65536, max_lines=512)
             if not lines:
@@ -956,7 +1068,7 @@ class SpoolQueue:
                     "spec": spec.to_dict(),
                     "slices": 0,
                     "chunks_done": 0,
-                    "admitted_m": round(time.monotonic(), 3),
+                    "admitted_m": round(self.store.now(), 3),
                     "parent": parent_id,
                     "shard_idx": int((spec.shard or {}).get("idx", 0)),
                 }
@@ -1070,7 +1182,7 @@ class SpoolQueue:
         — and the partial checkpoint is left intact either way, so a
         re-submitted job resumes instead of recomputing (and can never
         splice: resume re-verifies every shard)."""
-        now = time.monotonic()
+        now = self.store.now()
         with self._txn():
             expired = []
             for job_id, entry in self.jobs.items():
@@ -1290,7 +1402,12 @@ class SpoolQueue:
         behind forever (no later writer reuses the name). A file is an
         orphan exactly when its embedded pid is dead — no clocks, no
         guessing; live daemons' in-flight staging files are untouched.
-        Called at daemon startup; returns the number removed."""
+        The pid probe is the STORE's liveness oracle: on a sharedfs
+        spool pids from other hosts are unprobeable, so the store
+        answers "possibly alive" for every pid and this sweep removes
+        nothing (unreaped litter is inert; gc_terminal_litter still
+        reclaims the bulk per terminal job). Called at daemon startup;
+        returns the number removed."""
         removed = 0
         for d in (self.root, self.inbox_dir, self.results_dir):
             try:
@@ -1306,7 +1423,7 @@ class SpoolQueue:
                     int(parts[3])
                 except ValueError:
                     continue
-                if _pid_alive(pid):
+                if self.store.pid_alive(pid):
                     continue
                 try:
                     os.remove(os.path.join(d, n))

@@ -78,6 +78,7 @@ from duplexumiconsensusreads_tpu.serve.queue import (
     SpoolQueue,
 )
 from duplexumiconsensusreads_tpu.serve.states import OPEN_STATES
+from duplexumiconsensusreads_tpu.serve.store import LeaseStore, resolve_store
 from duplexumiconsensusreads_tpu.serve.scheduler import FairScheduler
 from duplexumiconsensusreads_tpu.serve.worker import (
     JobDeadlineExceeded,
@@ -140,6 +141,7 @@ class ConsensusService:
         watchdog_s: float | None = None,
         max_crashes: int = MAX_CRASHES_DEFAULT,
         min_free_bytes: int = DISK_LOW_WATER_BYTES,
+        store: str | LeaseStore | None = None,
     ):
         """Defensive knobs: ``default_deadline_s`` (daemon-level job
         deadline, 0 = none; a job's own ``deadline_s`` wins),
@@ -147,7 +149,9 @@ class ConsensusService:
         None = derive from observed chunk p95, 0 = disabled),
         ``max_crashes`` (unclean aborts before a job is quarantined),
         ``min_free_bytes`` (disk low-water mark below which admission
-        sheds, 0 = no probe)."""
+        sheds, 0 = no probe), ``store`` (the spool's lease-store
+        backend — "local"/"sharedfs"/a LeaseStore instance; None
+        inherits the spool's store.json pin, defaulting to local)."""
         if workers < 1:
             raise ValueError(f"workers must be >= 1 (got {workers})")
         if poll_s <= 0:
@@ -156,10 +160,16 @@ class ConsensusService:
             raise ValueError(f"lease_s must be > 0 (got {lease_s})")
         if watchdog_s is not None and watchdog_s < 0:
             raise ValueError(f"watchdog_s must be >= 0 (got {watchdog_s})")
+        # daemons PIN the spool's backend (clients only inherit): the
+        # first daemon's choice — the implicit local default included —
+        # is durably recorded so a later daemon cannot diverge
+        if not isinstance(store, LeaseStore):
+            store = resolve_store(spool_dir, store, pin=True)
+        self.store = store
         self.queue = SpoolQueue(
             spool_dir, max_queue=max_queue, max_crashes=max_crashes,
             default_deadline_s=default_deadline_s,
-            min_free_bytes=min_free_bytes,
+            min_free_bytes=min_free_bytes, store=store,
         )
         self.sched = FairScheduler(
             chunk_budget=chunk_budget, class_depths=class_depths
@@ -196,6 +206,15 @@ class ConsensusService:
         self.daemon_id = daemon_id or (
             f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         )
+        # bind the fleet identity to the lease store: backends with
+        # durable heartbeat documents write the first one here, and
+        # every later beat (fault site serve.hb) refreshes it — the
+        # cross-host liveness evidence other daemons' reclaim sweeps
+        # read under fault site serve.store
+        self.store.attach(self.daemon_id, lease_s)
+        # rate limiter for in-loop/on-chunk beats: first call always
+        # due, then at most one per half lease
+        self._hb_due_m = 0.0
         self._lock = threading.Lock()
         self._drain = threading.Event()
         self._fatal: BaseException | None = None
@@ -406,13 +425,52 @@ class ConsensusService:
         except OSError:
             pass  # the snapshot is observability, never worth a crash
 
+    def _beat_if_due(self) -> None:
+        """Rate-limited liveness-document beat for the worker-loop and
+        chunk-commit paths (the heartbeat thread, when enabled, beats
+        on its own cadence through :meth:`_beat_stats`). At most one
+        durable write per half lease; the first call is always due, so
+        every daemon leaves at least one document. Same fault site and
+        absorb policy as the heartbeat path: serve.hb, transient
+        faults retried, OSError beyond the ladder tolerated (expiry
+        still covers), a modelled kill re-raised to die properly."""
+        now = time.monotonic()
+        with self._lock:
+            if now < self._hb_due_m:
+                return
+            self._hb_due_m = now + self.lease_s / 2.0
+        try:
+            _io_retry(
+                "serve.hb", self.store.beat, "liveness heartbeat document"
+            )
+        except OSError:
+            pass  # staleness backstop only; expiry still covers
+
     def _beat_stats(self) -> dict:
         # the heartbeat is the lease keep-alive path: every beat
+        # refreshes the store's liveness document (serve.hb — the
+        # cross-host evidence; journal-lock-free, so it keeps beating
+        # even while a transaction waits out a wedged flock) and then
         # extends this daemon's running leases, so a paused daemon
         # (whose beats stop) expires within lease_s while a healthy
         # one can never expire between chunk commits. A dying daemon
         # (fatal set) must NOT renew — its leases should lapse so the
         # fleet takes its jobs over as fast as possible.
+        if self._fatal is None:
+            try:
+                _io_retry(
+                    "serve.hb",
+                    self.store.beat,
+                    "liveness heartbeat document",
+                )
+            except OSError:
+                pass  # staleness backstop only; expiry still covers
+            except BaseException as e:  # noqa: BLE001 — modelled kill
+                with self._lock:
+                    if self._fatal is None:
+                        self._fatal = e
+                self._drain.set()
+                raise
         if self._fatal is None:
             try:
                 _io_retry(
@@ -462,9 +520,18 @@ class ConsensusService:
                 # the meta header names this daemon: every record in
                 # the capture is this daemon's testimony, and the fleet
                 # stitcher (telemetry/fleet.py) attributes run slices
-                # to daemons by exactly this attr
+                # to daemons by exactly this attr. On a cross-host
+                # store the meta also OVERRIDES epoch_m into the
+                # spool's stamp domain (the recorder's own t0 is this
+                # host's arbitrary monotonic epoch): relative ts then
+                # stitch against other hosts' captures and the
+                # journal's *_m stamps without any per-host offset
+                meta = {"daemon_id": self.daemon_id}
+                epoch = self.store.capture_epoch()
+                if epoch is not None:
+                    meta["epoch_m"] = round(epoch, 6)
                 tr = TraceRecorder(self.trace_path, kind="service",
-                                   meta={"daemon_id": self.daemon_id})
+                                   meta=meta)
                 self._tr = tr
                 if telemetry.get_active() is None:
                     # the service capture doubles as the switchboard
@@ -577,14 +644,20 @@ class ConsensusService:
     def _reclaim_locked(self) -> list[dict]:
         """One takeover sweep (caller holds the lock): requeue every
         running job whose lease is expired or whose owner is provably
-        dead. The scan itself rides fault site ``serve.expire`` (the
-        persist inside reclaim_dead does too), so chaos schedules can
-        target takeover even on passes that reclaim nothing."""
+        dead. The heartbeat-document scan rides fault site
+        ``serve.store`` and the scan itself fault site ``serve.expire``
+        (the persist inside reclaim_dead does too), so chaos schedules
+        can target each step even on passes that reclaim nothing."""
         tr = self._tr
+        hosts = _io_retry(
+            "serve.store",
+            self.store.observe,
+            "lease-store liveness scan",
+        )
         reclaimed = _io_retry(
             "serve.expire",
             lambda: self.queue.reclaim_dead(
-                self.daemon_id, is_live=_daemon_is_live
+                self.daemon_id, is_live=_daemon_is_live, hosts=hosts
             ),
             "lease reclaim sweep",
         )
@@ -769,15 +842,23 @@ class ConsensusService:
         try:
             while not self._drain.is_set():
                 claimed = None
+                # liveness document refresh, rate-limited (first pass
+                # always due): a daemon running with the heartbeat
+                # thread disabled must still leave cross-host evidence
+                # it is alive, or a sharedfs peer's staleness backstop
+                # would read silence as death
+                self._beat_if_due()
                 with self._lock:
                     self._accept_pending_locked()
                     self._reclaim_locked()
                     self._expire_deadlines_locked()
                     self._advance_parents_locked()
                     # deadline-aware pick: never claim a job the sweep
-                    # (or another daemon's sweep) is about to expire
+                    # (or another daemon's sweep) is about to expire —
+                    # "now" on the spool's stamp clock, the domain of
+                    # the entries' deadline_m
                     job_id = self.sched.pick(
-                        self.queue.jobs, now=time.monotonic()
+                        self.queue.jobs, now=self.store.now()
                     )
                     if job_id is not None:
                         # the pick is advisory until the CLAIM commits:
@@ -803,7 +884,7 @@ class ConsensusService:
                             if first_slice and "admitted_m" in entry:
                                 self._note_latency_locked(
                                     entry.get("priority", 1), "queue_wait",
-                                    time.monotonic() - entry["admitted_m"],
+                                    self.store.now() - entry["admitted_m"],
                                 )
                             self._n_running += 1
                             # what the claim MEANT is in the journal:
@@ -1108,11 +1189,14 @@ class ConsensusService:
                 with self._lock:
                     self._note_latency_locked(
                         priority, "ttfc",
-                        time.monotonic() - admitted_m,
+                        self.store.now() - admitted_m,
                     )
 
         # chunk-cadence sampling: inter-commit intervals feed the
-        # auto-watchdog threshold (what a "normal" chunk costs here)
+        # auto-watchdog threshold (what a "normal" chunk costs here).
+        # Each commit also refreshes the liveness document (rate-
+        # limited): a long slice must keep its cross-host heartbeat
+        # honest even when the heartbeat thread is off.
         last_commit = [time.monotonic()]
 
         def on_chunk():
@@ -1120,11 +1204,13 @@ class ConsensusService:
             with self._lock:
                 self._note_chunk_locked(now - last_commit[0])
             last_commit[0] = now
+            self._beat_if_due()
 
         lease = LeaseContext(
             queue=self.queue, daemon_id=self.daemon_id, token=token,
             lease_s=self.lease_s, on_first_chunk=on_first_chunk,
             on_chunk=on_chunk, deadline_m=deadline_m,
+            now_fn=self.store.now,
         )
         t0 = time.monotonic()
         try:

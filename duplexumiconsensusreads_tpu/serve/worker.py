@@ -79,9 +79,13 @@ class LeaseContext:
     time-to-first-chunk sample. ``on_chunk`` (optional) fires on EVERY
     chunk commit — the service's chunk-cadence sample, which derives
     the watchdog's default stall threshold. ``deadline_m`` is the
-    job's admission-stamped monotonic expiry (None = no deadline): the
-    commit path checks it right after each chunk's mark is durable and
-    aborts the slice with :class:`JobDeadlineExceeded` when passed."""
+    job's admission-stamped expiry (None = no deadline): the commit
+    path checks it right after each chunk's mark is durable and aborts
+    the slice with :class:`JobDeadlineExceeded` when passed.
+    ``now_fn`` supplies "now" in the SAME clock domain ``deadline_m``
+    was stamped in — the spool's lease-store clock (the service wires
+    ``store.now``); None falls back to the local monotonic clock, the
+    single-host domain."""
 
     queue: SpoolQueue
     daemon_id: str
@@ -90,6 +94,7 @@ class LeaseContext:
     on_first_chunk: object = None
     on_chunk: object = None
     deadline_m: float | None = None
+    now_fn: object = None
 
 
 def fenced_renew(queue: SpoolQueue, job_id: str, daemon_id: str,
@@ -402,8 +407,11 @@ class WarmWorker:
                 # deadline abort rides the preemption contract: this
                 # chunk's mark is already durable, nothing later is —
                 # the strongest point to stop without wasting the
-                # prefix or splicing a byte
-                overdue = time.monotonic() - lease.deadline_m
+                # prefix or splicing a byte. "now" comes from the
+                # lease's clock (the spool's stamp domain); bare
+                # monotonic is only the single-host fallback
+                now_fn = lease.now_fn or time.monotonic
+                overdue = now_fn() - lease.deadline_m
                 if overdue >= 0:
                     raise JobDeadlineExceeded(commits[0], overdue)
             if drain_event.is_set():

@@ -87,6 +87,11 @@ KNOWN_EVENTS = (
     "durable_write",  # io/durable.py: a tmp+fsync+rename completed
     "heartbeat",  # periodic liveness sample (also printed to stderr)
     "truncated",  # the bounded recorder hit max_events; tail dropped
+    "lock_stall",  # serve/queue.py: journal.lock not acquired within
+    # the stall threshold — one event per stalled acquisition (attrs:
+    # waited_s, spool), the wedged-shared-filesystem-lock alarm; the
+    # acquisition itself keeps polling until lock_timeout_s, then
+    # fails typed (JournalLockTimeout)
     "packed_fallback",  # wire packing downgraded a rung (pos ids past
     # u16, qual cap past the 6-bit payload, per-base tags forcing an
     # unpacked d2h, a class capacity overflowing the u16 ids lane): the

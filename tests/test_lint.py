@@ -44,7 +44,7 @@ class TestEngine:
         assert {
             "clock-discipline", "durability-protocol", "fault-registry",
             "phase-registry", "lock-discipline", "hook-guard",
-            "lease-discipline", "deadline-discipline",
+            "lease-discipline", "deadline-discipline", "host-locality",
             # the protocol model-checker passes
             "state-machine", "txn-discipline", "fence-dominance",
             "exception-contract",
@@ -915,6 +915,150 @@ class TestDeadlineDiscipline:
             def status(jid):
                 return {"job_id": jid, "state": "submitted"}
             """})
+        assert res.ok
+
+    def test_store_clock_read_is_a_monotonic_derivation(self):
+        # the host-locality seam: *_m stamps fed from the lease store's
+        # clock (store.now() / store.capture_epoch()) are in-domain by
+        # construction — forcing time.monotonic() back in would be the
+        # exact cross-host bug the store exists to prevent
+        res = self.base(**{"pkg/serve/svc.py": """
+            def stamp(self, entry, lease_s):
+                entry["expires_m"] = round(self.store.now() + lease_s, 3)
+            def epoch(self, meta):
+                meta["epoch_m"] = round(self.store.capture_epoch(), 6)
+            """})
+        assert res.ok
+
+
+class TestHostLocality:
+    # a serving layer that routes liveness and stamps through the
+    # store seam, over a corpus where the sharedfs backend exists and
+    # its I/O sites are registered
+    SVC_OK = """
+        import os
+        def reclaim(self, entry, now):
+            reason = self.store.reclaim_reason(
+                entry.get("lease"), now, hosts=self.store.observe()
+            )
+            return reason
+        def sweep(self, pid):
+            if self.store.pid_alive(pid):
+                return
+        def wait_age(self, entry):
+            return self.store.now() - entry["admitted_m"]
+        def ident(self):
+            return f"d-{os.getpid()}"
+        """
+    STORE_OK = """
+        import os
+        def _pid_alive(pid):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return False
+            return True
+        """
+    FAULTS_OK = """
+        KNOWN_SITES = ("serve.lease", "serve.hb", "serve.store")
+        """
+
+    def base(self, **over):
+        files = {
+            "pkg/serve/svc.py": self.SVC_OK,
+            "pkg/serve/store.py": self.STORE_OK,
+            "pkg/runtime/faults.py": self.FAULTS_OK,
+        }
+        files.update(over)
+        return lint(files, rules=["host-locality"])
+
+    def test_passes_when_confined_to_the_store(self):
+        # the store backend itself may probe pids — that's its job —
+        # and os.getpid() as an identity read is legal anywhere
+        assert self.base().ok
+
+    def test_fires_on_os_kill_outside_the_store(self):
+        res = self.base(**{"pkg/serve/svc2.py": """
+            import os
+            def is_live(pid):
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    return False
+                return True
+            """})
+        assert rules_of(res) == [("host-locality", "pkg/serve/svc2.py")]
+        assert "os.kill" in res.findings[0].message
+        assert "store" in res.findings[0].hint
+
+    def test_fires_on_pid_alive_call_outside_the_store(self):
+        res = self.base(**{"pkg/serve/svc2.py": """
+            from pkg.serve.store import _pid_alive
+            def sweep(pid):
+                return _pid_alive(pid)
+            """})
+        assert rules_of(res) == [("host-locality", "pkg/serve/svc2.py")]
+        assert "_pid_alive" in res.findings[0].message
+
+    def test_fires_on_journal_pid_comparison(self):
+        # pid equality against a journal record is an ownership/liveness
+        # verdict in disguise — two hosts can share a pid number
+        res = self.base(**{"pkg/serve/svc2.py": """
+            import os
+            def mine(lease):
+                return lease.get("pid") == os.getpid()
+            """})
+        assert rules_of(res) == [("host-locality", "pkg/serve/svc2.py")]
+        assert "'pid'" in res.findings[0].message
+
+    def test_fires_on_monotonic_vs_stamp_arithmetic(self):
+        res = self.base(**{"pkg/serve/svc2.py": """
+            import time
+            def stalled(entry, budget_s):
+                return time.monotonic() - entry["progress_m"] > budget_s
+            """})
+        assert rules_of(res) == [("host-locality", "pkg/serve/svc2.py")]
+        assert "monotonic" in res.findings[0].message
+        assert "store.now()" in res.findings[0].hint
+
+    def test_store_now_vs_stamp_is_the_legal_form(self):
+        res = self.base(**{"pkg/serve/svc2.py": """
+            def stalled(self, entry, budget_s):
+                return self.store.now() - entry["progress_m"] > budget_s
+            """})
+        assert res.ok
+
+    def test_local_monotonic_durations_stay_legal(self):
+        # pure local durations (no *_m key in the expression) are fine:
+        # lock-wait accounting, elapsed_s, chunk cadence
+        res = self.base(**{"pkg/serve/svc2.py": """
+            import time
+            def waited(start):
+                return time.monotonic() - start
+            """})
+        assert res.ok
+
+    def test_fires_on_unregistered_xhost_site(self):
+        res = self.base(**{"pkg/runtime/faults.py": """
+            KNOWN_SITES = ("serve.lease", "serve.hb")
+            """})
+        assert rules_of(res) == [("host-locality", "pkg/runtime/faults.py")]
+        assert "serve.store" in res.findings[0].message
+        assert "chaos" in res.findings[0].hint
+
+    def test_pre_fleet_corpus_owes_no_sites(self):
+        # fixture corpora without the store backend (every older rule's
+        # miniature serve/ tree) must not be retrofitted with sites
+        res = lint(
+            {
+                "pkg/serve/svc.py": """
+                    def wait_age(self, entry, now):
+                        return now - entry["admitted_m"]
+                    """,
+                "pkg/runtime/faults.py": "KNOWN_SITES = (\"serve.lease\",)\n",
+            },
+            rules=["host-locality"],
+        )
         assert res.ok
 
 
