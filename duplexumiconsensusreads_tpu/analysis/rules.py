@@ -2213,3 +2213,168 @@ def check_host_locality(corpus: Corpus) -> Iterator[Finding]:
                 "site, which is what proves the pid-free takeover "
                 "ladders survive injected faults",
             )
+
+
+# -------------------------------------------- rule: ingest confinement
+
+# everything the CONSUMER side of the streaming executor owns: the
+# drain/dispatch pipeline, the prefetch window, the checkpoint. The
+# byte-identity proof for --ingest-overlap rests on the producer thread
+# never touching any of it — the bounded handoff queue is the ONLY
+# seam between the threads, so the proof stays local to one queue.
+_CONSUMER_NAMES = {
+    "inflight", "done_q", "prefetch_sem", "drain", "ckpt",
+}
+
+# device/dispatch entry points: work that must stay on the main loop /
+# its worker pools (the producer is a pure host-prep thread — a device
+# call from it would race the mesh dispatch and void the ordering
+# argument)
+_DEVICE_CALLS = {
+    "device_put", "block_until_ready", "sharded_pipeline",
+    "start_fetch", "dispatch_chunk", "materialize", "materialise",
+}
+
+# durable-state moves the producer must never make: per-chunk
+# checkpoint marks, journal transactions, durable writes — exactly-once
+# resume is proven over MAIN-LOOP commit order, and a producer-side
+# mark would commit a chunk the consumer has not finished
+_DURABLE_CALLS = {
+    "mark", "save", "_txn", "write_durable", "replace_durable",
+    "rewrite_from",
+}
+
+
+def _producer_scope(tree: ast.Module, root_name: str) -> list:
+    """The producer thread's static call scope: the ``root_name``
+    function plus every same-file function it (transitively) calls by
+    name — the closures the thread body actually runs (_q_put,
+    _prep_chunk, the retry helpers). Imported callees are out of scope;
+    they are the main loop's shared vocabulary and carry their own
+    rules."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    if root_name not in defs:
+        return []
+    scope = {root_name}
+    frontier = [defs[root_name]]
+    while frontier:
+        fn = frontier.pop()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in defs and name not in scope:
+                scope.add(name)
+                frontier.append(defs[name])
+    return [defs[n] for n in sorted(scope)]
+
+
+@register(
+    "ingest-confinement",
+    "the ingest producer thread makes no device calls, no durable "
+    "state moves, and hands off only through the bounded queue",
+)
+def check_ingest_confinement(corpus: Corpus) -> Iterator[Finding]:
+    """The pipelined-ingest thread contract (runtime/stream.py
+    ``_ingest_producer`` + the closures it calls): the producer is a
+    pure host-prep stage — read, inflate, parse, bucket — and the
+    depth-bounded handoff queue is its ONLY seam with the consumer.
+    Three drift classes, each of which would void the byte-identity /
+    exactly-once proofs silently:
+
+    (a) a jax/device/dispatch call from the producer scope races the
+        main loop's mesh dispatch and breaks the single-dispatcher
+        ordering argument;
+    (b) a checkpoint mark / journal txn / durable write from the
+        producer commits state for a chunk the consumer has not
+        finished — resume would skip work that never happened;
+    (c) touching a consumer-owned structure (inflight window, drain
+        pool, prefetch semaphore, done_q, the checkpoint object) or
+        putting to any queue other than the handoff queue bypasses the
+        one audited seam.
+
+    The rule also pins the producer's existence: a stream.py that
+    still carries the overlap machinery (the ``dut-ingest`` thread
+    name or the ``ingest_stall`` phase) but no ``_ingest_producer``
+    function has renamed the anchor out from under this rule —
+    that is a finding, not a silent skip."""
+    stream_path = corpus.find("runtime/stream.py")
+    if stream_path is None:
+        return
+    tree = corpus.trees[stream_path]
+    scope_fns = _producer_scope(tree, "_ingest_producer")
+    if not scope_fns:
+        has_overlap_markers = any(
+            str_const(n) in ("dut-ingest", "ingest_stall")
+            for n in ast.walk(tree)
+        )
+        if has_overlap_markers:
+            yield Finding(
+                rule="ingest-confinement",
+                path=stream_path,
+                line=1,
+                message="overlap machinery present ('dut-ingest'/"
+                "'ingest_stall') but no _ingest_producer function",
+                hint="keep the producer body in a function named "
+                "_ingest_producer — it anchors the thread-confinement "
+                "checks",
+            )
+        return
+    for fn in scope_fns:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                callee = expr_path(node.func) or name
+                if name in _DEVICE_CALLS or callee.startswith("jax."):
+                    yield Finding(
+                        rule="ingest-confinement",
+                        path=stream_path,
+                        line=node.lineno,
+                        message=f"device/dispatch call {callee}() in the "
+                        f"ingest producer scope ({fn.name})",
+                        hint="the producer is host-prep only; device "
+                        "work belongs to the main loop's dispatch "
+                        "pipeline (single-dispatcher ordering)",
+                    )
+                elif name in _DURABLE_CALLS:
+                    yield Finding(
+                        rule="ingest-confinement",
+                        path=stream_path,
+                        line=node.lineno,
+                        message=f"durable state move {callee}() in the "
+                        f"ingest producer scope ({fn.name})",
+                        hint="checkpoint marks / journal txns / durable "
+                        "writes commit on the MAIN loop after the chunk "
+                        "finishes — a producer-side commit breaks "
+                        "exactly-once resume",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("put", "put_nowait")
+                ):
+                    recv = expr_path(node.func.value) or ""
+                    if not recv.endswith("ingest_q"):
+                        yield Finding(
+                            rule="ingest-confinement",
+                            path=stream_path,
+                            line=node.lineno,
+                            message=f"producer puts to {recv or '?'!r} — "
+                            f"not the bounded handoff queue",
+                            hint="the handoff queue (ingest_q) is the "
+                            "producer's only legal output channel",
+                        )
+            elif isinstance(node, ast.Name) and node.id in _CONSUMER_NAMES:
+                yield Finding(
+                    rule="ingest-confinement",
+                    path=stream_path,
+                    line=node.lineno,
+                    message=f"consumer-owned structure {node.id!r} "
+                    f"referenced in the ingest producer scope "
+                    f"({fn.name})",
+                    hint="the producer may only touch its own state and "
+                    "the bounded handoff queue; everything else is the "
+                    "consumer's (thread-confinement contract)",
+                )

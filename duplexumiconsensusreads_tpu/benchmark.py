@@ -80,7 +80,7 @@ COMPACT_KEYS = (
     "e2e_wire_floor_frac", "e2e_wire_floor_frac_measured",
     "e2e_wire_h2d_mb_s_measured", "e2e_wire_d2h_mb_s_measured",
     "e2e_bytes_per_read", "e2e_packed_speedup", "e2e_d2h_packed_speedup",
-    "e2e_h2d_bits_per_cycle", "e2e_prefetch_depth",
+    "e2e_h2d_bits_per_cycle", "e2e_prefetch_depth", "e2e_ingest_overlap",
     "e2e_fill_factor", "tuner_predicted_speedup", "e2e_vs_cpu_e2e",
     "e2e_mesh_devices", "e2e_mesh_scaling",
     "serve_amortised_speedup", "serve_fleet_takeover_latency_s",
@@ -217,6 +217,7 @@ def _e2e_input(n_target: int) -> tuple[str, float]:
 def run_e2e(
     n_target: int, packed: str = "auto", prefix: str = "e2e",
     d2h_packed: str = "auto", n_devices: int | None = None,
+    ingest_overlap: str = "auto",
 ) -> dict:
     """Stream a cached large simulated BAM through the full pipeline;
     return wall-clock metrics including ingest and write. packed="off"
@@ -259,6 +260,7 @@ def run_e2e(
         packed=packed,
         d2h_packed=d2h_packed,
         prefetch_depth=prefetch_depth,
+        ingest_overlap=ingest_overlap,
         trace_path=trace_path,
     )
     wall = time.monotonic() - t0
@@ -1575,6 +1577,23 @@ def main() -> None:
                 / d2h_off["e2e_d2h_unpacked_reads_per_sec"],
                 3,
             )
+            # ingest-overlap A/B: the same leg with the background
+            # producer disabled — what pipelining BGZF/decode/bucketing
+            # under device compute buys end-to-end. The packed leg
+            # above already ran with overlap on (auto), so only the
+            # off leg costs extra wall. Ratio is off-wall/on-wall, so
+            # >= 1.111 means overlap-on runs at <= 0.9x the sync wall.
+            # DUT_BENCH_INGEST_AB=0 disables.
+            if int(os.environ.get("DUT_BENCH_INGEST_AB", 1)):
+                ov_off = run_e2e(
+                    n_ab, ingest_overlap="off", prefix="e2e_ov_off"
+                )
+                result.update(ov_off)
+                result["e2e_ingest_overlap"] = round(
+                    ov_off["e2e_ov_off_wall_s"]
+                    / max(packed_leg["e2e_ab_packed_wall_s"], 1e-9),
+                    3,
+                )
             # mesh-scaling A/B (DUT_BENCH_MESH=K, needs K devices —
             # simulated on CPU via XLA_FLAGS, real chips on a pod):
             # the same leg at K devices vs 1, same warm caches. On the

@@ -252,6 +252,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--chunk-reads)",
     )
     c.add_argument(
+        "--ingest-overlap",
+        choices=["auto", "on", "off"],
+        default=None,
+        help="bounded background ingest producer (streaming): auto/on "
+        "run BGZF read + decode + bucketing on a dedicated thread up "
+        "to --prefetch-depth prepped chunks ahead of the main loop "
+        "(handoff through a depth-bounded queue sharing the prefetch "
+        "window's back-pressure); off forces fully synchronous "
+        "main-loop ingest. Output bytes are identical either way "
+        "(default auto; requires --chunk-reads)",
+    )
+    c.add_argument(
         "--bucket-ladder",
         default=None,
         metavar="{auto,off,R1,R2,..}",
@@ -577,7 +589,7 @@ def _load_config_file(path: str) -> dict:
         "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
         "min_input_qual", "capacity", "devices", "mesh", "cycle_shards",
         "chunk_reads", "max_inflight", "drain_workers", "packed",
-        "prefetch_depth", "bucket_ladder", "config",
+        "prefetch_depth", "ingest_overlap", "bucket_ladder", "config",
         "mate_aware", "max_reads",
         "per_base_tags", "read_group_id", "write_index", "count_ratio",
         "ref_projected", "umi_whitelist", "umi_max_mismatches",
@@ -765,6 +777,12 @@ def _cmd_call(args) -> int:
         raise SystemExit(
             f"--prefetch-depth must be >= 1 (got {prefetch_depth})"
         )
+    ingest_overlap = opt("ingest_overlap", "auto")
+    if ingest_overlap not in ("auto", "on", "off"):
+        raise SystemExit(
+            f"invalid ingest_overlap value {ingest_overlap!r} "
+            f"(allowed: ['auto', 'on', 'off'])"
+        )
     mate_aware = opt("mate_aware", "auto")
     max_reads = opt("max_reads", 0)
     if max_reads < 0:
@@ -898,6 +916,7 @@ def _cmd_call(args) -> int:
             "drain_workers": drain_workers,
             "packed": packed,
             "prefetch_depth": prefetch_depth,
+            "ingest_overlap": ingest_overlap,
             "mesh": mesh,
             "bucket_ladder": (
                 list(ladder_norm) if isinstance(ladder_norm, tuple)
@@ -954,17 +973,19 @@ def _cmd_call(args) -> int:
         )
     if chunk_reads <= 0 and (
         args.packed is not None or args.prefetch_depth is not None
+        or args.ingest_overlap is not None
         or packed != "auto" or prefetch_depth != 2
+        or ingest_overlap != "auto"
     ):
         # only the streaming executor carries the wire-diet knobs; on
         # the whole-file path they would be silently inert (a --submit
         # job always streams, so the keys rode into its config above).
         # The resolved values are checked too: a config-file
-        # packed/prefetch_depth must be refused exactly like the flag,
-        # not silently dropped
+        # packed/prefetch_depth/ingest_overlap must be refused exactly
+        # like the flag, not silently dropped
         raise SystemExit(
-            "--packed/--prefetch-depth require the streaming executor "
-            "(--chunk-reads N)"
+            "--packed/--prefetch-depth/--ingest-overlap require the "
+            "streaming executor (--chunk-reads N)"
         )
     if chunk_reads <= 0 and (args.mesh is not None or mesh != "auto"):
         # the mesh knob steers the STREAMING dispatch path (per-device
@@ -1090,6 +1111,7 @@ def _cmd_call(args) -> int:
             drain_workers=drain_workers,
             packed=packed,
             prefetch_depth=prefetch_depth,
+            ingest_overlap=ingest_overlap,
             bucket_ladder=ladder_norm,
             checkpoint_path=host_ckpt,
             resume=args.resume,
@@ -1125,6 +1147,7 @@ def _cmd_call(args) -> int:
             drain_workers=drain_workers,
             packed=packed,
             prefetch_depth=prefetch_depth,
+            ingest_overlap=ingest_overlap,
             bucket_ladder=ladder_norm,
             checkpoint_path=args.checkpoint,
             resume=args.resume,

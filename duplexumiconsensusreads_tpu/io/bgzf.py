@@ -29,6 +29,11 @@ BGZF_EOF = bytes.fromhex(
 MAX_BLOCK_UNCOMPRESSED = 65280
 
 _HEADER = struct.Struct("<BBBBIBBH")  # magic1 magic2 CM FLG MTIME XFL OS XLEN
+# Precompiled scalar codecs for the hot header scan: read_block_size
+# runs once per 18-byte BGZF header on the streaming ingest path, and
+# struct.unpack_from("<H", ...) re-parses the format string each call.
+_U16 = struct.Struct("<H")
+_U32X2 = struct.Struct("<II")
 
 
 def read_block_size(data: bytes, offset: int) -> int:
@@ -41,15 +46,15 @@ def read_block_size(data: bytes, offset: int) -> int:
     flg = data[offset + 3]
     if not flg & 4:  # FEXTRA
         raise ValueError("gzip member without FEXTRA: not BGZF")
-    xlen = struct.unpack_from("<H", data, offset + 10)[0]
+    xlen = _U16.unpack_from(data, offset + 10)[0]
     pos = offset + 12
     end = pos + xlen
     while pos + 4 <= end:
-        si1, si2, slen = data[pos], data[pos + 1], struct.unpack_from("<H", data, pos + 2)[0]
+        si1, si2, slen = data[pos], data[pos + 1], _U16.unpack_from(data, pos + 2)[0]
         if si1 == 66 and si2 == 67:
             if slen != 2:
                 raise ValueError("BC subfield with SLEN != 2")
-            return struct.unpack_from("<H", data, pos + 4)[0] + 1
+            return _U16.unpack_from(data, pos + 4)[0] + 1
         pos += 4 + slen
     raise ValueError("no BC subfield: not BGZF")
 
@@ -68,12 +73,12 @@ def iter_block_offsets(data: bytes):
 
 def decompress_block(data: bytes, offset: int, size: int) -> bytes:
     """Decompress one block given its offset and compressed size."""
-    xlen = struct.unpack_from("<H", data, offset + 10)[0]
+    xlen = _U16.unpack_from(data, offset + 10)[0]
     start = offset + 12 + xlen
     # last 8 bytes are CRC32 + ISIZE
     payload = data[start : offset + size - 8]
     out = zlib.decompress(payload, wbits=-15)
-    crc, isize = struct.unpack_from("<II", data, offset + size - 8)
+    crc, isize = _U32X2.unpack_from(data, offset + size - 8)
     if len(out) != isize or zlib.crc32(out) != crc:
         raise ValueError(f"BGZF block at {offset}: CRC/size mismatch")
     return out

@@ -108,7 +108,9 @@ def segment_gemm(
         _seg_gemm_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((f_pad, c_pad), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -76,6 +76,9 @@ class RunReport:
     n_umi_corrected: int = 0
     n_dropped_whitelist: int = 0
     mate_aware: bool = False  # resolved mate-aware mode of this run
+    ingest_overlap: bool = False  # streaming: resolved overlap mode —
+    # True when ingest ran as the bounded background producer pipeline
+    # (a scheduling decision like the mesh: never changes output bytes)
     backend: str = ""
     # wire accounting (streaming): bytes of device-input tensors
     # dispatched and device-output tensors materialised. Together with
@@ -134,11 +137,14 @@ def write_report(rep: "RunReport", path: str) -> None:
 XFER_WORKERS = 4
 DRAIN_PHASES = ("device_wait_fetch", "scatter", "deflate", "shard_write")
 # rep.seconds entries that are not per-stage busy seconds
-# (main_loop_stall / prefetch_stall are main-thread blocked wall —
-# back-pressure and the bounded H2D prefetch window respectively —
-# shown via dedicated summary lines, not stage rows)
+# (main_loop_stall / prefetch_stall / ingest_stall are main-thread
+# blocked wall — back-pressure, the bounded H2D prefetch window and the
+# ingest-producer handoff respectively — and ingest_backpressure is the
+# producer blocked on its full queue; shown via dedicated summary
+# lines, not stage rows)
 _NON_STAGE_KEYS = (
     "total", "drain_utilization", "main_loop_stall", "prefetch_stall",
+    "ingest_stall", "ingest_backpressure",
 )
 
 
@@ -208,6 +214,18 @@ def busy_wall_table(
         lines.append(
             f"main loop stalled on the H2D prefetch window "
             f"{pstall / wall:.0%} of the wall"
+        )
+    istall = _num(seconds.get("ingest_stall"))
+    if istall is not None and wall:
+        lines.append(
+            f"main loop stalled on the ingest producer "
+            f"{istall / wall:.0%} of the wall"
+        )
+    ibp = _num(seconds.get("ingest_backpressure"))
+    if ibp is not None and wall:
+        lines.append(
+            f"ingest producer blocked on the full handoff queue "
+            f"{ibp / wall:.0%} of the wall"
         )
     return lines, bugs
 

@@ -51,6 +51,11 @@ import threading
 KNOWN_SITES = (
     "ingest.read",  # file read feeding the rolling BGZF buffer
     "bgzf.inflate",  # block-batch decompression (native or Python)
+    "ingest.queue",  # producer->consumer handoff of a prepped chunk
+    # (overlap mode's bounded queue put, on the dut-ingest thread):
+    # transients ride the standard bounded-retry ladder on the producer;
+    # kills forward to the main loop through the queue's error sentinel
+    # and surface exactly like a main-thread InjectedKill
     "dispatch.device_put",  # stack/pack/device dispatch (xfer worker)
     "dispatch.pack",  # host-side wire packing of the stacked chunk
     "fetch.result",  # device->host materialisation of outputs

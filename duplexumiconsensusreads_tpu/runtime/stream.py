@@ -33,6 +33,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import queue as _queue
 import struct
 import sys
 import threading
@@ -108,12 +109,15 @@ from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 _HOST_IO_RETRIES = 3
 
 
-def _io_retry(site: str, fn, what: str):
+def _io_retry(site: str, fn, what: str, *args):
+    # ``*args`` are forwarded to ``fn`` so hot-loop callers can pass a
+    # module-level function instead of allocating a fresh closure per
+    # call (the BGZF header scan hits this once per 18-byte read).
     last: OSError | None = None
     for attempt in range(_HOST_IO_RETRIES + 1):
         try:
             fault_point(site)
-            return fn()
+            return fn(*args)
         except OSError as e:
             last = e
             if attempt == _HOST_IO_RETRIES:
@@ -136,18 +140,32 @@ def _io_retry(site: str, fn, what: str):
     raise last
 
 
-def _read_ingest(f, n: int) -> bytes:
+def _seek_read(f, pos: int, n: int) -> bytes:
     # re-seek per attempt: a real transient error can fire after the fd
     # offset already advanced past partially-read bytes, and a naive
     # re-read would silently skip them (desynced BGZF framing at best,
     # silently wrong records at worst)
-    pos = f.tell()
+    f.seek(pos)
+    return f.read(n)
 
-    def _once():
-        f.seek(pos)
-        return f.read(n)
 
-    return _io_retry("ingest.read", _once, "ingest read")
+def _read_ingest(f, n: int) -> bytes:
+    return _io_retry("ingest.read", _seek_read, "ingest read", f, f.tell(), n)
+
+
+def _noop():
+    # the ingest.queue fault probe: the handoff itself is a pure
+    # in-memory enqueue, so the chaos site wraps a no-op — transients
+    # ride the standard _io_retry ladder, kills escape it
+    return None
+
+
+class _IngestAbort(BaseException):
+    """Internal unwind signal for the ingest producer thread: the run
+    is aborting (the main loop already owns the error), so the producer
+    must exit its blocked handoff put WITHOUT emitting another sentinel.
+    BaseException so no retry/isolation ladder can absorb it — the same
+    reasoning as faults.InjectedKill."""
 
 
 # --------------------------------------------------------------- input
@@ -179,6 +197,14 @@ def _inflate_native(lib, buf: bytes, n_threads: int) -> bytes:
     return out[:usize].tobytes()
 
 
+def _inflate_python(block: bytes) -> bytes:
+    """Per-block pure-Python inflate of a batch of complete blocks."""
+    return b"".join(
+        bgzf.decompress_block(block, o, s)
+        for o, s in bgzf.iter_block_offsets(block)
+    )
+
+
 def _iter_bgzf_stream(f, read_size=4 << 20, native_lib=None, n_threads=0):
     """Yield decompressed byte chunks from a BGZF (or raw BAM) file obj.
 
@@ -199,18 +225,13 @@ def _iter_bgzf_stream(f, read_size=4 << 20, native_lib=None, n_threads=0):
                 block = buf[:off]
                 if native_lib is not None:
                     yield _io_retry(
-                        "bgzf.inflate",
-                        lambda: _inflate_native(native_lib, block, n_threads),
-                        "BGZF inflate",
+                        "bgzf.inflate", _inflate_native, "BGZF inflate",
+                        native_lib, block, n_threads,
                     )
                 else:
                     yield _io_retry(
-                        "bgzf.inflate",
-                        lambda: b"".join(
-                            bgzf.decompress_block(block, o, s)
-                            for o, s in bgzf.iter_block_offsets(block)
-                        ),
-                        "BGZF inflate",
+                        "bgzf.inflate", _inflate_python, "BGZF inflate",
+                        block,
                     )
             buf = buf[off:]
             if not data:
@@ -950,6 +971,16 @@ def stream_call_consensus(
     # host packing + H2D of chunk k+1 overlaps device compute of chunk
     # k without unbounded device-buffer pileup. Output bytes are
     # identical at any depth.
+    ingest_overlap: str = "auto",  # bounded background producer:
+    # "auto"/"on" run BGZF read + decode + host prep (bucketing) on a
+    # dedicated ingest thread that works up to prefetch_depth prepped
+    # chunks AHEAD of the main loop, handing chunks off through a
+    # depth-bounded queue whose bound couples ingest back-pressure to
+    # the same window as the H2D prefetch semaphore; "off" keeps the
+    # fully synchronous main-loop ingest (today's exact path). A
+    # scheduling decision like the mesh: output bytes are identical
+    # either way, and the knob stays OUT of the checkpoint fingerprint
+    # so overlap-on runs can resume overlap-off prefixes and vice versa.
     bucket_ladder="off",  # mixed-capacity bucket ladder (tuning/):
     # "off" = the single --capacity (legacy), "auto" = profile the
     # first chunk's group-size histogram and pick a 1-3 rung ladder by
@@ -1033,6 +1064,7 @@ def stream_call_consensus(
             per_base_tags=per_base_tags, read_group=read_group,
             write_index=write_index, packed=packed,
             d2h_packed=d2h_packed, prefetch_depth=prefetch_depth,
+            ingest_overlap=ingest_overlap,
             bucket_ladder=bucket_ladder,
             tr=tr, heartbeat_s=heartbeat_s, hb_box=hb_box,
             provenance_cl=provenance_cl,
@@ -1076,6 +1108,7 @@ def _stream_call(
     packed: str = "auto",
     d2h_packed: str = "auto",
     prefetch_depth: int = 2,
+    ingest_overlap: str = "auto",
     bucket_ladder="off",
     tr: TraceRecorder | None = None,
     heartbeat_s: float = 0.0,
@@ -1134,6 +1167,15 @@ def _stream_call(
         raise ValueError(f"packed must be auto/byte/off, got {packed!r}")
     if d2h_packed not in ("auto", "off"):
         raise ValueError(f"d2h_packed must be auto/off, got {d2h_packed!r}")
+    if ingest_overlap not in ("auto", "on", "off"):
+        raise ValueError(
+            f"ingest_overlap must be auto/on/off, got {ingest_overlap!r}"
+        )
+    # auto == on: the producer pipeline is pure scheduling (byte-
+    # identical output, proven by the A/B matrix), so there is nothing
+    # input-dependent for "auto" to resolve — it exists so callers can
+    # express "the default" without pinning today's default
+    overlap_on = ingest_overlap != "off"
     from duplexumiconsensusreads_tpu import tuning
 
     # bucket-ladder resolution: an explicit ladder is known now (its
@@ -1150,6 +1192,7 @@ def _stream_call(
     if isinstance(ladder_mode, tuple):
         rep.bucket_ladder = [int(r) for r in ladder_mode]
     rep.n_drain_workers = drain_workers
+    rep.ingest_overlap = overlap_on
     duplex = consensus.mode == "duplex"
     # monotonic everywhere in phase accounting: an NTP step mid-run
     # would corrupt wall-clock deltas (negative or inflated phases)
@@ -1278,6 +1321,7 @@ def _stream_call(
         "device_wait_fetch": 0.0, "scatter": 0.0, "deflate": 0.0,
         "shard_write": 0.0, "ckpt": 0.0, "finalise": 0.0,
         "main_loop_stall": 0.0, "prefetch_stall": 0.0,
+        "ingest_stall": 0.0, "ingest_backpressure": 0.0,
     }
     # byte-ledger running totals (telemetry/ledger.py), maintained only
     # while tracing: every `led[...] +=` below pairs with a tr.xfer()
@@ -1926,6 +1970,79 @@ def _stream_call(
         done_q[k] = res
         _advance_frontier()
 
+    def _prep_chunk(k, batch):
+        """Per-chunk host prep: family downsample → (one-shot) ladder
+        resolution → build_buckets → qual-alphabet union. ONE shared
+        implementation for the forced-sync path (runs inline on the
+        main thread, today's exact order) and the overlap producer
+        (runs on the dut-ingest thread, ahead of the main loop). Either
+        way there is exactly ONE caller at a time processing chunks in
+        chunk order, so the run_ladder / alpha_seen mutations stay
+        sequential and the decisions — and therefore the output
+        bytes — are identical across modes."""
+        nonlocal run_ladder, ladder_auto, alpha_seen
+        n_down = 0
+        if max_reads > 0:
+            n_down = downsample_families(batch, max_reads)
+        fb: dict = {}
+        t0 = time.monotonic()
+        if ladder_auto:
+            # profile pass (host-only, once per run): the first
+            # non-empty chunk's position-group size sequence feeds
+            # the tuner's padded-cycles cost model; the verdict is
+            # pinned for the whole run so compile classes stay
+            # stable, and it is LEDGERED so any capture can audit
+            # the shape decision
+            sizes = tuning.group_sizes(batch)
+            if len(sizes):
+                verdict = tuning.choose_ladder(
+                    sizes, capacity, pack_mult=n_data
+                )
+                run_ladder = (
+                    verdict.ladder if len(verdict.ladder) > 1 else None
+                )
+                ladder_auto = False
+                rep.bucket_ladder = [int(r) for r in verdict.ladder]
+                if tr is not None:
+                    tr.event(
+                        "tuner_verdict", chunk=k,
+                        ladder=list(verdict.ladder),
+                        fill_factor=verdict.fill_factor,
+                        fill_factor_off=verdict.fill_factor_off,
+                        predicted_speedup=verdict.predicted_speedup,
+                        n_groups=verdict.n_groups,
+                        source=verdict.source,
+                    )
+        buckets = build_buckets(
+            batch, capacity=capacity, grouping=grouping, counters=fb,
+            ladder=run_ladder,
+        )
+        # the run's real-cycle qual alphabet feeds the sub-byte
+        # rung decision: one scan per chunk, accumulated into a
+        # MONOTONE-GROWING run-level union so a rare qual bin
+        # absent from some chunks cannot flip the lut back and
+        # forth and recompile the pipeline per chunk — the lut only
+        # ever grows (bounded by the dictionary capacity, after
+        # which the class falls back to the byte rung). A superset
+        # lut stays exact for every chunk: searchsorted is an exact
+        # index for any member. ("byte" caps the ladder.)
+        alpha = None
+        if packed == "auto" and buckets and alpha_seen is not None:
+            alpha_seen.update(qual_alphabet(buckets))
+            if len(alpha_seen) > _ALPHA_CAP:
+                # every dictionary width has overflowed for good
+                # (the union only grows): stop paying the per-chunk
+                # scan — the byte rung owns the rest of the run
+                alpha_seen = None
+            else:
+                alpha = tuple(sorted(alpha_seen))
+        dt = time.monotonic() - t0
+        with phase_lock:
+            phase["bucketing"] += dt
+        if tr is not None:
+            tr.span("bucketing", t0, dt, chunk=k, n_buckets=len(buckets))
+        return buckets, alpha, fb, n_down
+
     def timed_chunks(it):
         i = chunk_base
         while True:
@@ -1969,11 +2086,151 @@ def _stream_call(
         if hb_box is not None:
             hb_box.append(hb)
 
+    # ---- bounded background producer (--ingest-overlap) ----
+    # Overlap mode moves ingest (BGZF read + inflate + chunk parse) AND
+    # host prep (_prep_chunk) onto one dedicated "dut-ingest" thread
+    # that works ahead of the main loop, so BGZF/decode/bucketing of
+    # chunk k+1..k+D overlap device compute of chunk k. The handoff
+    # queue is bounded at prefetch_depth: together with the prefetch
+    # semaphore (taken by the main loop at dispatch) total in-flight
+    # chunks stay bounded by the SAME window — the producer can run at
+    # most depth prepped chunks ahead, then blocks (the
+    # "ingest_backpressure" span). The producer emits strictly in chunk
+    # order, so the consumer sees exactly the sequence the sync path
+    # would — which is why output bytes are provably identical across
+    # modes. Producer errors (typed OSErrors past the retry ladder,
+    # InjectedKill, anything) forward through the queue's error
+    # sentinel and re-raise on the main loop, preserving the sync
+    # path's exception surface; GIL note: the native inflate, zlib,
+    # numpy packing and file reads all release the GIL, so the overlap
+    # is real even on CPU-simulated devices.
+    ingest_thread: threading.Thread | None = None
+    if overlap_on:
+        ingest_q: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
+        # resume-skip snapshot: ckpt.done only ever grows with marks
+        # for chunks the frontier already committed (all < the chunk
+        # the producer is looking at), so this pre-loop snapshot equals
+        # the sync path's live per-chunk membership check
+        done_set = frozenset(int(s) for s in ckpt.done) if ckpt else frozenset()
+
+        def _q_put(item, chunk):
+            # named chaos site on every handoff: transient faults ride
+            # the standard bounded-retry ladder ON the producer thread;
+            # a kill unwinds into the error sentinel in
+            # _ingest_producer and surfaces on the main loop — the
+            # exactly-once resume contract the chaos matrix asserts
+            _io_retry("ingest.queue", _noop, "ingest queue handoff")
+            t0 = time.monotonic()
+            while True:
+                if aborting.is_set():
+                    raise _IngestAbort()
+                try:
+                    ingest_q.put(item, timeout=0.05)
+                    break
+                except _queue.Full:
+                    continue
+            dt = time.monotonic() - t0
+            with phase_lock:
+                phase["ingest_backpressure"] += dt
+            if tr is not None:
+                tr.span("ingest_backpressure", t0, dt, chunk=chunk)
+
+        def _ingest_producer():
+            try:
+                it = iter(chunk_iter)
+                k = chunk_base
+                while True:
+                    t0 = time.monotonic()
+                    item = next(it, None)
+                    dt = time.monotonic() - t0
+                    with phase_lock:
+                        phase["ingest"] += dt
+                    if tr is not None:
+                        # the final (None-returning) read keeps its
+                        # span too — chunkless, so the per-stage sums
+                        # still match phase (the trace sum-check)
+                        tr.span(
+                            "ingest", t0, dt,
+                            chunk=k if item is not None else None,
+                        )
+                    if item is None:
+                        _q_put(("done", None), None)
+                        return
+                    prep = None
+                    if k not in done_set:
+                        # resume-skipped chunks splice their shard
+                        # straight from disk — prepping them would also
+                        # disturb the ladder/alphabet resolution order
+                        # (the first non-skipped non-empty chunk
+                        # decides, same as the sync path)
+                        prep = _prep_chunk(k, item[1])
+                    _q_put(("item", (k, item, prep)), k)
+                    k += 1
+            except _IngestAbort:
+                pass  # run is going down; the main loop owns the error
+            except BaseException as e:
+                # forward EVERYTHING (InjectedKill included) to the
+                # main loop: producer errors must surface there with
+                # the same typed exceptions as the sync path. Bounded
+                # best-effort put: either the consumer reads it, or the
+                # run is already aborting for another reason.
+                while not aborting.is_set():
+                    try:
+                        ingest_q.put(("err", e), timeout=0.05)
+                        break
+                    except _queue.Full:
+                        continue
+
+        ingest_thread = threading.Thread(
+            target=_ingest_producer, name="dut-ingest", daemon=True
+        )
+
+        def _overlap_chunks():
+            while True:
+                t0 = time.monotonic()
+                while True:
+                    try:
+                        kind, payload = ingest_q.get(timeout=0.05)
+                        break
+                    except _queue.Empty:
+                        if not ingest_thread.is_alive() and ingest_q.empty():
+                            # crashed without a sentinel (the forward
+                            # loop above is total, so this should be
+                            # impossible): fail loudly, never spin
+                            raise RuntimeError(
+                                "ingest producer died without a result"
+                            )
+                dt = time.monotonic() - t0
+                phase["ingest_stall"] += dt
+                if tr is not None:
+                    tr.span(
+                        "ingest_stall", t0, dt,
+                        chunk=payload[0] if kind == "item" else None,
+                    )
+                if kind == "done":
+                    return
+                if kind == "err":
+                    raise payload
+                yield payload
+
+        chunk_stream = _overlap_chunks()
+    else:
+
+        def _sync_chunks():
+            # forced-sync path: today's exact main-loop ingest; prep
+            # runs inline in the consumer body (prep=None below)
+            for k, item in enumerate(
+                timed_chunks(iter(chunk_iter)), start=chunk_base
+            ):
+                yield k, item, None
+
+        chunk_stream = _sync_chunks()
+
     n_skipped = 0
     try:
-        for k, (header, batch, info) in enumerate(
-            timed_chunks(iter(chunk_iter)), start=chunk_base
-        ):
+        if ingest_thread is not None:
+            ingest_thread.start()
+        for k, (header, batch, info), prep in chunk_stream:
             if header_out is None:
                 header_out = header
                 # collision-free consensus @RG, resolved once from the
@@ -2039,64 +2296,13 @@ def _stream_call(
                 )
 
                 _warnings.warn(MIXED_MATE_WARNING)
-            if max_reads > 0:
-                rep.n_downsampled_reads += downsample_families(batch, max_reads)
-            fb: dict = {}
-            t0 = time.monotonic()
-            if ladder_auto:
-                # profile pass (host-only, once per run): the first
-                # non-empty chunk's position-group size sequence feeds
-                # the tuner's padded-cycles cost model; the verdict is
-                # pinned for the whole run so compile classes stay
-                # stable, and it is LEDGERED so any capture can audit
-                # the shape decision
-                sizes = tuning.group_sizes(batch)
-                if len(sizes):
-                    verdict = tuning.choose_ladder(
-                        sizes, capacity, pack_mult=n_data
-                    )
-                    run_ladder = (
-                        verdict.ladder if len(verdict.ladder) > 1 else None
-                    )
-                    ladder_auto = False
-                    rep.bucket_ladder = [int(r) for r in verdict.ladder]
-                    if tr is not None:
-                        tr.event(
-                            "tuner_verdict", chunk=k,
-                            ladder=list(verdict.ladder),
-                            fill_factor=verdict.fill_factor,
-                            fill_factor_off=verdict.fill_factor_off,
-                            predicted_speedup=verdict.predicted_speedup,
-                            n_groups=verdict.n_groups,
-                            source=verdict.source,
-                        )
-            buckets = build_buckets(
-                batch, capacity=capacity, grouping=grouping, counters=fb,
-                ladder=run_ladder,
-            )
-            # the run's real-cycle qual alphabet feeds the sub-byte
-            # rung decision: one scan per chunk, accumulated into a
-            # MONOTONE-GROWING run-level union so a rare qual bin
-            # absent from some chunks cannot flip the lut back and
-            # forth and recompile the pipeline per chunk — the lut only
-            # ever grows (bounded by the dictionary capacity, after
-            # which the class falls back to the byte rung). A superset
-            # lut stays exact for every chunk: searchsorted is an exact
-            # index for any member. ("byte" caps the ladder.)
-            alpha = None
-            if packed == "auto" and buckets and alpha_seen is not None:
-                alpha_seen.update(qual_alphabet(buckets))
-                if len(alpha_seen) > _ALPHA_CAP:
-                    # every dictionary width has overflowed for good
-                    # (the union only grows): stop paying the per-chunk
-                    # scan — the byte rung owns the rest of the run
-                    alpha_seen = None
-                else:
-                    alpha = tuple(sorted(alpha_seen))
-            dt = time.monotonic() - t0
-            phase["bucketing"] += dt
-            if tr is not None:
-                tr.span("bucketing", t0, dt, chunk=k, n_buckets=len(buckets))
+            if prep is None:
+                # forced-sync mode: host prep runs inline on the main
+                # thread — exactly today's order (the overlap producer
+                # pre-computed it for every fresh chunk it handed over)
+                prep = _prep_chunk(k, batch)
+            buckets, alpha, fb, n_down = prep
+            rep.n_downsampled_reads += n_down
             for fk, fv in fb.items():
                 setattr(rep, fk, getattr(rep, fk) + fv)
             rep.n_buckets += len(buckets)
@@ -2166,6 +2372,14 @@ def _stream_call(
                 pass
         raise
     finally:
+        if ingest_thread is not None and ingest_thread.is_alive():
+            # normal exit: the producer already returned after "done";
+            # error exit: aborting is set (above), so a producer
+            # blocked on the full queue unwinds within one put timeout.
+            # The bounded join is a backstop against a producer stuck
+            # deep in retry backoff — it is a daemon thread, so even
+            # the pathological case cannot hang process exit.
+            ingest_thread.join(timeout=30.0)
         # drop queued-but-unstarted drain tasks and transfers on the
         # error path — their results would never be committed; running
         # ones complete (their shard writes are harmless without marks)

@@ -38,6 +38,7 @@ CONFIG_DEFAULTS = {
     "drain_workers": 2,
     "packed": "auto",
     "prefetch_depth": 2,
+    "ingest_overlap": "auto",
     "bucket_ladder": "off",
     "mesh": "auto",
     "mate_aware": "auto",
@@ -53,6 +54,7 @@ _CHOICES = {
     "error_model": {"none", "cycle"},
     "mate_aware": {"auto", "on", "off"},
     "packed": {"auto", "byte", "off"},
+    "ingest_overlap": {"auto", "on", "off"},
 }
 
 
@@ -270,6 +272,7 @@ def job_params(spec: JobSpec):
         drain_workers=c["drain_workers"],
         packed=c["packed"],
         prefetch_depth=c["prefetch_depth"],
+        ingest_overlap=c["ingest_overlap"],
         bucket_ladder=_normalized_ladder(c),
         # "auto" -> None: the worker resolves the mesh within its own
         # device pool (run_slice pops this key; it is not a
@@ -308,6 +311,14 @@ def serve_provenance(config: dict) -> str:
             # it against ITS device pool — embedding it in the @PG CL
             # would make job bytes depend on serving topology, breaking
             # bytes == f(input, config). Excluded like bucket_ladder.
+            continue
+        if key == "ingest_overlap":
+            # the producer pipeline is a SCHEDULING knob that provably
+            # cannot change output bytes (the producer emits in chunk
+            # order, so the consumer sees the sync path's exact
+            # sequence) — embedding it in the @PG CL would make job
+            # bytes depend on how a daemon chose to overlap its host
+            # work. Excluded like mesh, for the same reason.
             continue
         if key == "bucket_ladder":
             # the ladder is a SHAPE knob that provably cannot change

@@ -80,8 +80,8 @@ __all__ = [
     "FLEET_SEGMENT_KINDS", "FLEET_GAP_KINDS", "FLEET_METRIC_KEYS",
     "seg_rec", "gap_rec", "discover_service_captures",
     "load_capture", "load_captures", "load_journal",
-    "load_metrics_docs", "stitch", "fleet_metrics", "render_prom",
-    "check_slo", "render_report",
+    "load_metrics_docs", "stitch", "fleet_metrics", "run_overlap",
+    "render_prom", "check_slo", "render_report",
 ]
 
 # Timeline segment kinds: what a daemon was doing while it held the
@@ -826,6 +826,40 @@ def fleet_metrics(
         "n_problems": len(stitched["problems"]),
     }
     return out
+
+
+def run_overlap(run_caps: list[dict]) -> dict:
+    """Ingest-overlap efficiency aggregated over the fleet's per-run
+    captures (the ``run``-kind captures that ride along for the
+    Perfetto export). Per run: :func:`ledger.overlap_stats`; fleet
+    level: byte-ledger-style exact sums, so the fleet efficiency is
+    overlap seconds over ingest-busy seconds ACROSS runs — a long run
+    weighs proportionally, not one-run-one-vote. Returns {} when no
+    run capture carries ingest spans (service-only spools)."""
+    from duplexumiconsensusreads_tpu.telemetry import ledger
+
+    per: dict[str, dict] = {}
+    ingest = overlap = stall = backpressure = 0.0
+    for cap in run_caps:
+        ov = ledger.overlap_stats(cap["records"])
+        if not ov:
+            continue
+        per[os.path.basename(cap["path"])] = ov
+        ingest += ov["ingest_busy_s"]
+        overlap += ov["overlap_s"]
+        stall += ov["stall_s"]
+        backpressure += ov["backpressure_s"]
+    if not per:
+        return {}
+    return {
+        "n_runs": len(per),
+        "ingest_busy_s": round(ingest, 3),
+        "overlap_s": round(overlap, 3),
+        "efficiency": round(overlap / ingest, 4) if ingest > 0 else 0.0,
+        "stall_s": round(stall, 3),
+        "backpressure_s": round(backpressure, 3),
+        "runs": per,
+    }
 
 
 # ----------------------------------------------------------- exposition

@@ -63,7 +63,7 @@ __all__ = [
     "KNOWN_XFER_DIRS", "SUMMARY_BYTE_KEYS", "xfer_records", "byte_totals",
     "bandwidth_stats", "wire_floor", "packing_stats", "per_chunk_bytes",
     "summary_bytes", "sum_check_bytes", "output_check", "fill_stats",
-    "device_lanes",
+    "device_lanes", "overlap_stats",
 ]
 
 # summary["bytes"] keys the executor embeds (all integers; *_logical
@@ -99,6 +99,100 @@ def _union_seconds(intervals: list[tuple[float, float]]) -> float:
     if cur_b is not None:
         total += cur_b - cur_a
     return total
+
+
+def _merged(intervals: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    """Sorted, non-overlapping form of an interval set (the list
+    :func:`_union_seconds` measures, materialised for intersection)."""
+    out: list[tuple[float, float]] = []
+    for a, b in sorted(intervals):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _intersect_seconds(
+    a: list[tuple[float, float]], b: list[tuple[float, float]]
+) -> float:
+    """Total length of the intersection of two interval sets — the
+    wall time during which BOTH activities were genuinely in flight."""
+    a, b = _merged(a), _merged(b)
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+# the two sides of the ingest-overlap ledger: host-side chunk prep
+# (read/inflate/parse + bucketing — the work the background producer
+# exists to hide) vs the device-facing pipeline it must hide BEHIND
+_INGEST_STAGES = ("ingest", "bucketing")
+_DEVICE_STAGES = ("dispatch", "mesh_h2d", "device_wait_fetch")
+
+
+def overlap_stats(records: list[dict]) -> dict:
+    """How much of the host-side ingest work the pipelined producer
+    actually hid behind device-facing work — the measured verdict on
+    the ingest-overlap knob, from the capture's own spans.
+
+    ``ingest_busy_s`` is the wall occupancy (interval union) of the
+    ingest + bucketing spans; ``device_busy_s`` the same for dispatch /
+    mesh H2D / device-wait-fetch; ``overlap_s`` their intersection —
+    wall time when chunk prep and device work ran concurrently.
+    ``efficiency`` = overlap_s / ingest_busy_s: 0 is the strictly
+    serial pre-overlap pipeline, 1 means every second of host prep was
+    hidden. ``mode`` reports which path the run took ("overlap" when
+    any span rode the producer's "ingest" lane, else "sync"), and
+    ``stall_s`` / ``backpressure_s`` carry the two residue stages —
+    what the pipeline could NOT hide, and how long the producer sat on
+    a full handoff queue. Returns {} for captures with no ingest spans
+    (compute-only or pre-span captures)."""
+    ing: list[tuple[float, float]] = []
+    dev: list[tuple[float, float]] = []
+    stall_s = backpressure_s = 0.0
+    saw_ingest_lane = False
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("type") != "span":
+            continue
+        stage = rec.get("stage")
+        t = float(rec.get("t", 0.0))
+        dur = float(rec.get("dur", 0.0))
+        if stage in _INGEST_STAGES:
+            ing.append((t, t + dur))
+            if rec.get("lane") == "ingest":
+                saw_ingest_lane = True
+        elif stage in _DEVICE_STAGES:
+            dev.append((t, t + dur))
+        elif stage == "ingest_stall":
+            stall_s += dur
+        elif stage == "ingest_backpressure":
+            backpressure_s += dur
+    if not ing:
+        return {}
+    ingest_busy = _union_seconds(ing)
+    device_busy = _union_seconds(dev)
+    overlap = _intersect_seconds(ing, dev)
+    return {
+        "mode": "overlap" if saw_ingest_lane else "sync",
+        "ingest_busy_s": round(ingest_busy, 3),
+        "device_busy_s": round(device_busy, 3),
+        "overlap_s": round(overlap, 3),
+        "efficiency": (
+            round(overlap / ingest_busy, 4) if ingest_busy > 0 else 0.0
+        ),
+        "stall_s": round(stall_s, 3),
+        "backpressure_s": round(backpressure_s, 3),
+    }
 
 
 def byte_totals(records: list[dict]) -> dict[str, dict]:

@@ -73,6 +73,13 @@ KNOWN_STAGES = (
     "prefetch_stall",  # main loop blocked on the bounded H2D prefetch
     # window (--prefetch-depth): dispatch of chunk k+depth may not start
     # until chunk k's device results are materialised (main)
+    "ingest_stall",  # overlap mode: main loop blocked waiting for the
+    # ingest producer's next chunk (main) — the honest residue of
+    # ingest cost the background pipeline could NOT hide behind device
+    # time; 0 in forced-sync mode, where "ingest" itself is main wall
+    "ingest_backpressure",  # overlap mode: the ingest producer blocked
+    # on the full bounded handoff queue (ingest lane) — ingest running
+    # AHEAD of the pipeline, the healthy steady state
 )
 
 # Structured point events. Attrs are per-name (see the emitting sites);
@@ -163,17 +170,20 @@ KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap", "mesh_pad")
 # dutlint's phase-registry rule pins every literal ``lane=`` argument
 # (f-string prefixes included) to this registry, so a typo'd lane
 # family cannot silently fork the capture schema consumers group by.
-KNOWN_LANE_PREFIXES = ("main", "xfer-", "drain-", "job-", "dev-")
+KNOWN_LANE_PREFIXES = ("main", "xfer-", "drain-", "job-", "dev-", "ingest")
 
 
 def current_lane() -> str:
     """Lane id of the calling thread. The executor's pools carry
     ``dut-`` thread-name prefixes precisely so spans can self-identify:
-    ``main`` / ``xfer-N`` / ``drain-N``; anything else keeps its raw
-    thread name (still a valid lane)."""
+    ``main`` / ``xfer-N`` / ``drain-N`` / ``ingest`` (the background
+    producer); anything else keeps its raw thread name (still a valid
+    lane)."""
     name = threading.current_thread().name
     if name == "MainThread":
         return "main"
+    if name == "dut-ingest":
+        return "ingest"
     for prefix, lane in (("dut-xfer_", "xfer-"), ("dut-drain_", "drain-")):
         if name.startswith(prefix):
             return lane + name[len(prefix):]

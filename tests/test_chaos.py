@@ -202,6 +202,13 @@ BOUNDARY_KILLS = [
     # chunk is durable, so resume recomputes it
     ("dispatch.pack", 2),
     ("fetch.unpack", 2),
+    # pipelined-ingest site: killed at the producer thread's 2nd queue
+    # handoff (default ingest_overlap=auto runs the background producer)
+    # — the kill must cross the thread boundary and surface on the main
+    # loop as the same typed exception, with nothing durable yet for
+    # chunks the consumer never committed, so resume recomputes exactly
+    # the missing suffix
+    ("ingest.queue", 2),
 ]
 
 

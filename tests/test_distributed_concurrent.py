@@ -184,7 +184,9 @@ def test_concurrent_hosts_kill_and_resume(tmp_path):
         env=_cpu_env(), capture_output=True, text=True, timeout=300,
     )
     assert rc.returncode == 0, rc.stderr[-3000:]
-    with open(report) as f:
+    # multihost runs suffix the report per host (a shared --report path
+    # would have every host clobber the same file)
+    with open(report + ".host1") as f:
         rep = json.load(f)
     assert rep["n_chunks_skipped"] == len(done_before_resume)
     if killed:

@@ -47,7 +47,7 @@ class TestEngine:
             "lease-discipline", "deadline-discipline", "host-locality",
             # the protocol model-checker passes
             "state-machine", "txn-discipline", "fence-dominance",
-            "exception-contract",
+            "exception-contract", "ingest-confinement",
         } <= set(RULES)
         for rule in RULES.values():
             assert rule.title
@@ -1691,6 +1691,83 @@ class TestExceptionContract:
             rules=["exception-contract"],
         )
         assert res.ok  # scope is runtime/ + serve/ only
+
+
+class TestIngestConfinement:
+    # a confined producer: pure host prep handed off through the
+    # bounded queue only, consumer structures untouched
+    STREAM_OK = """
+        import queue as _queue
+        def _stream_call(chunk_iter, prefetch_depth):
+            ingest_q = _queue.Queue(maxsize=prefetch_depth)
+            def _prep_chunk(k, batch):
+                return [batch]
+            def _q_put(item):
+                ingest_q.put(item, timeout=0.05)
+            def _ingest_producer():
+                for k, item in enumerate(chunk_iter):
+                    prep = _prep_chunk(k, item)
+                    _q_put(("item", (k, item, prep)))
+                _q_put(("done", None))
+        """
+
+    def base(self, src=STREAM_OK):
+        return lint(
+            {"pkg/runtime/stream.py": src}, rules=["ingest-confinement"]
+        )
+
+    def test_passes_on_a_confined_producer(self):
+        assert self.base().ok
+
+    def test_passes_when_no_overlap_machinery_exists(self):
+        # pre-overlap corpora (the other fixture corpora here) owe
+        # nothing to this rule
+        assert self.base("def _stream_call():\n    pass\n").ok
+
+    def test_fires_on_device_call_from_producer(self):
+        res = self.base(self.STREAM_OK.replace(
+            "return [batch]", "return device_put(batch)"
+        ))
+        assert not res.ok
+        assert any("device" in f.message for f in res.findings)
+
+    def test_fires_on_checkpoint_mark_from_producer(self):
+        res = self.base(self.STREAM_OK.replace(
+            "return [batch]", "ckpt.mark(k)\n                return [batch]"
+        ))
+        assert not res.ok
+        assert any("durable" in f.message or "ckpt" in f.message
+                   for f in res.findings)
+
+    def test_fires_on_consumer_structure_reference(self):
+        res = self.base(self.STREAM_OK.replace(
+            "_q_put((\"done\", None))",
+            "prefetch_sem.release()",
+        ))
+        assert rules_of(res) == [
+            ("ingest-confinement", "pkg/runtime/stream.py")
+        ]
+        assert "prefetch_sem" in res.findings[0].message
+
+    def test_fires_on_put_to_a_foreign_queue(self):
+        res = self.base(self.STREAM_OK.replace(
+            "ingest_q.put(item, timeout=0.05)",
+            "other_q.put(item, timeout=0.05)",
+        ))
+        assert not res.ok
+        assert any("handoff" in f.message or "handoff" in f.hint
+                   for f in res.findings)
+
+    def test_fires_when_the_anchor_function_is_renamed_away(self):
+        # overlap machinery present (thread name literal) but no
+        # _ingest_producer: the rule must refuse to silently skip
+        res = self.base("""
+            import threading
+            def _stream_call():
+                t = threading.Thread(target=None, name="dut-ingest")
+            """)
+        assert not res.ok
+        assert "_ingest_producer" in res.findings[0].message
 
 
 # ------------------------------------------------------------------- CLI

@@ -535,6 +535,7 @@ def test_wire_diet_knobs_validated():
         (dict(prefetch_depth=0), "prefetch_depth"),
         (dict(packed="subbyte"), "packed"),
         (dict(d2h_packed="on"), "d2h_packed"),
+        (dict(ingest_overlap="background"), "ingest_overlap"),
     ]:
         with pytest.raises(ValueError, match=match):
             stream_call_consensus(
@@ -606,6 +607,94 @@ class TestWireDietMatrix:
             with open(out, "rb") as f:
                 assert f.read() == ref_bytes
         assert reps["auto"].bytes_h2d < reps["byte"].bytes_h2d
+
+
+class TestIngestOverlap:
+    """The pipelined-ingest acceptance A/B: the background producer is
+    a scheduling transform, never a result transform — every combination
+    of ingest_overlap rung (off / on / auto=on) and prefetch depth
+    (1 / 2, which bounds the handoff queue) on the 2-virtual-device mesh
+    must produce output BYTE-IDENTICAL to the synchronous serial
+    reference, with the report flag telling the truth about which path
+    ran."""
+
+    @pytest.fixture(scope="class")
+    def overlap_sim(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("ingestoverlap")
+        path = str(d / "in.bam")
+        cfg = SimConfig(n_molecules=60, n_positions=8, umi_error=0.02, seed=31)
+        simulated_bam(cfg, path=path, sort=True)
+        gp = GroupingParams(strategy="adjacency", paired=True)
+        cp = ConsensusParams(mode="duplex")
+        ref = str(d / "ref.bam")
+        rep = stream_call_consensus(
+            path, ref, gp, cp, capacity=128, chunk_reads=90,
+            ingest_overlap="off", prefetch_depth=1,
+        )
+        assert rep.n_chunks >= 3  # several producer handoffs per run
+        assert rep.ingest_overlap is False
+        with open(ref, "rb") as f:
+            return path, gp, cp, f.read(), rep
+
+    @pytest.mark.parametrize("overlap", ["off", "on", "auto"])
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_byte_identity(self, overlap_sim, tmp_path, overlap, depth):
+        path, gp, cp, ref_bytes, ref_rep = overlap_sim
+        out = str(tmp_path / f"ov_{overlap}_{depth}.bam")
+        rep = stream_call_consensus(
+            path, out, gp, cp, capacity=128, chunk_reads=90,
+            ingest_overlap=overlap, prefetch_depth=depth,
+        )
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        assert rep.n_consensus == ref_rep.n_consensus
+        assert rep.n_chunks == ref_rep.n_chunks
+        assert rep.ingest_overlap is (overlap != "off")
+        # the knob schedules host work, it never moves different bytes
+        assert rep.bytes_h2d == ref_rep.bytes_h2d
+        assert rep.bytes_d2h == ref_rep.bytes_d2h
+
+    def test_overlap_run_reports_ingest_lane_and_stall_keys(
+        self, overlap_sim, tmp_path
+    ):
+        """An overlap run's trace carries ingest/bucketing spans on the
+        dedicated ingest lane, and the report's seconds table accounts
+        the producer's stall/backpressure phases."""
+        from duplexumiconsensusreads_tpu.telemetry.report import validate_trace
+
+        path, gp, cp, ref_bytes, _ = overlap_sim
+        out = str(tmp_path / "traced.bam")
+        tr = str(tmp_path / "traced.trace.jsonl")
+        rep = stream_call_consensus(
+            path, out, gp, cp, capacity=128, chunk_reads=90,
+            ingest_overlap="on", trace_path=tr,
+        )
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        assert rep.ingest_overlap is True
+        assert {"ingest_stall", "ingest_backpressure"} <= set(rep.seconds)
+        with open(tr) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        assert validate_trace(records) == []
+        lanes = {
+            r.get("lane") for r in records
+            if r.get("type") == "span" and r.get("stage") in ("ingest", "bucketing")
+        }
+        assert "ingest" in lanes
+
+    def test_off_run_has_no_ingest_lane(self, overlap_sim, tmp_path):
+        path, gp, cp, ref_bytes, _ = overlap_sim
+        out = str(tmp_path / "sync.bam")
+        tr = str(tmp_path / "sync.trace.jsonl")
+        stream_call_consensus(
+            path, out, gp, cp, capacity=128, chunk_reads=90,
+            ingest_overlap="off", trace_path=tr,
+        )
+        with open(tr) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        assert not any(
+            r.get("lane") == "ingest" for r in records if r.get("type") == "span"
+        )
 
 
 class TestPackingRungSelection:

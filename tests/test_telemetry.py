@@ -223,8 +223,11 @@ class TestStreamCapture:
                 continue
             if r["stage"] in ("scatter", "deflate", "device_wait_fetch"):
                 assert r["lane"].startswith("drain-"), r
-            if r["stage"] in ("ingest", "bucketing", "ckpt", "finalise",
-                              "main_loop_stall"):
+            # ingest/bucketing ride the producer's ingest lane when the
+            # pipelined-ingest default (auto=on) runs them off-thread
+            if r["stage"] in ("ingest", "bucketing"):
+                assert r["lane"] in ("main", "ingest"), r
+            if r["stage"] in ("ckpt", "finalise", "main_loop_stall"):
                 assert r["lane"] == "main", r
 
     def test_sum_check_against_report_seconds(self, traced):
@@ -835,7 +838,7 @@ class TestReportShape:
             "n_dropped_cigar_ba", "n_projected_reads",
             "n_projection_fallback_reads", "n_projection_fallback_groups",
             "n_projection_unanchored_reads", "n_umi_corrected",
-            "n_dropped_whitelist", "mate_aware", "backend",
+            "n_dropped_whitelist", "mate_aware", "ingest_overlap", "backend",
             "bytes_h2d", "bytes_d2h", "n_rows_real", "n_rows_padded",
             "n_mesh_pad_buckets", "bucket_ladder", "seconds",
         }
@@ -850,7 +853,8 @@ class TestReportShape:
             "ingest", "bucketing", "dispatch", "mesh_h2d",
             "device_wait_fetch",
             "scatter", "deflate", "shard_write", "ckpt", "finalise",
-            "main_loop_stall", "prefetch_stall", "drain_utilization",
+            "main_loop_stall", "prefetch_stall", "ingest_stall",
+            "ingest_backpressure", "drain_utilization",
             "total",
         }
 

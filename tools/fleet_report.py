@@ -122,6 +122,9 @@ def main(argv: list[str] | None = None) -> int:
 
     stitched = fleet.stitch(captures, journal=journal)
     metrics = fleet.fleet_metrics(stitched, metrics_docs=metrics_docs)
+    # ingest-overlap efficiency aggregated over any per-run captures
+    # that rode along ({} when none carry ingest spans)
+    overlap = fleet.run_overlap(captures.get("run", ()))
 
     slo_rows = None
     slo_ok = True
@@ -160,6 +163,7 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps({
             "jobs": stitched["jobs"],
             "metrics": metrics,
+            "overlap": overlap,
             "problems": stitched["problems"],
             "warnings": stitched["warnings"],
             "slo": slo_rows,
@@ -168,6 +172,15 @@ def main(argv: list[str] | None = None) -> int:
     else:
         for line in fleet.render_report(stitched, metrics):
             print(line)
+        if overlap:
+            print()
+            print(
+                f"ingest overlap ({overlap['n_runs']} runs): prep "
+                f"{overlap['ingest_busy_s']}s hidden "
+                f"{overlap['overlap_s']}s = efficiency "
+                f"{overlap['efficiency']}  stall {overlap['stall_s']}s  "
+                f"backpressure {overlap['backpressure_s']}s"
+            )
         if slo_rows is not None:
             print()
             for r in slo_rows:

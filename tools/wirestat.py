@@ -86,6 +86,7 @@ def main(argv: list[str] | None = None) -> int:
             "packing": ledger.packing_stats(records, totals=totals),
             "chunks": ledger.per_chunk_bytes(records),
             "fill": fill,
+            "overlap": ledger.overlap_stats(records),
             "devices": ledger.device_lanes(records),
             "summary_bytes": ledger.summary_bytes(records),
             "sum_check": {"ok": sum_ok, "rows": rows},
@@ -179,6 +180,16 @@ def main(argv: list[str] | None = None) -> int:
             f"(union {fl['floor_s']}s) over wall {fl['wall_s']}s "
             f"= frac {fl['frac']}"
         )
+        ov = ledger.overlap_stats(records)
+        if ov:
+            # the ingest-overlap verdict: how much host-side chunk prep
+            # the background producer hid behind device-facing work
+            print(
+                f"ingest overlap ({ov['mode']}): prep {ov['ingest_busy_s']}s "
+                f"hidden {ov['overlap_s']}s = efficiency "
+                f"{ov['efficiency']}  stall {ov['stall_s']}s  "
+                f"backpressure {ov['backpressure_s']}s"
+            )
         print()
         if rows:
             verdict = "OK" if sum_ok else "FAIL"
