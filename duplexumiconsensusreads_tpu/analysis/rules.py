@@ -2378,3 +2378,150 @@ def check_ingest_confinement(corpus: Corpus) -> Iterator[Finding]:
                     "the bounded handoff queue; everything else is the "
                     "consumer's (thread-confinement contract)",
                 )
+
+
+# ------------------------------------------- rule: kernel-cost-registry
+
+def _dict_str_keys(tree: ast.Module, name: str) -> tuple[set[str], int]:
+    """Keys of a module-level ``NAME = {"a": <anything>, ...}`` dict
+    literal — the cost registry's shape (values are function refs, so
+    engine.str_dict_assign's tuple-valued contract does not fit).
+    Returns (set(), 0) when missing or not all-literal-keyed."""
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+            and isinstance(node.value, ast.Dict)
+        ):
+            continue
+        keys = [str_const(k) for k in node.value.keys if k is not None]
+        if keys and all(k is not None for k in keys):
+            return {k for k in keys if k is not None}, node.lineno
+    return set(), 0
+
+
+def _method_literals(tree: ast.Module) -> Iterator[tuple[str, int]]:
+    """Every string literal a kernels/ module treats as an ssc-method
+    name: comparisons against a ``method`` variable (``method ==
+    "matmul"``, ``method in ("blockseg", "runsum")``) and the default
+    of a ``method=`` parameter. These are the literals that select a
+    kernel path — exactly the set the FLOP-cost registry must cover."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare):
+            if not (
+                isinstance(node.left, ast.Name)
+                and node.left.id == "method"
+            ):
+                continue
+            for comp in node.comparators:
+                lit = str_const(comp)
+                if lit is not None:
+                    yield lit, node.lineno
+                elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    for e in comp.elts:
+                        lit = str_const(e)
+                        if lit is not None:
+                            yield lit, node.lineno
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = a.posonlyargs + a.args
+            defaults = a.defaults
+            # defaults align to the TAIL of the parameter list
+            for param, default in zip(params[len(params) - len(defaults):],
+                                      defaults):
+                if param.arg == "method":
+                    lit = str_const(default)
+                    if lit is not None:
+                        yield lit, node.lineno
+            for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                if param.arg == "method" and default is not None:
+                    lit = str_const(default)
+                    if lit is not None:
+                        yield lit, node.lineno
+
+
+@register(
+    "kernel-cost-registry",
+    "every kernel method literal has a FLOP-cost entry and every dev "
+    "record field is registered",
+)
+def check_kernel_cost_registry(corpus: Corpus) -> Iterator[Finding]:
+    """The device ledger's honesty depends on two registries staying
+    closed over their call sites: a kernel method with no entry in
+    ``ops.pipeline.SSC_METHOD_COSTS`` makes ``analytic_flops`` raise on
+    a healthy run (the executor emits FLOPs for every dispatch), and a
+    ``dev(...)`` field outside ``telemetry.trace.KNOWN_DEV_FIELDS``
+    fails the capture validator only at runtime, with a trace flag set
+    — the same too-late drift class the phase-registry rule pins for
+    spans. Both directions: an unregistered literal fires at its call
+    site; a cost entry no kernel ever selects is a dead registry row."""
+    pipe_path = corpus.find("ops/pipeline.py")
+    trace_path = corpus.find("telemetry/trace.py")
+    costs: set[str] = set()
+    costs_line = 1
+    if pipe_path is not None:
+        costs, costs_line = _dict_str_keys(
+            corpus.trees[pipe_path], "SSC_METHOD_COSTS"
+        )
+    dev_fields: list[str] = []
+    if trace_path is not None:
+        dev_fields, _ = str_tuple_assign(
+            corpus.trees[trace_path], "KNOWN_DEV_FIELDS"
+        )
+
+    seen_methods: set[str] = set()
+    for path in corpus.package_paths():
+        if "kernels/" in path and costs:
+            for lit, line in _method_literals(corpus.trees[path]):
+                seen_methods.add(lit)
+                if lit not in costs:
+                    yield Finding(
+                        rule="kernel-cost-registry",
+                        path=path,
+                        line=line,
+                        message=f"kernel method {lit!r} has no registered "
+                        f"FLOP cost",
+                        hint="register a cost function under that key in "
+                        "ops.pipeline.SSC_METHOD_COSTS — analytic_flops "
+                        "raises on unregistered methods and every "
+                        "dispatch is FLOP-ledgered",
+                    )
+        if dev_fields and path != trace_path:
+            for node in ast.walk(corpus.trees[path]):
+                if not isinstance(node, ast.Call):
+                    continue
+                if call_name(node) != "dev":
+                    continue
+                for kw in node.keywords or ():
+                    # chunk/lane are envelope args of the recorder
+                    # method, not ledger fields
+                    if kw.arg in (None, "chunk", "lane"):
+                        continue
+                    if kw.arg not in dev_fields:
+                        yield Finding(
+                            rule="kernel-cost-registry",
+                            path=path,
+                            line=node.lineno,
+                            message=f"dev record field {kw.arg!r} is not "
+                            f"registered",
+                            hint="register it in telemetry.trace."
+                            "KNOWN_DEV_FIELDS (and the dev schema golden "
+                            "+ ARCHITECTURE.md) — the validator rejects "
+                            "unregistered dev fields",
+                        )
+
+    # dead-registry direction: a cost entry nothing in kernels/ can
+    # select will never be exercised and hides geometry drift
+    if costs and seen_methods and pipe_path is not None:
+        for key in sorted(costs - seen_methods):
+            yield Finding(
+                rule="kernel-cost-registry",
+                path=pipe_path,
+                line=costs_line,
+                message=f"FLOP cost registered for {key!r} but no kernel "
+                f"selects that method",
+                hint="prune the SSC_METHOD_COSTS entry or wire the "
+                "method into kernels/",
+            )

@@ -42,6 +42,12 @@ CANONICAL_METRICS = (
     ("mfu", True, False),
     ("e2e_reads_per_sec", True, True),
     ("e2e_wall_s", False, False),
+    # device ledger (telemetry/devledger.py): e2e MFU measured from the
+    # capture's own dev records, and the fraction of the measured
+    # roofline the run attained — informational, never gated (both
+    # follow tunnel weather and sim-device sharing on CPU legs)
+    ("e2e_mfu", True, False),
+    ("e2e_roofline_frac", True, False),
     ("e2e_wire_floor_frac", False, False),
     ("e2e_wire_floor_frac_measured", False, False),
     ("e2e_bytes_per_read", False, False),

@@ -77,6 +77,7 @@ COMPACT_KEYS = (
     "metric", "value", "unit", "vs_baseline", "tflops", "mfu",
     "vs_vectorized_cpu", "ssc_method",
     "e2e_reads_per_sec", "e2e_wall_s",
+    "e2e_mfu", "e2e_roofline_frac",
     "e2e_wire_floor_frac", "e2e_wire_floor_frac_measured",
     "e2e_wire_h2d_mb_s_measured", "e2e_wire_d2h_mb_s_measured",
     "e2e_bytes_per_read", "e2e_packed_speedup", "e2e_d2h_packed_speedup",
@@ -315,6 +316,26 @@ def run_e2e(
             pk = trace_ledger.packing_stats(records)
             if "d2h_packing_ratio" in pk:
                 extra[f"{prefix}_d2h_packing_ratio"] = pk["d2h_packing_ratio"]
+            # the device ledger: honest MFU and roofline position
+            # MEASURED from the capture's own dev records — the e2e
+            # twin of the compute bench's analytic MFU (absent on
+            # pre-devledger captures)
+            from duplexumiconsensusreads_tpu.telemetry import devledger
+
+            dtot = devledger.device_totals(records)
+            if dtot:
+                extra[f"{prefix}_mfu"] = dtot["mfu"]
+                extra[f"{prefix}_device_gflops"] = round(
+                    dtot["flops"] / 1e9, 3
+                )
+                roofl = devledger.roofline(records)
+                if roofl:
+                    extra[f"{prefix}_roofline_frac"] = (
+                        roofl["attainable_frac"]
+                    )
+            comp = devledger.compile_stats(records)
+            if comp:
+                extra[f"{prefix}_compile_s"] = comp["compile_s"]
         except (OSError, ValueError) as e:
             # telemetry must never sink the bench capture itself
             extra = {f"{prefix}_trace_error": str(e)[:200]}
@@ -1362,9 +1383,12 @@ def main() -> None:
 
     # analytic executed-FLOP accounting -> TFLOP/s and MFU (VERDICT r1
     # item 4): per-class geometry x padded bucket count, over the
-    # measured step time. Peak default: v5e bf16 197 TFLOP/s
-    # (override with DUT_PEAK_TFLOPS for other chips).
+    # measured step time. Peak from the shared device table
+    # (telemetry/device.py) keyed on the local device kind —
+    # DUT_PEAK_TFLOPS env override wins, cpu-sim deliberately keeps the
+    # v5e 197 so the CPU-leg trajectory stays comparable across rounds.
     from duplexumiconsensusreads_tpu.ops.pipeline import analytic_flops
+    from duplexumiconsensusreads_tpu.telemetry.device import device_peak_flops
 
     l_ = batch.read_len
     b_ = batch.umi_len
@@ -1373,7 +1397,7 @@ def main() -> None:
         * args["pos"].shape[0]
         for cbuckets, cspec, args in classes
     )
-    peak = float(os.environ.get("DUT_PEAK_TFLOPS", 197)) * 1e12
+    peak, peak_entry = device_peak_flops()
     tflops = step_flops / tpu_s / 1e12
     mfu = step_flops / tpu_s / peak
 
@@ -1483,6 +1507,9 @@ def main() -> None:
         "vs_baseline": round(tpu_rps / cpu_rps, 2),
         "tflops": round(tflops, 2),
         "mfu": round(mfu, 4),
+        # which peak-table row (or env override) scored the MFU — an
+        # MFU without its denominator's provenance is unauditable
+        "peak_entry": peak_entry,
         "vs_vectorized_cpu": round(tpu_rps / vec_cpu_rps, 2),
         "ssc_method": ssc_method,
     }
@@ -1671,7 +1698,8 @@ def main() -> None:
         f"bucket_capacity={capacity} tpu_step={tpu_s:.3f}s compile={compile_s:.1f}s "
         f"cpu_oracle={cpu_rps:.0f} reads/s (n={len(sub_idx)}) "
         f"vec_cpu={vec_cpu_rps:.0f} reads/s (n={got}, XLA-CPU fused pipeline) "
-        f"tflops={tflops:.2f} mfu={mfu:.4f} (peak={peak/1e12:.0f}T) sim={sim_s:.1f}s "
+        f"tflops={tflops:.2f} mfu={mfu:.4f} "
+        f"(peak={peak/1e12:.0f}T [{peak_entry}]) sim={sim_s:.1f}s "
         f"consensus_error_rate={err_rate:.2e} ({n_err}/{n_base} bases, "
         f"raw base_error={sim_cfg.base_error:g}) "
         f"ssc_method={ssc_method} (r2 in-pipeline on v5e: matmul fastest "

@@ -308,16 +308,49 @@ def spec_for_buckets(
     )
 
 
+def _ssc_cost_matmul(spec: "PipelineSpec", r: int, cols: int) -> float:
+    f = (spec.f_max or r) + 1
+    return 2.0 * f * r * cols  # dense one-hot GEMM
+
+
+def _ssc_cost_blockseg(spec: "PipelineSpec", r: int, cols: int) -> float:
+    t = min(spec.blockseg_t, r)
+    return 2.0 * r * t * cols  # block-local rank one-hot GEMMs
+
+
+def _ssc_cost_reduction(spec: "PipelineSpec", r: int, cols: int) -> float:
+    # segment/runsum/pallas perform ~the useful reduction FLOPs only
+    return 2.0 * r * cols
+
+
+# Per-method ssc reduction cost functions — the kernel-cost registry.
+# EVERY method literal kernels/consensus.py dispatches on must have an
+# entry here (dutlint's dev-ledger rule pins the two sets against each
+# other), so a new kernel cannot ship without its cost model and the
+# device ledger's per-class FLOPs stay honest for every capture.
+SSC_METHOD_COSTS = {
+    "matmul": _ssc_cost_matmul,
+    "blockseg": _ssc_cost_blockseg,
+    "segment": _ssc_cost_reduction,
+    "runsum": _ssc_cost_reduction,
+    "pallas": _ssc_cost_reduction,
+    "pallas_interpret": _ssc_cost_reduction,
+}
+
+
 def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
     """Executed FLOPs of ONE fused_pipeline call on an (r, l) bucket
     with b UMI code columns — the denominator-side input of the
-    benchmark's MFU accounting. Counts the two MXU-heavy GEMMs
-    (Hamming one-hot, ssc segment reduction) plus a floor on the seed
+    benchmark's MFU accounting and of every ``dev`` record in the
+    device ledger (telemetry/devledger.py). Counts the two MXU-heavy
+    GEMMs (Hamming one-hot, ssc segment reduction via the
+    ``SSC_METHOD_COSTS`` registry) plus a floor on the seed
     propagation's per-sweep VPU select/min (the r5 replacement for the
     closure squarings this function used to count — negligible next to
     the GEMMs, kept so the term list matches the kernel). Other
     elementwise/VPU work is excluded, so the number is a lower bound
-    on executed work and MFU is conservative.
+    on executed work and MFU is conservative. Raises on a method with
+    no registered cost function — a silent 0 would fake MFU.
     """
     g, c = spec.grouping, spec.consensus
     u = spec.u_max or r
@@ -335,15 +368,13 @@ def analytic_flops(spec: PipelineSpec, r: int, l: int, b: int) -> float:
     # error model adds a fit-only pass: 4l+1 evidence columns (no depth
     # block) vs the final pass's 5l+1
     cols = (5 * l + 1) + ((4 * l + 1) if c.error_model == "cycle" else 0)
-    if spec.ssc_method == "matmul":
-        f = (spec.f_max or r) + 1
-        fl += 2.0 * f * r * cols  # dense one-hot GEMM
-    elif spec.ssc_method == "blockseg":
-        t = min(spec.blockseg_t, r)
-        fl += 2.0 * r * t * cols  # block-local rank one-hot GEMMs
-    else:
-        # pallas/segment/runsum perform ~the useful reduction FLOPs only
-        fl += 2.0 * r * cols
+    cost = SSC_METHOD_COSTS.get(spec.ssc_method)
+    if cost is None:
+        raise ValueError(
+            f"ssc_method {spec.ssc_method!r} has no registered cost "
+            f"function (SSC_METHOD_COSTS: {sorted(SSC_METHOD_COSTS)})"
+        )
+    fl += cost(spec, r, cols)
     return fl
 
 

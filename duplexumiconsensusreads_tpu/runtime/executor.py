@@ -86,6 +86,15 @@ class RunReport:
     # from an assertion into arithmetic (bytes / MB/s ~ observed wall).
     bytes_h2d: int = 0
     bytes_d2h: int = 0
+    # device-ledger accounting (streaming): executed analytic FLOPs
+    # of every dispatch (ops/pipeline.py's SSC_METHOD_COSTS x padded
+    # bucket count; retries re-count like the byte ledger) and the
+    # device wait+fetch busy seconds they ran in. flops / seconds /
+    # peak (telemetry/device.py) is the run's honest MFU — the serving
+    # layer derives per-job MFU from exactly these two counters, and a
+    # capture's dev records must sum to them (devstat's sum-check)
+    device_flops: float = 0.0
+    device_seconds: float = 0.0
     # padding observability (streaming): real read rows dispatched vs
     # total padded row-slots (bucket capacities x padded bucket counts,
     # retried dispatches counted like the byte ledger counts them) —
@@ -112,6 +121,10 @@ class RunReport:
         # sort_keys below orders every dict (seconds included); this
         # comprehension only normalises the values
         d["seconds"] = {k: round(float(v), 3) for k, v in self.seconds.items()}
+        # the device-ledger accumulators carry float-sum noise past
+        # what the measurements are honest to; same ms/flop rounding
+        d["device_flops"] = round(float(self.device_flops), 3)
+        d["device_seconds"] = round(float(self.device_seconds), 3)
         return json.dumps(d, indent=2, sort_keys=True)
 
 

@@ -63,6 +63,7 @@ from duplexumiconsensusreads_tpu.io.convert import (
 from duplexumiconsensusreads_tpu.io.convert import records_pos_keys as _rec_pos_keys
 from duplexumiconsensusreads_tpu.ops.pipeline import (
     SUBBYTE_QBITS,
+    analytic_flops,
     pack_stacked,
     qual_alphabet,
 )
@@ -1333,6 +1334,23 @@ def _stream_call(
         "h2d_logical": 0, "h2d_wire": 0, "d2h_logical": 0, "d2h_wire": 0,
         "shard_logical": 0, "shard_wire": 0, "output_overhead_bytes": 0,
     }
+    # device-ledger side table (telemetry/devledger.py), maintained
+    # only while tracing: dispatch() accrues one entry per
+    # (chunk, dispatch class) — dispatch busy seconds, analytic FLOPs,
+    # wire bytes, padded bucket count; retries and bucket-isolation
+    # re-dispatches fold into the SAME entry, exactly like the byte
+    # ledger counts a re-transfer each time it crosses the wire. The
+    # drain worker pops the entry once the class's device results are
+    # materialised and emits ONE ``dev`` record carrying the chunk's
+    # device_wait_fetch window, so a capture's dev-record sums
+    # reproduce phase["device_wait_fetch"] and phase["dispatch"]
+    # exactly — the devstat time sum-check, the device twin of the
+    # wirestat byte sum-check. Guarded by phase_lock like ``led``.
+    dev_pending: dict = {}
+    # per-class compile ledger: classes whose FIRST pipeline call
+    # (trace + XLA compile + first dispatch, synchronous under jit) has
+    # been timed into a jit_compile event. Guarded by phase_lock.
+    dev_compiled: set = set()
 
     # packed consensus-only return path (runtime/executor packed-D2H
     # rung): one run-level decision — the per-chunk epilogue bound
@@ -1464,6 +1482,28 @@ def _stream_call(
         bucket_rows = [int(bk.valid.sum()) for bk in buckets]
         rows_real = sum(bucket_rows)
         mesh_pad = n_stacked - len(buckets)
+        # device ledger: executed analytic FLOPs of this dispatch —
+        # per-bucket cost (ops/pipeline.py's SSC_METHOD_COSTS registry)
+        # x padded bucket count (mesh-pad buckets ride the GEMM like
+        # they ride the wire, so they are in the FLOPs). Accrued on the
+        # report unconditionally (the serving layer's per-job MFU needs
+        # it without a capture) and into the dev side table while
+        # tracing; a retried dispatch re-counts, exactly like the byte
+        # ledger counts a re-transfer.
+        l_cyc = int(buckets[0].bases.shape[1])
+        flops_d = analytic_flops(
+            spec, buckets[0].capacity, l_cyc,
+            int(buckets[0].umi.shape[1]),
+        ) * n_stacked
+        # per-class compile ledger: claim first-call status under the
+        # lock BEFORE the pipeline call (concurrent xfer workers may
+        # race the same fresh class; exactly one times it)
+        first_call = False
+        if tr is not None:
+            with phase_lock:
+                first_call = spec not in dev_compiled
+                if first_call:
+                    dev_compiled.add(spec)
         # multi-device 1-D mesh: the per-device put path (value-
         # identical, per-device-attributed). The 2-D (data, cycle)
         # mesh shards bases/quals along cycles too, so its transfers
@@ -1486,10 +1526,24 @@ def _stream_call(
                 )
                 for key in _ARRAY_KEYS
             }
+            t_pipe = time.monotonic()
             out = presharded_pipeline(args, spec, mesh)
         else:
             t_pre, t0b = None, t0
+            t_pipe = time.monotonic()
             out = sharded_pipeline(stacked, spec, mesh)
+        if tr is not None and first_call:
+            # under jit the first call of a fresh class traces + XLA-
+            # compiles synchronously before its (async) dispatch
+            # returns, so the first-call seconds ARE the class's
+            # compile cost to within one dispatch enqueue — the
+            # per-class jit-cache ledger devstat totals
+            tr.event(
+                "jit_compile", chunk=chunk,
+                compile_s=round(time.monotonic() - t_pipe, 6),
+                cap=int(buckets[0].capacity), cycles=l_cyc,
+                method=spec.ssc_method,
+            )
         # the run-level d2h decision re-checked against the CLASS
         # capacity (one pure helper — executor.d2h_rung_for_class — so
         # the gate matrix is unit-tested without a device): jumbo
@@ -1548,12 +1602,25 @@ def _stream_call(
         with phase_lock:  # dict += from concurrent workers would race
             phase["dispatch"] += disp_dt
             rep.bytes_h2d += h2d
+            rep.device_flops += flops_d
             rep.n_rows_real += rows_real
             rep.n_rows_padded += rows_pad
             rep.n_mesh_pad_buckets += mesh_pad
             if tr is not None:
                 led["h2d_logical"] += logical
                 led["h2d_wire"] += h2d
+                # dev side table: fold this dispatch into its
+                # (chunk, class) entry — the drain worker pops it into
+                # ONE dev record once the class's results materialise
+                ent = dev_pending.setdefault((chunk, spec), {
+                    "cap": int(buckets[0].capacity), "cycles": l_cyc,
+                    "method": spec.ssc_method, "buckets": 0,
+                    "flops": 0.0, "h2d_wire": 0, "disp_s": 0.0,
+                })
+                ent["buckets"] += n_stacked
+                ent["flops"] += flops_d
+                ent["h2d_wire"] += h2d
+                ent["disp_s"] += disp_dt
         if tr is not None:
             if t_pre is None:
                 tr.span(
@@ -1772,14 +1839,33 @@ def _stream_call(
             dt = time.monotonic() - t0
             with phase_lock:
                 phase["device_wait_fetch"] += dt
+                rep.device_seconds += dt
                 rep.bytes_d2h += d2h_wire
                 rep.n_families += int(out["n_families"].sum())
                 rep.n_molecules += int(out["n_molecules"].sum())
                 if tr is not None:
                     led["d2h_wire"] += d2h_wire
                     led["d2h_logical"] += d2h_logical
+                    # device ledger: this class's dispatch-side
+                    # accumulator, complete now that materialize (and
+                    # every retry it ran) has returned
+                    dent = dev_pending.pop((k, cspec), None)
             if tr is not None:
                 tr.span("device_wait_fetch", t0, dt, chunk=k)
+                if dent is not None:
+                    # one dev record per (chunk, dispatch class): the
+                    # SAME (t0, dt) window as the span above, so a
+                    # capture's dev durs sum to the device_wait_fetch
+                    # phase and its disp_s to the dispatch phase — the
+                    # devstat sum-check contract
+                    tr.dev(
+                        t0, dt, chunk=k,
+                        cap=dent["cap"], cycles=dent["cycles"],
+                        buckets=dent["buckets"], method=dent["method"],
+                        flops=round(dent["flops"], 3),
+                        h2d_wire=dent["h2d_wire"], d2h_wire=d2h_wire,
+                        disp_s=round(dent["disp_s"], 6),
+                    )
                 # the packed return path: wire is what the compact
                 # consensus-only fetch moved, logical what the full
                 # padded FETCH_KEYS arrays would have — the d2h
@@ -2386,7 +2472,26 @@ def _stream_call(
         drain.shutdown(wait=True, cancel_futures=True)
         xfer.shutdown(wait=True, cancel_futures=True)
         if profile_dir:
-            jax.profiler.stop_trace()
+            # profiler teardown rides the same finally discipline as
+            # the recorder teardown: the trace directory is finalised
+            # on EVERY exit path, and a teardown failure (profiler
+            # died mid-run, disk full) must never mask the error that
+            # brought the run down
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001 — telemetry teardown
+                print(
+                    f"[duplexumi] jax.profiler.stop_trace failed: {e!r}",
+                    file=sys.stderr,
+                )
+            else:
+                if tr is not None:
+                    # the capture records that a profiler trace exists
+                    # alongside it (post-mortems pair the two)
+                    tr.event(
+                        "profile_written",
+                        profile_dir=os.path.abspath(profile_dir),
+                    )
 
     # ---- terminal finalise: every shard is already appended into the
     # tmp in frontier order, so what remains is the EOF block + fsync +

@@ -86,6 +86,10 @@ from duplexumiconsensusreads_tpu.serve.worker import (
     WarmWorker,
 )
 from duplexumiconsensusreads_tpu.telemetry import trace as telemetry
+from duplexumiconsensusreads_tpu.telemetry.device import (
+    device_peak_flops,
+    round_mfu,
+)
 from duplexumiconsensusreads_tpu.telemetry.report import _pctl
 from duplexumiconsensusreads_tpu.telemetry.trace import Heartbeat, TraceRecorder
 
@@ -250,6 +254,10 @@ class ConsensusService:
             # committed — rides the heartbeat line and metrics.json, so
             # a long-lived daemon's transfer pressure is live-readable
             "h2d_bytes": 0, "d2h_bytes": 0,
+            # cumulative executed device FLOPs and device-busy seconds
+            # (the device-ledger twin of the byte counters): stats()
+            # derives the daemon's live MFU from these
+            "device_flops": 0.0, "device_s": 0.0,
         }
         # a restarted daemon's counters must not lie about the spool it
         # serves: seed the job-outcome counters from the journal the
@@ -316,7 +324,25 @@ class ConsensusService:
                     round(v_hits / v_total, 3) if v_total else 0.0
                 ),
             }
+            # daemon-level honest MFU: executed FLOPs over device-busy
+            # seconds over the shared peak table — the serve analogue of
+            # the capture's device ledger (fleet_report folds it)
+            dev_s = self.counters["device_s"]
+            snap["mfu"] = (
+                round_mfu(
+                    self.counters["device_flops"] / dev_s
+                    / self._peak_flops()
+                )
+                if dev_s > 0 else 0.0
+            )
         return snap
+
+    @staticmethod
+    def _peak_flops() -> float:
+        """Peak FLOP/s for MFU denominators, resolved per call: the
+        env override may change under test, and resolving lazily keeps
+        jax backend init off the service constructor."""
+        return device_peak_flops()[0]
 
     def _note_chunk_locked(self, interval_s: float) -> None:
         """One observed inter-chunk-commit interval (caller holds the
@@ -349,10 +375,17 @@ class ConsensusService:
         """Fold one slice's byte snapshot into the per-job and daemon
         cumulative totals (caller holds the lock)."""
         jb = self._job_bytes.setdefault(
-            job_id, {"h2d_bytes": 0, "d2h_bytes": 0, "reads": 0}
+            job_id, {"h2d_bytes": 0, "d2h_bytes": 0, "reads": 0,
+                     "device_flops": 0.0, "device_s": 0.0}
         )
         for key in ("h2d_bytes", "d2h_bytes", "reads"):
             jb[key] += int(sb.get(key, 0) or 0)
+        # device-ledger twin: FLOPs/seconds accumulate per job and per
+        # daemon the same traffic-attributed way the bytes do
+        for key in ("device_flops", "device_s"):
+            v = float(sb.get(key, 0.0) or 0.0)
+            jb[key] = round(jb.get(key, 0.0) + v, 6)
+            self.counters[key] = round(self.counters[key] + v, 6)
         self.counters["h2d_bytes"] += int(sb.get("h2d_bytes", 0) or 0)
         self.counters["d2h_bytes"] += int(sb.get("d2h_bytes", 0) or 0)
 
@@ -362,10 +395,20 @@ class ConsensusService:
         out = {}
         for job_id, jb in self._job_bytes.items():
             wire = jb["h2d_bytes"] + jb["d2h_bytes"]
+            dev_s = jb.get("device_s", 0.0)
             out[job_id] = {
                 **jb,
                 "bytes_per_read": (
                     round(wire / jb["reads"], 1) if jb["reads"] else 0.0
+                ),
+                # per-job honest MFU off the slices' snapshots (0.0 for
+                # jobs whose slices predate the device ledger)
+                "mfu": (
+                    round_mfu(
+                        jb.get("device_flops", 0.0) / dev_s
+                        / self._peak_flops()
+                    )
+                    if dev_s > 0 else 0.0
                 ),
             }
         return out
@@ -1262,6 +1305,8 @@ class ConsensusService:
                         "h2d_bytes": result.get("bytes_h2d", 0),
                         "d2h_bytes": result.get("bytes_d2h", 0),
                         "reads": result.get("n_records", 0),
+                        "device_flops": result.get("device_flops", 0.0),
+                        "device_s": result.get("device_seconds", 0.0),
                     })
                     jb = dict(self._job_bytes.get(job_id, {}))
             except JobFenced as f:
@@ -1283,6 +1328,16 @@ class ConsensusService:
                     bytes_per_read=(
                         round(wire / jb["reads"], 1)
                         if jb.get("reads") else 0.0
+                    ),
+                    # whole-life device ledger: executed FLOPs and the
+                    # job's honest MFU (serve_report's mfu column)
+                    device_flops=round(jb.get("device_flops", 0.0), 3),
+                    mfu=(
+                        round_mfu(
+                            jb.get("device_flops", 0.0)
+                            / jb["device_s"] / self._peak_flops()
+                        )
+                        if jb.get("device_s") else 0.0
                     ),
                 )
         else:

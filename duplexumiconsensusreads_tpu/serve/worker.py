@@ -370,7 +370,10 @@ class WarmWorker:
         # measure bytes daemons actually moved for the job, counting a
         # re-transfer each time it crosses the wire, exactly like
         # retried dispatches in the run capture's byte ledger.
-        slice_bytes = {"h2d_bytes": 0, "d2h_bytes": 0, "reads": 0}
+        # device_flops / device_s ride the same snapshot: per-job MFU
+        # must survive preemption for the same traffic-attributed reason
+        slice_bytes = {"h2d_bytes": 0, "d2h_bytes": 0, "reads": 0,
+                       "device_flops": 0.0, "device_s": 0.0}
 
         commit_guard = None
         if lease is not None:
@@ -391,6 +394,8 @@ class WarmWorker:
             slice_bytes["h2d_bytes"] = _rep.bytes_h2d
             slice_bytes["d2h_bytes"] = _rep.bytes_d2h
             slice_bytes["reads"] = _rep.n_records
+            slice_bytes["device_flops"] = _rep.device_flops
+            slice_bytes["device_s"] = _rep.device_seconds
             ladder_seen["ladder"] = list(_rep.bucket_ladder)
             ladder_seen["rows_real"] = _rep.n_rows_real
             ladder_seen["rows_pad"] = _rep.n_rows_padded

@@ -7,12 +7,17 @@ capture as Chrome trace events (opens in Perfetto / chrome://tracing);
 utilization, per-stage percentiles, per-chunk critical path, the
 sum-check against `RunReport.seconds`); `ledger` is the byte twin
 (per-chunk byte totals, measured bandwidth, the wire-floor model, the
-byte sum-checks `tools/wirestat.py` enforces). The recording side
-imports only the stdlib so `runtime/faults.py` and `io/durable.py`
-can hook into it without an import cycle.
+byte sum-checks `tools/wirestat.py` enforces); `devledger` is the
+device twin (per-class FLOPs/MFU/arithmetic intensity, the roofline
+verdicts, the dev-interval sum-checks `tools/devstat.py` enforces);
+`device` is the shared peak-FLOP/s table every MFU consumer resolves
+through. The recording side imports only the stdlib so
+`runtime/faults.py` and `io/durable.py` can hook into it without an
+import cycle.
 """
 
 from duplexumiconsensusreads_tpu.telemetry.trace import (
+    KNOWN_DEV_FIELDS,
     KNOWN_EVENTS,
     KNOWN_STAGES,
     KNOWN_XFER_DIRS,
@@ -25,6 +30,7 @@ from duplexumiconsensusreads_tpu.telemetry.trace import (
 )
 
 __all__ = [
+    "KNOWN_DEV_FIELDS",
     "KNOWN_EVENTS",
     "KNOWN_STAGES",
     "KNOWN_XFER_DIRS",

@@ -4,7 +4,9 @@ Maps a JSONL capture (telemetry/trace.py) onto the Trace Event Format
 consumed by https://ui.perfetto.dev and chrome://tracing — spans become
 complete ('X') slices, point events become instants ('i'), byte-ledger
 xfer records become counter ('C') tracks of bytes-in-flight per lane,
-and each LANE becomes one named pseudo-thread so the main loop,
+device-ledger dev records become FLOP/s counter tracks (the roofline's
+numerator, live under the timeline), and each LANE becomes one named
+pseudo-thread so the main loop,
 transfer workers, and every drain worker render as parallel tracks. That
 side-by-side rendering is the whole point: overlap that hides the
 critical path in aggregate numbers is visible at a glance.
@@ -39,10 +41,10 @@ def to_chrome(records) -> dict:
     (``telemetry.report.load_trace`` output). Returns the JSON-object
     form ({"traceEvents": [...]}), which Perfetto accepts directly.
     """
-    spans, instants, xfers, lanes = [], [], [], set()
+    spans, instants, xfers, devs, lanes = [], [], [], [], set()
     for rec in records:
         kind = rec.get("type")
-        if kind not in ("span", "event", "xfer"):
+        if kind not in ("span", "event", "xfer", "dev"):
             continue
         lane = rec.get("lane", "?")
         lanes.add(lane)
@@ -56,6 +58,8 @@ def to_chrome(records) -> dict:
             spans.append((rec, lane, args))
         elif kind == "xfer":
             xfers.append((rec, lane))
+        elif kind == "dev":
+            devs.append((rec, lane))
         else:
             instants.append((rec, lane, args))
 
@@ -110,6 +114,31 @@ def to_chrome(records) -> dict:
         events.append({
             "name": name, "cat": "xfer", "ph": "C", "ts": t1,
             "pid": _PID, "tid": tid[lane], "args": {"bytes": 0},
+        })
+    # device-ledger records render as a FLOP/s counter track: each dev
+    # record raises "device_gflops_s (<class>)" to its average rate
+    # (flops/dur) for its device-wait window and drops it back to zero
+    # — so Perfetto shows WHICH bucket class the MXU was earning on at
+    # any instant, right under the span timeline. Same raise/drop
+    # pattern as the byte counters; the class rides in the name because
+    # counter identity is (pid, name).
+    for rec, lane in devs:
+        cap = int(rec.get("cap", 0))
+        name = (
+            f"device_gflops_s (c{cap}xL{int(rec.get('cycles', 0))}/"
+            f"{rec.get('method', '?')})"
+        )
+        t0 = round(float(rec.get("t", 0.0)) * 1e6, 3)
+        dur = float(rec.get("dur", 0.0))
+        t1 = round((float(rec.get("t", 0.0)) + dur) * 1e6, 3)
+        rate = float(rec.get("flops", 0.0)) / dur / 1e9 if dur > 0 else 0.0
+        events.append({
+            "name": name, "cat": "dev", "ph": "C", "ts": t0,
+            "pid": _PID, "tid": tid[lane], "args": {"gflops_s": round(rate, 3)},
+        })
+        events.append({
+            "name": name, "cat": "dev", "ph": "C", "ts": t1,
+            "pid": _PID, "tid": tid[lane], "args": {"gflops_s": 0},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

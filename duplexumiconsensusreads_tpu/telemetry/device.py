@@ -1,0 +1,75 @@
+"""Device peak-FLOP/s table — ONE resolution for every MFU consumer.
+
+Before the device ledger, the only peak lived inline in benchmark.py
+(`DUT_PEAK_TFLOPS=197`, the v5e bf16 number) — serve jobs and offline
+capture analysis had no peak at all, and a bench run on a v4 silently
+normalised against the wrong chip. This module is the single table:
+keyed on ``jax.devices()[0].device_kind``, env override wins, and every
+consumer (benchmark.py's compute leg, tools/devstat.py, the serving
+layer's per-job MFU) resolves through :func:`device_peak_flops` so the
+denominators cannot drift apart.
+
+The resolution NAMES its entry (``("env", "v5e", "cpu-sim", ...)``):
+an MFU number without its peak provenance is unauditable, so the bench
+line prints the entry and devstat carries it in ``--json``.
+"""
+
+from __future__ import annotations
+
+import os
+
+# bf16 peak TFLOP/s per device kind. Matching is case-insensitive
+# substring over the JAX ``device_kind`` string, first hit wins — v5p
+# must precede the bare "v5 lite" family and v4 never collides.
+# The cpu-sim entry deliberately keeps the v5e 197: the driver's
+# CPU-sim canonical legs have normalised against it since r1, so their
+# MFU is a cross-round-comparable ratio, not a host utilisation claim —
+# changing it would step every trajectory metric with no code change.
+PEAK_TFLOPS_TABLE = (
+    ("v5p", ("v5p",), 459.0),
+    ("v5e", ("v5 lite", "v5e"), 197.0),
+    ("v4", ("v4",), 275.0),
+    ("cpu-sim", ("cpu",), 197.0),
+)
+
+# unrecognised device kinds (new chip, exotic backend) fall back to the
+# v5e number the repo has always assumed — the honest move is a named
+# fallback entry, not a crash in a telemetry path
+DEFAULT_PEAK = ("default-v5e", 197.0)
+
+
+def device_peak_flops(device_kind: str | None = None) -> tuple[float, str]:
+    """Resolve (peak FLOP/s, entry name) for ``device_kind``.
+
+    ``DUT_PEAK_TFLOPS`` overrides everything (the pre-existing knob —
+    other chips, derated clocks); ``device_kind=None`` asks the local
+    JAX runtime, degrading to the default entry when no backend is
+    reachable (offline capture analysis must never need a device).
+    """
+    env = os.environ.get("DUT_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12, f"env:{env}T"
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — offline analysis, no backend
+            return DEFAULT_PEAK[1] * 1e12, DEFAULT_PEAK[0]
+    kind = str(device_kind).lower()
+    for entry, needles, tflops in PEAK_TFLOPS_TABLE:
+        if any(n in kind for n in needles):
+            return tflops * 1e12, entry
+    return DEFAULT_PEAK[1] * 1e12, DEFAULT_PEAK[0]
+
+
+def round_mfu(x: float) -> float:
+    """Round an MFU ratio for JSON to 4 significant figures. Fixed
+    decimal places would flush CPU-sim values to zero — a sim device
+    against a 197T peak sustains ~1e-7, and 0.0 reads as "no ledger"
+    rather than "tiny machine"."""
+    if not x:
+        return 0.0
+    from math import floor, log10
+
+    return round(x, 3 - int(floor(log10(abs(x)))))

@@ -1,0 +1,329 @@
+"""Device ledger: per-class FLOP attribution and the roofline model.
+
+The byte ledger (`telemetry/ledger.py`) made the WIRE measurable —
+bytes per chunk, effective bandwidth, a wire floor computed from the
+capture itself. But the compute side of the roofline stayed analytic:
+`benchmark.py` derived one whole-run MFU from `analytic_flops` and a
+hard-coded peak, and nobody could say which bucket class (capacity
+rung x read length x kernel method) actually earned its device time,
+or whether a class sat above or below the machine's ridge point. This
+module is the FLOP twin of the byte ledger: the streaming executor
+emits one typed ``dev`` record per (chunk, dispatch-class) carrying
+the class identity, the executed analytic FLOPs, the wire bytes the
+byte ledger already charged that dispatch, and the measured device
+interval — so per-class honest MFU, arithmetic intensity, and a
+measured roofline verdict fall out of ANY capture.
+
+Dev record (one JSONL line in the capture, ``type == "dev"``)::
+
+  {"type": "dev", "t": <rel start s>, "dur": <device-wait span s>,
+   "chunk": k, "lane": "...", "cap": 128, "cycles": 9, "buckets": 3,
+   "method": "matmul", "flops": 1.23e9, "h2d_wire": ...,
+   "d2h_wire": ..., "disp_s": ...}
+
+The record's (t, dur) window IS the chunk's ``device_wait_fetch``
+span and ``disp_s`` accrues exactly the seconds the ``dispatch``
+phase was charged for that chunk (retries and bucket-isolation
+re-dispatches fold into the same record before it is emitted), which
+gives the two sum-check identities ``tools/devstat.py`` enforces:
+
+  sum(dev.dur)     == summary.seconds["device_wait_fetch"]
+  sum(dev.disp_s)  == summary.seconds["dispatch"]
+
+Drift means records were dropped, double-emitted, or the capture was
+edited — exit 1, exactly like the byte sum-check.
+
+Roofline convention: intensity = FLOPs / wire bytes (both directions)
+per class; the ridge ("critical") intensity = peak FLOP/s over the
+capture's own MEASURED wire bandwidth, so the verdict compares two
+numbers measured under the same tunnel weather. A class at intensity
+above the ridge is compute-bound (more bytes/FLOP would not help); at
+intensity below it the PR 7 wire floor owns the class.
+
+Busy seconds are interval UNIONS (shared with the byte ledger's
+helpers) — dev windows from different chunks overlap whenever the
+drain pool runs wide, and a sum would claim more device time than the
+wall contains.
+"""
+
+from __future__ import annotations
+
+from duplexumiconsensusreads_tpu.telemetry.device import (
+    device_peak_flops,
+    round_mfu,
+)
+from duplexumiconsensusreads_tpu.telemetry.ledger import (
+    _union_seconds,
+    byte_totals,
+)
+from duplexumiconsensusreads_tpu.telemetry.report import (
+    _SUM_ABS_TOL,
+    _SUM_REL_TOL,
+    _is_num,
+    summary_record,
+)
+from duplexumiconsensusreads_tpu.telemetry.trace import KNOWN_DEV_FIELDS
+
+__all__ = [
+    "KNOWN_DEV_FIELDS", "dev_records", "class_key", "class_stats",
+    "device_totals", "compile_stats", "wire_bandwidth", "roofline",
+    "sum_check_dev",
+]
+
+
+def dev_records(records: list[dict]) -> list[dict]:
+    return [r for r in records if isinstance(r, dict) and r.get("type") == "dev"]
+
+
+def class_key(rec: dict) -> str:
+    """The bucket-class identity a dev record attributes to: capacity
+    rung x cycle count (read length) x kernel method — the same triple
+    that keys a pipeline jit entry, minus the spec knobs that don't
+    change the FLOP shape."""
+    return f"c{int(rec.get('cap', 0))}xL{int(rec.get('cycles', 0))}/{rec.get('method', '?')}"
+
+
+def class_stats(
+    records: list[dict], peak_flops: float | None = None
+) -> dict[str, dict]:
+    """Per bucket class: record/bucket counts, executed FLOPs, device
+    seconds (summed and union-busy), dispatch seconds, wire bytes both
+    directions, honest MFU and arithmetic intensity.
+
+    ``mfu`` divides FLOPs by the class's union-busy device seconds
+    (overlapping chunk windows collapsed — the device twin of the byte
+    ledger's bandwidth denominator) and the resolved peak;
+    ``intensity`` is FLOPs per wire byte over BOTH directions — the
+    x-axis of the roofline. ``peak_flops`` defaults to the shared
+    device table (`telemetry/device.py`); pass the value explicitly
+    when analysing a capture from a different machine."""
+    if peak_flops is None:
+        peak_flops, _ = device_peak_flops()
+    out: dict[str, dict] = {}
+    spans: dict[str, list[tuple[float, float]]] = {}
+    for rec in dev_records(records):
+        key = class_key(rec)
+        d = out.setdefault(key, {
+            "cap": int(rec.get("cap", 0)),
+            "cycles": int(rec.get("cycles", 0)),
+            "method": rec.get("method", "?"),
+            "n": 0, "buckets": 0, "flops": 0.0,
+            "dev_s": 0.0, "busy_s": 0.0, "disp_s": 0.0,
+            "h2d_wire": 0, "d2h_wire": 0,
+        })
+        d["n"] += 1
+        d["buckets"] += int(rec.get("buckets", 0))
+        d["flops"] += float(rec.get("flops", 0.0))
+        t = float(rec.get("t", 0.0))
+        dur = float(rec.get("dur", 0.0))
+        d["dev_s"] += dur
+        d["disp_s"] += float(rec.get("disp_s", 0.0))
+        d["h2d_wire"] += int(rec.get("h2d_wire", 0))
+        d["d2h_wire"] += int(rec.get("d2h_wire", 0))
+        spans.setdefault(key, []).append((t, t + dur))
+    for key, d in out.items():
+        busy = _union_seconds(spans.get(key, []))
+        wire = d["h2d_wire"] + d["d2h_wire"]
+        d["dev_s"] = round(d["dev_s"], 6)
+        d["busy_s"] = round(busy, 6)
+        d["disp_s"] = round(d["disp_s"], 6)
+        d["flops"] = round(d["flops"], 3)
+        d["mfu"] = (
+            round_mfu(d["flops"] / busy / peak_flops)
+            if busy > 0 and peak_flops > 0 else 0.0
+        )
+        d["intensity"] = round(d["flops"] / wire, 4) if wire > 0 else 0.0
+    # largest FLOP earners first — the classes that own the device
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]["flops"]))
+
+
+def device_totals(records: list[dict], peak_flops: float | None = None) -> dict:
+    """Whole-run device view: total executed FLOPs, summed vs
+    union-busy device seconds, dispatch seconds, wire bytes, and the
+    run's honest MFU (FLOPs over union busy over peak — what the
+    machine actually sustained while it had work in flight). {} for
+    captures with no dev records (pre-devledger)."""
+    recs = dev_records(records)
+    if not recs:
+        return {}
+    if peak_flops is None:
+        peak_flops, _ = device_peak_flops()
+    flops = sum(float(r.get("flops", 0.0)) for r in recs)
+    dev_s = sum(float(r.get("dur", 0.0)) for r in recs)
+    disp_s = sum(float(r.get("disp_s", 0.0)) for r in recs)
+    busy = _union_seconds([
+        (float(r.get("t", 0.0)),
+         float(r.get("t", 0.0)) + float(r.get("dur", 0.0)))
+        for r in recs
+    ])
+    h2d = sum(int(r.get("h2d_wire", 0)) for r in recs)
+    d2h = sum(int(r.get("d2h_wire", 0)) for r in recs)
+    wire = h2d + d2h
+    return {
+        "n": len(recs),
+        "buckets": sum(int(r.get("buckets", 0)) for r in recs),
+        "flops": round(flops, 3),
+        "dev_s": round(dev_s, 6),
+        "busy_s": round(busy, 6),
+        "disp_s": round(disp_s, 6),
+        "h2d_wire": h2d,
+        "d2h_wire": d2h,
+        "mfu": (
+            round_mfu(flops / busy / peak_flops)
+            if busy > 0 and peak_flops > 0 else 0.0
+        ),
+        "intensity": round(flops / wire, 4) if wire > 0 else 0.0,
+    }
+
+
+def compile_stats(records: list[dict]) -> dict:
+    """The jit-cache ledger view: one ``jit_compile`` event per first
+    pipeline call per compiled spec, each carrying that call's wall
+    seconds (trace + XLA compile + the first execution — JAX dispatches
+    asynchronously, so the first call is the only one that blocks on
+    compilation). Returns total count/seconds plus the per-class
+    breakdown; {} when the capture has no compile events."""
+    per: dict[str, dict] = {}
+    n = 0
+    total = 0.0
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("type") != "event":
+            continue
+        if rec.get("name") != "jit_compile":
+            continue
+        n += 1
+        cs = float(rec.get("compile_s", 0.0))
+        total += cs
+        key = class_key(rec)
+        d = per.setdefault(key, {"n": 0, "compile_s": 0.0})
+        d["n"] += 1
+        d["compile_s"] = round(d["compile_s"] + cs, 6)
+    if not n:
+        return {}
+    return {
+        "n_compiles": n,
+        "compile_s": round(total, 6),
+        "per_class": dict(sorted(per.items())),
+    }
+
+
+def wire_bandwidth(records: list[dict], totals: dict | None = None) -> float:
+    """Measured wire bandwidth of the capture in bytes/s: total wire
+    bytes over the union occupancy of BOTH directions' transfer spans
+    — the denominator of the roofline's ridge point. 0.0 when the
+    capture has no timed transfers."""
+    if totals is None:
+        totals = byte_totals(records)
+    wire = (
+        totals.get("h2d", {}).get("wire", 0)
+        + totals.get("d2h", {}).get("wire", 0)
+    )
+    both: list[tuple[float, float]] = []
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("type") != "xfer":
+            continue
+        if rec.get("dir") in ("h2d", "d2h"):
+            t = float(rec.get("t", 0.0))
+            both.append((t, t + float(rec.get("dur", 0.0))))
+    busy = _union_seconds(both)
+    return wire / busy if busy > 0 and wire > 0 else 0.0
+
+
+def roofline(
+    records: list[dict],
+    peak_flops: float | None = None,
+    totals: dict | None = None,
+) -> dict:
+    """The measured roofline position of every bucket class.
+
+    The ridge ("critical") intensity is peak FLOP/s over the capture's
+    own measured wire bandwidth — the FLOPs/byte a class must execute
+    for compute to own its wall. Classes above the ridge are
+    ``compute-bound`` (the wire could feed them faster than the MXU
+    drains them); below it they are ``wire-bound`` — the PR 7 wire
+    floor owns them and packing, not kernel work, is the lever. The
+    run-level ``attainable_frac`` compares the run's achieved FLOP/s
+    against min(peak, run intensity x wire bandwidth): 1.0 means the
+    run sat ON its roof; the gap is overhead the roofline model does
+    not explain. {} when the capture has no dev records."""
+    tot = device_totals(records, peak_flops=peak_flops)
+    if not tot:
+        return {}
+    if peak_flops is None:
+        peak_flops, peak_entry = device_peak_flops()
+    else:
+        peak_entry = "caller"
+    bw = wire_bandwidth(records, totals=totals)
+    critical = peak_flops / bw if bw > 0 else 0.0
+    classes = {}
+    for key, d in class_stats(records, peak_flops=peak_flops).items():
+        classes[key] = {
+            "intensity": d["intensity"],
+            "mfu": d["mfu"],
+            "verdict": (
+                "compute-bound"
+                if critical > 0 and d["intensity"] >= critical
+                else "wire-bound"
+            ),
+        }
+    achieved = tot["flops"] / tot["busy_s"] if tot["busy_s"] > 0 else 0.0
+    roof = (
+        min(peak_flops, tot["intensity"] * bw)
+        if bw > 0 else peak_flops
+    )
+    return {
+        "peak_flops": peak_flops,
+        "peak_entry": peak_entry,
+        "wire_bw_b_s": round(bw, 1),
+        "critical_intensity": round(critical, 4),
+        "achieved_flops_s": round(achieved, 1),
+        "attainable_frac": (
+            round(min(achieved / roof, 1.0), 4) if roof > 0 else 0.0
+        ),
+        "classes": classes,
+    }
+
+
+def sum_check_dev(
+    records: list[dict], seconds: dict | None = None
+) -> tuple[list[dict], bool]:
+    """Dev-record totals vs the executor's phase totals — the device
+    twin of the byte sum-check.
+
+    Every dev record's window IS a ``device_wait_fetch`` span and its
+    ``disp_s`` accrued exactly what the ``dispatch`` phase was charged
+    for that chunk, so the record sums must reproduce the summary's
+    two phase totals to within the time sum-check's tolerance (floats
+    round; bytes don't). A capture truncated by the bounded recorder
+    (summary n_dropped > 0) can only under-count: one-sided, records
+    <= summary. Returns (rows, ok); a capture with NO dev records
+    (pre-devledger) has nothing to check -> ([], True)."""
+    recs = dev_records(records)
+    if not recs:
+        return [], True
+    s = summary_record(records)
+    dropped = int((s or {}).get("n_dropped") or 0)
+    if seconds is None:
+        seconds = (s or {}).get("seconds") or {}
+    got = {
+        "device_wait_fetch": sum(float(r.get("dur", 0.0)) for r in recs),
+        "dispatch": sum(float(r.get("disp_s", 0.0)) for r in recs),
+    }
+    rows = []
+    ok_all = True
+    for stage, rec_s in got.items():
+        sv = seconds.get(stage, 0.0)
+        report_s = float(sv) if _is_num(sv) else 0.0
+        tol = _SUM_ABS_TOL + _SUM_REL_TOL * report_s
+        if dropped:
+            ok = rec_s <= report_s + tol
+        else:
+            ok = abs(rec_s - report_s) <= tol
+        ok_all &= ok
+        rows.append({
+            "stage": stage,
+            "records_s": round(rec_s, 3),
+            "summary_s": round(report_s, 3),
+            "ok": ok,
+        })
+    return rows, ok_all

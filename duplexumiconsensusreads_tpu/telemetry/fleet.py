@@ -81,7 +81,7 @@ __all__ = [
     "seg_rec", "gap_rec", "discover_service_captures",
     "load_capture", "load_captures", "load_journal",
     "load_metrics_docs", "stitch", "fleet_metrics", "run_overlap",
-    "render_prom", "check_slo", "render_report",
+    "run_device", "render_prom", "check_slo", "render_report",
 ]
 
 # Timeline segment kinds: what a daemon was doing while it held the
@@ -775,7 +775,8 @@ def fleet_metrics(
             continue
         info = daemons[d]
         for key in ("h2d_bytes", "d2h_bytes", "jobs_done", "jobs_failed",
-                    "compile_hit_rate", "verdict_hit_rate"):
+                    "compile_hit_rate", "verdict_hit_rate",
+                    "device_flops", "mfu"):
             if _is_num(doc.get(key)):
                 info[key] = doc[key]
 
@@ -862,6 +863,61 @@ def run_overlap(run_caps: list[dict]) -> dict:
     }
 
 
+def run_device(run_caps: list[dict]) -> dict:
+    """Per-class MFU aggregated over the fleet's per-run captures —
+    the fleet view of the device ledger (telemetry/devledger.py).
+    Per run: :func:`devledger.device_totals`; fleet level: FLOPs and
+    busy seconds sum EXACTLY across runs (distinct captures never share
+    a device interval, so the sum IS the union) and the fleet MFU is
+    total FLOPs over total busy over peak — a long run weighs
+    proportionally, the same weighting :func:`run_overlap` uses.
+    Per-class rows merge across runs by class key. Returns {} when no
+    run capture carries dev records (pre-devledger captures)."""
+    from duplexumiconsensusreads_tpu.telemetry import devledger
+    from duplexumiconsensusreads_tpu.telemetry.device import (
+        device_peak_flops,
+        round_mfu,
+    )
+
+    peak, peak_entry = device_peak_flops()
+    per: dict[str, dict] = {}
+    classes: dict[str, dict] = {}
+    flops = busy = 0.0
+    for cap in run_caps:
+        tot = devledger.device_totals(cap["records"], peak_flops=peak)
+        if not tot:
+            continue
+        per[os.path.basename(cap["path"])] = {
+            "flops": tot["flops"], "busy_s": tot["busy_s"],
+            "mfu": tot["mfu"], "intensity": tot["intensity"],
+        }
+        flops += tot["flops"]
+        busy += tot["busy_s"]
+        for key, d in devledger.class_stats(
+            cap["records"], peak_flops=peak
+        ).items():
+            c = classes.setdefault(key, {"flops": 0.0, "busy_s": 0.0})
+            c["flops"] = round(c["flops"] + d["flops"], 3)
+            c["busy_s"] = round(c["busy_s"] + d["busy_s"], 6)
+    if not per:
+        return {}
+    for c in classes.values():
+        c["mfu"] = (
+            round_mfu(c["flops"] / c["busy_s"] / peak)
+            if c["busy_s"] > 0 and peak > 0 else 0.0
+        )
+    return {
+        "n_runs": len(per),
+        "peak_entry": peak_entry,
+        "flops": round(flops, 3),
+        "busy_s": round(busy, 6),
+        "mfu": round_mfu(flops / busy / peak) if busy > 0 and peak > 0 else 0.0,
+        "classes": dict(sorted(classes.items(),
+                               key=lambda kv: -kv[1]["flops"])),
+        "runs": per,
+    }
+
+
 # ----------------------------------------------------------- exposition
 
 def render_prom(metrics: dict) -> str:
@@ -887,7 +943,7 @@ def render_prom(metrics: dict) -> str:
             lines.append(f'{name}{{class="{pri}"}} {v}')
     for d, info in sorted(metrics.get("daemons", {}).items()):
         for k in ("n_slices", "busy_s", "utilization",
-                  "h2d_bytes", "d2h_bytes"):
+                  "h2d_bytes", "d2h_bytes", "device_flops", "mfu"):
             v = info.get(k)
             if _is_num(v):
                 lines.append(f'dut_fleet_daemon_{k}{{daemon="{d}"}} {v}')

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 
 from duplexumiconsensusreads_tpu.telemetry.trace import (
+    KNOWN_DEV_FIELDS,
     KNOWN_EVENTS,
     KNOWN_STAGES,
     KNOWN_XFER_DIRS,
@@ -151,6 +152,47 @@ def validate_trace(records: list[dict]) -> list[str]:
                 not isinstance(rec["chunk"], int) or rec["chunk"] < 0
             ):
                 problems.append(f"record {i}: xfer chunk must be an int >= 0")
+            n_counted += 1
+        elif kind == "dev":
+            # device-ledger record (telemetry/devledger.py): the span
+            # envelope plus the registered dev fields — the class
+            # identity integral, the FLOP/second accumulators numeric
+            # and non-negative, no unregistered fields (the schema is
+            # a closed registry, unlike event attrs: devstat's table
+            # and sum-check read every field, so an unknown one is a
+            # schema fork, not extra context)
+            if not _is_num(rec.get("t")) or rec["t"] < 0:
+                problems.append(f"record {i}: dev needs numeric t >= 0")
+            if not _is_num(rec.get("dur")) or rec["dur"] < 0:
+                problems.append(f"record {i}: dev needs numeric dur >= 0")
+            if not isinstance(rec.get("lane"), str) or not rec.get("lane"):
+                problems.append(f"record {i}: dev needs a non-empty lane")
+            if "chunk" in rec and (
+                not isinstance(rec["chunk"], int) or rec["chunk"] < 0
+            ):
+                problems.append(f"record {i}: dev chunk must be an int >= 0")
+            for fk in ("cap", "cycles", "buckets", "h2d_wire", "d2h_wire"):
+                fv = rec.get(fk)
+                if not isinstance(fv, int) or isinstance(fv, bool) or fv < 0:
+                    problems.append(
+                        f"record {i}: dev {fk} must be an int >= 0"
+                    )
+            if not isinstance(rec.get("method"), str) or not rec.get("method"):
+                problems.append(
+                    f"record {i}: dev needs a non-empty method"
+                )
+            for fk in ("flops", "disp_s"):
+                if not _is_num(rec.get(fk)) or rec[fk] < 0:
+                    problems.append(
+                        f"record {i}: dev {fk} must be numeric >= 0"
+                    )
+            for fk in rec:
+                if fk in ("type", "t", "dur", "chunk", "lane"):
+                    continue
+                if fk not in KNOWN_DEV_FIELDS:
+                    problems.append(
+                        f"record {i}: unregistered dev field {fk!r}"
+                    )
             n_counted += 1
         elif kind == "summary":
             n_summary += 1

@@ -22,6 +22,15 @@ per-chunk pipeline:
          same (t, dur) pair as the stage span that moved them — the
          capture's byte accounting, sum-checked by tools/wirestat.py
          the way spans are sum-checked by tools/trace_report.py.
+  dev    one device-ledger dispatch (telemetry/devledger.py): the
+         bucket-class identity (capacity/cycles/buckets/method),
+         executed analytic FLOPs, the dispatch's wire bytes, and the
+         measured device interval — (t, dur) is the SAME pair as the
+         chunk's device_wait_fetch span and ``disp_s`` the same
+         seconds the dispatch phase accumulator received, so per-class
+         MFU/intensity/roofline fall out of any capture and
+         tools/devstat.py sum-checks the records against the phase
+         totals the way wirestat sum-checks bytes.
 
 Capture format: JSONL, one record per line, strictly in write order —
 a `meta` line first, then spans/events as they complete (NOT in start
@@ -109,6 +118,15 @@ KNOWN_EVENTS = (
     # fill_factor_off, predicted_speedup, source) — in a run capture at
     # the first profiled chunk, in a service capture when a verdict is
     # persisted/reused for a job's input profile
+    "jit_compile",  # device ledger: the FIRST pipeline call of a fresh
+    # dispatch class (a spec the executor's jit cache had not seen) —
+    # attrs: compile_s (the first-call seconds: trace + XLA compile +
+    # the first execution's dispatch), cap/cycles/method (the class
+    # identity devstat groups by). Per-class compile cost in the same
+    # record stream the per-class MFU comes from.
+    "profile_written",  # --profile: the jax.profiler trace directory
+    # was finalised (attrs: profile_dir) — the capture records that a
+    # profiler trace exists alongside it
     # serving layer (serve/service.py): the job lifecycle in a
     # kind="service" capture. Every job_* event carries a "job" attr and
     # a "job-<id>" lane, so one capture decomposes per job the way a run
@@ -162,6 +180,32 @@ KNOWN_XFER_DIRS = (
 #              ledgered; the per-record sums must reproduce the summary
 #              counter n_mesh_pad_buckets exactly (wirestat checks)
 KNOWN_H2D_XFER_ATTRS = ("bpc", "rows_real", "rows_pad", "cap", "mesh_pad")
+
+# Schema fields a ``dev`` (device-ledger) record carries beyond the
+# core envelope (type/t/dur/chunk/lane) — a registry like the h2d
+# attrs above; the capture validator checks the envelope against it
+# and dutlint's dev-ledger rule pins every literal keyword at the
+# emitting site, so the devstat schema cannot drift silently:
+#   cap       bucket capacity of the dispatch class (its ladder rung)
+#   cycles    read length L of the class's bucket tensors
+#   buckets   padded bucket count dispatched (mesh-pad included — pads
+#             ride the wire and the GEMM, so they are in the FLOPs too)
+#   method    the class's ssc kernel method (a kernels/consensus.py
+#             literal; every one has a registered cost function in
+#             ops/pipeline.py's SSC_METHOD_COSTS — dutlint enforces it)
+#   flops     executed analytic FLOPs of the class's dispatches
+#             (analytic_flops x padded bucket count, retries counted
+#             like the byte ledger counts re-transfers)
+#   h2d_wire  wire bytes the dispatches put on the device (the same
+#             bytes the chunk's h2d xfer records ledger)
+#   d2h_wire  wire bytes the materialised fetch moved back
+#   disp_s    host-side dispatch busy seconds of the class's
+#             dispatches — the SAME seconds phase["dispatch"] received,
+#             so devstat's dispatch sum-check holds by construction
+KNOWN_DEV_FIELDS = (
+    "cap", "cycles", "buckets", "method", "flops", "h2d_wire",
+    "d2h_wire", "disp_s",
+)
 
 # Literal lane ids/prefixes a recording site may pass as ``lane=``.
 # Most lanes derive from thread names (current_lane: main / xfer-N /
@@ -364,6 +408,34 @@ class TraceRecorder:
             rec["chunk"] = int(chunk)
         if attrs:
             rec.update(attrs)
+        self._emit(rec)
+
+    def dev(
+        self,
+        t_start: float,
+        dur: float,
+        chunk: int | None = None,
+        lane: str | None = None,
+        **fields,
+    ) -> None:
+        """Record one device-ledger dispatch (``type == "dev"``).
+
+        ``t_start`` / ``dur`` are the raw monotonic reading and
+        measured span of the chunk's device wait + fetch for this
+        dispatch class — the SAME pair the ``device_wait_fetch`` span
+        records, so summing ``dur`` over a capture's dev records
+        reproduces that phase total (the devstat time sum-check), and
+        ``fields["disp_s"]`` likewise sums to the dispatch phase.
+        ``fields`` are the KNOWN_DEV_FIELDS schema attrs."""
+        rec = {
+            "type": "dev",
+            "t": round(self.rel(t_start), 6), "dur": round(dur, 6),
+            "lane": lane or current_lane(),
+        }
+        if chunk is not None:
+            rec["chunk"] = int(chunk)
+        if fields:
+            rec.update(fields)
         self._emit(rec)
 
     def write_summary(self, **fields) -> None:

@@ -337,6 +337,35 @@ class TestStitch:
 
 # ------------------------------------------------- metrics / SLO / prom
 
+class TestRunDevice:
+    def test_fleet_device_ledger_sums_exactly_across_runs(self):
+        """Distinct captures never share a device interval, so fleet
+        FLOPs/busy SUM across runs; per-class and fleet MFU must both
+        survive CPU-sim magnitudes (~1e-7) without flushing to 0."""
+        dev = dict(cap=128, cycles=9, buckets=1, method="matmul",
+                   h2d_wire=10, d2h_wire=10, disp_s=0.01)
+        recs = [
+            {"type": "meta", "version": 1, "kind": "run",
+             "clock": "monotonic-relative"},
+            {"type": "dev", "t": 0.0, "dur": 1.0, "chunk": 0,
+             "lane": "drain-0", "flops": 197e6, **dev},
+        ]
+        caps = [
+            {"path": "a.trace.jsonl", "records": recs},
+            {"path": "b.trace.jsonl", "records": recs},
+        ]
+        d = fleet.run_device(caps)
+        assert d["n_runs"] == 2
+        assert d["flops"] == pytest.approx(2 * 197e6)
+        assert d["busy_s"] == pytest.approx(2.0)
+        assert d["mfu"] > 0
+        assert d["classes"]["c128xL9/matmul"]["mfu"] > 0
+        assert d["peak_entry"]
+        # pre-devledger captures contribute nothing -> {}
+        empty = [{"path": "c", "records": recs[:1]}]
+        assert fleet.run_device(empty) == {}
+
+
 class TestFleetMetrics:
     def metrics(self, tmp_path):
         pa, pb = _fixture_paths(tmp_path)

@@ -48,6 +48,8 @@ class TestEngine:
             # the protocol model-checker passes
             "state-machine", "txn-discipline", "fence-dominance",
             "exception-contract", "ingest-confinement",
+            # the device ledger's FLOP-cost registry closure
+            "kernel-cost-registry",
         } <= set(RULES)
         for rule in RULES.values():
             assert rule.title
@@ -1770,6 +1772,97 @@ class TestIngestConfinement:
         assert "_ingest_producer" in res.findings[0].message
 
 
+# ------------------------------------------------- kernel-cost-registry
+
+PIPE_COSTS_OK = """
+    def _cost_matmul(spec, r, l, b):
+        return 1.0
+
+    SSC_METHOD_COSTS = {
+        "matmul": _cost_matmul,
+        "blockseg": _cost_matmul,
+    }
+"""
+
+TRACE_DEV_OK = """
+    KNOWN_DEV_FIELDS = (
+        "cap", "cycles", "buckets", "method", "flops",
+        "h2d_wire", "d2h_wire", "disp_s",
+    )
+"""
+
+KERNEL_OK = """
+    def ssc_kernel(x, method="matmul"):
+        if method == "blockseg":
+            return x + 1
+        return x
+"""
+
+STREAM_DEV_OK = """
+    def drain(tr):
+        if tr is not None:
+            tr.dev(0.0, 0.1, chunk=0, cap=128, cycles=9, buckets=1,
+                   method="matmul", flops=1.0, h2d_wire=1, d2h_wire=1,
+                   disp_s=0.1)
+"""
+
+
+class TestKernelCostRegistry:
+    def base(self, **over):
+        files = {
+            "pkg/ops/pipeline.py": PIPE_COSTS_OK,
+            "pkg/telemetry/trace.py": TRACE_DEV_OK,
+            "pkg/kernels/ssc.py": KERNEL_OK,
+            "pkg/runtime/stream.py": STREAM_DEV_OK,
+        }
+        files.update(over)
+        return lint(files, rules=["kernel-cost-registry"])
+
+    def test_passes_when_registries_are_closed(self):
+        assert self.base().ok
+
+    def test_fires_on_unregistered_method_literal(self):
+        res = self.base(**{"pkg/kernels/ssc.py": """
+            def ssc_kernel(x, method="matmul"):
+                if method in ("blockseg", "warp"):
+                    return x + 1
+                return x
+            """})
+        assert any(
+            "'warp'" in f.message and "FLOP cost" in f.message
+            for f in res.findings
+        )
+
+    def test_fires_on_unregistered_dev_field(self):
+        res = self.base(**{"pkg/runtime/stream.py": """
+            def drain(tr):
+                if tr is not None:
+                    tr.dev(0.0, 0.1, chunk=0, method="matmul", gflops=3.0)
+            """})
+        assert any("'gflops'" in f.message for f in res.findings)
+        # chunk/lane are recorder-envelope args, never findings
+        assert not any("'chunk'" in f.message for f in res.findings)
+
+    def test_fires_on_dead_registry_entry(self):
+        res = self.base(**{"pkg/kernels/ssc.py": """
+            def ssc_kernel(x, method="matmul"):
+                return x
+            """})
+        assert any(
+            "'blockseg'" in f.message and "no kernel" in f.message
+            for f in res.findings
+        )
+
+    def test_skips_pre_registry_corpora(self):
+        # corpora without the registries (older anchors, fixtures for
+        # other rules) must not fire — the rule has nothing to close
+        res = lint(
+            {"pkg/kernels/ssc.py": KERNEL_OK},
+            rules=["kernel-cost-registry"],
+        )
+        assert res.ok
+
+
 # ------------------------------------------------------------------- CLI
 
 class TestCli:
@@ -1964,6 +2057,8 @@ class TestShippedTree:
             # the fleet flight recorder: its CLI carries the same
             # schema/sum-check obligations as wirestat/trace_report
             "tools/fleet_report.py",
+            # the device ledger's CLI twin of wirestat
+            "tools/devstat.py",
             # the profiling/tuning tools carry the same clock +
             # durability obligations as the report tools; anchoring
             # them here means clock/durability drift in any tool is

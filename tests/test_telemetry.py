@@ -25,7 +25,14 @@ from duplexumiconsensusreads_tpu.io import read_bam, simulated_bam
 from duplexumiconsensusreads_tpu.runtime import faults
 from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
 from duplexumiconsensusreads_tpu.simulate import SimConfig
-from duplexumiconsensusreads_tpu.telemetry import chrome, ledger, report, trace
+from duplexumiconsensusreads_tpu.telemetry import (
+    chrome,
+    device,
+    devledger,
+    ledger,
+    report,
+    trace,
+)
 from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 
 GP = GroupingParams(strategy="adjacency", paired=True)
@@ -509,7 +516,12 @@ class TestByteLedger:
     def test_chrome_export_carries_byte_counters(self, traced):
         records, _, _ = traced
         doc = chrome.to_chrome(records)
-        counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        # dev records export their own FLOP/s counters; the byte
+        # contract is on the xfer-cat counters only
+        counters = [
+            e for e in doc["traceEvents"]
+            if e["ph"] == "C" and e.get("cat") == "xfer"
+        ]
         assert counters
         names = {e["name"] for e in counters}
         assert any(n.startswith("h2d_bytes") for n in names)
@@ -522,6 +534,258 @@ class TestByteLedger:
             by_name.setdefault(e["name"], []).append(e["args"]["bytes"])
         for vals in by_name.values():
             assert 0 in vals and any(v > 0 for v in vals)
+
+
+# ---------------------------------------------------------- dev ledger
+
+class TestDeviceLedger:
+    """The FLOP twin of TestByteLedger: dev-record schema golden,
+    the dev sum-check against the executor's phase totals, the devstat
+    CLI corruption contract, interval-union busy accounting, and the
+    shared peak-FLOP/s table."""
+
+    def test_dev_record_schema_golden(self, traced):
+        records, rep, _ = traced
+        recs = devledger.dev_records(records)
+        assert recs, "a traced streaming run must carry dev records"
+        # golden envelope — a new field is a schema change and must be
+        # made here (and in ARCHITECTURE.md) on purpose, not by drift
+        envelope = {"type", "t", "dur", "chunk", "lane"} | set(
+            trace.KNOWN_DEV_FIELDS
+        )
+        from duplexumiconsensusreads_tpu.ops.pipeline import SSC_METHOD_COSTS
+
+        for r in recs:
+            assert set(r) == envelope
+            assert r["method"] in SSC_METHOD_COSTS
+            assert r["flops"] > 0 and r["buckets"] > 0
+            assert r["cap"] > 0 and r["cycles"] > 0
+            assert r["dur"] >= 0 and r["disp_s"] >= 0
+        # one record per chunk on a clean run — every chunk attributed
+        chunks = sorted(r["chunk"] for r in recs)
+        n_chunks = rep["n_chunks"]
+        assert chunks == list(range(n_chunks))
+
+    def test_sum_check_and_totals(self, traced):
+        records, rep, _ = traced
+        rows, ok = devledger.sum_check_dev(records)
+        assert ok, rows
+        assert {r["stage"] for r in rows} == {
+            "device_wait_fetch", "dispatch"
+        }
+        totals = devledger.device_totals(records)
+        classes = devledger.class_stats(records)
+        assert totals and classes
+        # union busy can never exceed summed durations, and per-class
+        # FLOPs must add up to the run total (exact: same floats)
+        assert totals["busy_s"] <= totals["dev_s"] + 1e-9
+        assert sum(d["flops"] for d in classes.values()) == pytest.approx(
+            totals["flops"], rel=1e-9
+        )
+        # RunReport carries the same ledger (rounded at to_json time)
+        assert rep["device_flops"] == pytest.approx(
+            totals["flops"], rel=1e-6
+        )
+        assert rep["device_seconds"] == pytest.approx(
+            totals["dev_s"], abs=2e-3
+        )
+        roof = devledger.roofline(records)
+        assert roof["classes"].keys() == classes.keys()
+        for v in roof["classes"].values():
+            assert v["verdict"] in ("compute-bound", "wire-bound")
+        comp = devledger.compile_stats(records)
+        assert comp["n_compiles"] >= 1 and comp["compile_s"] > 0
+
+    def test_busy_seconds_are_union_not_sum(self):
+        """Overlapping dev windows (wide drain pool) must collapse —
+        a sum would claim more device time than the wall contains.
+        Same contract as ledger.overlap_stats's device union."""
+        base = [{"type": "meta", "version": trace.TRACE_VERSION,
+                 "kind": "run", "clock": "monotonic-relative"}]
+        dev = dict(cap=128, cycles=9, buckets=1, method="matmul",
+                   flops=100.0, h2d_wire=10, d2h_wire=10, disp_s=0.01)
+        recs = base + [
+            {"type": "dev", "t": 0.0, "dur": 1.0, "chunk": 0,
+             "lane": "drain-0", **dev},
+            {"type": "dev", "t": 0.5, "dur": 1.0, "chunk": 1,
+             "lane": "drain-1", **dev},
+        ]
+        totals = devledger.device_totals(recs)
+        assert totals["dev_s"] == pytest.approx(2.0)
+        assert totals["busy_s"] == pytest.approx(1.5)
+        # the span-side twin: overlap_stats' device occupancy is the
+        # same union over device_wait_fetch spans
+        spans = base + [
+            {"type": "span", "stage": "device_wait_fetch", "t": 0.0,
+             "dur": 1.0, "lane": "drain-0"},
+            {"type": "span", "stage": "device_wait_fetch", "t": 0.5,
+             "dur": 1.0, "lane": "drain-1"},
+            {"type": "span", "stage": "ingest", "t": 0.0, "dur": 0.2,
+             "lane": "ingest"},
+        ]
+        ov = ledger.overlap_stats(spans)
+        assert ov["device_busy_s"] == pytest.approx(1.5)
+
+    def test_validator_rejects_malformed_dev(self):
+        base = [{"type": "meta", "version": trace.TRACE_VERSION,
+                 "kind": "run", "clock": "monotonic-relative"}]
+        good = {"type": "dev", "t": 0.0, "dur": 0.1, "chunk": 0,
+                "lane": "main", "cap": 128, "cycles": 9, "buckets": 1,
+                "method": "matmul", "flops": 1.0, "h2d_wire": 1,
+                "d2h_wire": 1, "disp_s": 0.01}
+        assert not report.validate_trace(base + [dict(good)])
+        bad_field = dict(good, gflops=3.0)
+        assert any(
+            "unregistered dev field" in p
+            for p in report.validate_trace(base + [bad_field])
+        )
+        bad_cap = dict(good, cap=1.5)
+        assert any(
+            "cap" in p for p in report.validate_trace(base + [bad_cap])
+        )
+        bad_method = dict(good, method="")
+        assert any(
+            "method" in p
+            for p in report.validate_trace(base + [bad_method])
+        )
+
+    def test_devstat_cli_ok_and_tampered_record(self, traced, tmp_path):
+        """The corruption contract, FLOP edition: healthy capture
+        exits 0 with the dev sum-check green; a capture whose dev
+        records disagree with the summary's phase totals exits 1."""
+        _, _, paths = traced
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        devstat = os.path.join(REPO, "tools", "devstat.py")
+        r = subprocess.run(
+            [sys.executable, devstat, paths["trace"]],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "dev sum-check" in r.stdout and "OK" in r.stdout
+        rj = subprocess.run(
+            [sys.executable, devstat, paths["trace"], "--json"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert rj.returncode == 0
+        doc = json.loads(rj.stdout)
+        assert doc["sum_check"]["ok"]
+        assert doc["classes"] and doc["totals"]["mfu"] > 0
+        assert doc["roofline"]["critical_intensity"] > 0
+        assert doc["peak_entry"]
+        # tamper one dev record's interval -> records/summary drift
+        tampered = str(tmp_path / "dev_tampered.jsonl")
+        with open(paths["trace"]) as f, open(tampered, "w") as g:
+            done = False
+            for line in f:
+                rec = json.loads(line)
+                if not done and rec.get("type") == "dev":
+                    rec["dur"] = round(rec["dur"] + 1.5, 6)
+                    done = True
+                g.write(json.dumps(rec) + "\n")
+        assert done
+        r = subprocess.run(
+            [sys.executable, devstat, tampered],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert r.returncode == 1
+        assert "DEVICE LEDGER DRIFT" in r.stderr
+
+    def test_devstat_pre_devledger_capture_is_vacuously_ok(self, tmp_path):
+        """Captures that predate the dev ledger (the committed CI
+        fixture) must pass with every check vacuous, not crash."""
+        p = str(tmp_path / "old.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps(
+                {"type": "meta", "version": trace.TRACE_VERSION,
+                 "kind": "run", "clock": "monotonic-relative"}) + "\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "devstat.py"), p],
+            capture_output=True, text=True, env=env, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "no dev records" in r.stdout
+        rows, ok = devledger.sum_check_dev(report.load_trace(p))
+        assert ok and rows == []
+
+    def test_chrome_export_carries_flops_counters(self, traced):
+        records, _, _ = traced
+        doc = chrome.to_chrome(records)
+        counters = [
+            e for e in doc["traceEvents"]
+            if e["ph"] == "C" and e.get("cat") == "dev"
+        ]
+        assert counters
+        for e in counters:
+            assert e["name"].startswith("device_gflops_s (c")
+            assert e["args"].get("gflops_s") is not None
+        by_name: dict = {}
+        for e in counters:
+            by_name.setdefault(e["name"], []).append(e["args"]["gflops_s"])
+        for vals in by_name.values():
+            assert 0 in vals and any(v > 0 for v in vals)
+
+    def test_device_peak_table_resolution(self, monkeypatch):
+        monkeypatch.delenv("DUT_PEAK_TFLOPS", raising=False)
+        assert device.device_peak_flops("TPU v5p") == (459.0e12, "v5p")
+        assert device.device_peak_flops("TPU v5 lite") == (197.0e12, "v5e")
+        assert device.device_peak_flops("tpu v4") == (275.0e12, "v4")
+        assert device.device_peak_flops("cpu") == (197.0e12, "cpu-sim")
+        flops, entry = device.device_peak_flops("quantum-accelerator-9000")
+        assert (flops, entry) == (197.0e12, "default-v5e")
+        # env override wins over any kind and names its provenance
+        monkeypatch.setenv("DUT_PEAK_TFLOPS", "42")
+        flops, entry = device.device_peak_flops("TPU v5p")
+        assert flops == 42e12 and entry == "env:42T"
+
+    def test_analytic_flops_registry_and_cost_analysis(self):
+        """Satellite check: the analytic cost model vs XLA's own
+        cost_analysis() on the jitted fused pipeline (CPU backend).
+
+        analytic_flops is a documented LOWER BOUND — it counts the
+        MXU-shaped work (adjacency/cluster GEMMs + seed propagation)
+        and excludes elementwise/VPU ops, while XLA counts every HLO
+        flop and may also simplify GEMMs the model charges for. On the
+        canonical small config the ratio measures ~0.85; the window
+        [0.2, 1.2] asserts same-order agreement without welding the
+        test to XLA's costing of one compiler version."""
+        from duplexumiconsensusreads_tpu.bucketing import build_buckets
+        from duplexumiconsensusreads_tpu.ops import spec_for_buckets
+        from duplexumiconsensusreads_tpu.ops.pipeline import (
+            SSC_METHOD_COSTS,
+            analytic_flops,
+            fused_pipeline,
+        )
+        from duplexumiconsensusreads_tpu.simulate import (
+            SimConfig,
+            simulate_batch,
+        )
+
+        cfg = SimConfig(n_molecules=80, duplex=True, umi_error=0.03, seed=31)
+        batch, _ = simulate_batch(cfg)
+        buckets = build_buckets(batch, capacity=128, adjacency=True)
+        spec = spec_for_buckets(buckets, GP, CP)
+        bk = buckets[0]
+        lowered = fused_pipeline.lower(
+            bk.pos, bk.umi, bk.strand_ab, bk.frag_end, bk.valid,
+            bk.bases, bk.quals, spec=spec,
+        )
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, list):  # older jax returns one dict per device
+            ca = ca[0]
+        xla = float(ca.get("flops", 0.0))
+        an = analytic_flops(spec, bk.capacity, bk.bases.shape[1], 1)
+        assert xla > 0 and an > 0
+        assert 0.2 * xla <= an <= 1.2 * xla, (an, xla)
+        # the registry is closed: unknown kernel methods must raise at
+        # dispatch time, not silently cost zero
+        bad = dataclasses.replace(spec, ssc_method="warp")
+        with pytest.raises(ValueError, match="warp"):
+            analytic_flops(bad, bk.capacity, bk.bases.shape[1], 1)
+        assert set(SSC_METHOD_COSTS) >= {
+            "matmul", "blockseg", "segment", "runsum",
+            "pallas", "pallas_interpret",
+        }
 
 
 # ------------------------------------------------ chaos + resume events
@@ -840,7 +1104,9 @@ class TestReportShape:
             "n_projection_unanchored_reads", "n_umi_corrected",
             "n_dropped_whitelist", "mate_aware", "ingest_overlap", "backend",
             "bytes_h2d", "bytes_d2h", "n_rows_real", "n_rows_padded",
-            "n_mesh_pad_buckets", "bucket_ladder", "seconds",
+            "n_mesh_pad_buckets", "bucket_ladder",
+            # the device ledger's run totals (telemetry/devledger.py)
+            "device_flops", "device_seconds", "seconds",
         }
         assert {f.name for f in dataclasses.fields(RunReport)} == golden
 

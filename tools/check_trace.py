@@ -66,10 +66,11 @@ def main(argv: list[str] | None = None) -> int:
     n_spans = sum(1 for r in records if r.get("type") == "span")
     n_events = sum(1 for r in records if r.get("type") == "event")
     n_xfer = sum(1 for r in records if r.get("type") == "xfer")
+    n_dev = sum(1 for r in records if r.get("type") == "dev")
     print(
         f"[check_trace] {args.trace}: OK "
         f"({kind} capture, {n_spans} spans, {n_events} events, "
-        f"{n_xfer} xfer)",
+        f"{n_xfer} xfer, {n_dev} dev)",
         file=sys.stderr,
     )
     return 0

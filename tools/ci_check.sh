@@ -21,6 +21,10 @@
 #      reconstruct every timeline with the admission→terminal
 #      sum-check green, so a stitching/schema regression fails at
 #      commit time, not when a production fleet needs post-morteming.
+#   4. devstat over the committed fixture capture: the device-ledger
+#      analyser must accept a known-good capture with its dev
+#      sum-check green (vacuously green on a pre-devledger fixture) —
+#      the FLOP twin of leg 2.
 #
 # tests/test_lint.py runs this script as a tier-1 test, so the gate
 # cannot rot out of CI.
@@ -41,5 +45,9 @@ echo "[ci_check] fleet_report (2-daemon fixture captures, sum-check)" >&2
 "$py" "$root/tools/fleet_report.py" \
     "$root/tests/data/fleet.fixture.a.trace.jsonl" \
     "$root/tests/data/fleet.fixture.b.trace.jsonl" >/dev/null
+
+echo "[ci_check] devstat (fixture capture, dev sum-check)" >&2
+"$py" "$root/tools/devstat.py" \
+    "$root/tests/data/run.fixture.trace.jsonl" >/dev/null
 
 echo "[ci_check] OK" >&2

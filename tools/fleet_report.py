@@ -125,6 +125,9 @@ def main(argv: list[str] | None = None) -> int:
     # ingest-overlap efficiency aggregated over any per-run captures
     # that rode along ({} when none carry ingest spans)
     overlap = fleet.run_overlap(captures.get("run", ()))
+    # per-class MFU aggregated the same way ({} when none carry dev
+    # records — pre-devledger captures)
+    device = fleet.run_device(captures.get("run", ()))
 
     slo_rows = None
     slo_ok = True
@@ -164,6 +167,7 @@ def main(argv: list[str] | None = None) -> int:
             "jobs": stitched["jobs"],
             "metrics": metrics,
             "overlap": overlap,
+            "device": device,
             "problems": stitched["problems"],
             "warnings": stitched["warnings"],
             "slo": slo_rows,
@@ -181,6 +185,19 @@ def main(argv: list[str] | None = None) -> int:
                 f"{overlap['efficiency']}  stall {overlap['stall_s']}s  "
                 f"backpressure {overlap['backpressure_s']}s"
             )
+        if device:
+            print()
+            print(
+                f"device ledger ({device['n_runs']} runs, peak "
+                f"{device['peak_entry']}): {device['flops'] / 1e9:.3f} "
+                f"GFLOP over {device['busy_s']:.3f}s busy = fleet mfu "
+                f"{device['mfu']}"
+            )
+            for key, c in device["classes"].items():
+                print(
+                    f"  {key}: {c['flops'] / 1e9:.3f} GFLOP  "
+                    f"busy {c['busy_s']:.3f}s  mfu {c['mfu']}"
+                )
         if slo_rows is not None:
             print()
             for r in slo_rows:

@@ -107,8 +107,10 @@ def summarize(records: list[dict]) -> dict:
             if isinstance(sec, dict):
                 j["seconds"] = sec
             # whole-job byte ledger (all slices), from the service's
-            # per-job accumulation — pre-ledger captures simply lack it
-            for key in ("h2d_bytes", "d2h_bytes", "bytes_per_read"):
+            # per-job accumulation — pre-ledger captures simply lack
+            # it; device_flops/mfu are the device-ledger twin
+            for key in ("h2d_bytes", "d2h_bytes", "bytes_per_read",
+                        "device_flops", "mfu"):
                 if isinstance(rec.get(key), (int, float)):
                     j[key] = rec[key]
         elif name == "job_failed":
@@ -275,9 +277,14 @@ def main(argv: list[str] | None = None) -> int:
                   f"({states}){merge}")
     print(f"{'job':<18} {'state':<11} {'pri':>3} {'slices':>6} "
           f"{'preempt':>7} {'wd':>3} {'wall_s':>8} {'warm':>5} "
-          f"{'h2d_mb':>8} {'d2h_mb':>8} {'B/read':>7} {'lineage':>12}")
+          f"{'h2d_mb':>8} {'d2h_mb':>8} {'B/read':>7} {'mfu':>7} "
+          f"{'lineage':>12}")
     def _mb(v):
         return f"{v / 1e6:.1f}" if isinstance(v, (int, float)) else "-"
+
+    def _fmt_mfu(v):
+        # "-" for pre-devledger captures (no mfu on the event at all)
+        return f"{v:.2g}" if isinstance(v, (int, float)) else "-"
 
     for job_id in sorted(s["jobs"]):
         j = s["jobs"][job_id]
@@ -295,6 +302,7 @@ def main(argv: list[str] | None = None) -> int:
             f"{str(j['warm']):>5} {_mb(j.get('h2d_bytes')):>8} "
             f"{_mb(j.get('d2h_bytes')):>8} "
             f"{f'{bpr:g}' if isinstance(bpr, (int, float)) else '-':>7} "
+            f"{_fmt_mfu(j.get('mfu')):>7} "
             f"{lineage:>12}"
         )
         sec = j.get("seconds")
