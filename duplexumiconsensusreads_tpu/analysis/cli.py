@@ -13,7 +13,10 @@ listed with their reasons under -v), 1 on any non-allowlisted finding,
 file, line, message per finding) for CI and editors; ``--rule ID``
 (repeatable) runs/bisects single passes; ``--strict`` — the CI gate's
 mode (tools/ci_check.sh) — additionally fails default-set runs whose
-allowlist carries stale entries.
+allowlist carries stale entries. ``--since REV`` is the fast local
+loop: the FULL default corpus still loads (the cross-file registries
+need it), but only findings in files changed vs the git rev are
+reported — CI keeps the whole-tree ``--strict`` gate.
 """
 
 from __future__ import annotations
@@ -21,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from duplexumiconsensusreads_tpu.analysis.allowlist import ALLOWLIST
@@ -36,6 +40,7 @@ TEST_ANCHORS = (
     "tests/test_chaos.py",
     "tests/test_telemetry.py",
     "tests/test_serve.py",
+    "tests/test_knobs.py",
 )
 
 
@@ -68,6 +73,35 @@ def default_targets(root: str) -> list[str]:
     return rels
 
 
+def changed_since(root: str, rev: str) -> set[str] | None:
+    """Repo-relative paths changed vs ``rev``: committed diffs plus
+    worktree edits plus untracked files — everything the fast local
+    loop might have touched. None (usage error) when git fails — an
+    unknown rev must not silently lint nothing."""
+    try:
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", rev, "--"],
+            capture_output=True, text=True,
+        )
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True,
+        )
+        if untracked.returncode != 0:
+            return None
+    except OSError:
+        return None
+    return {
+        line.strip()
+        for out in (diff.stdout, untracked.stdout)
+        for line in out.splitlines()
+        if line.strip()
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="dutlint",
@@ -85,6 +119,13 @@ def main(argv: list[str] | None = None) -> int:
                     "the checkout containing the package)")
     ap.add_argument("--rule", action="append", dest="rules", metavar="ID",
                     help="run only this rule (repeatable)")
+    ap.add_argument(
+        "--since", metavar="REV", default=None,
+        help="incremental mode: load the full default corpus (the "
+        "cross-file registries need it) but report only findings in "
+        "files changed vs this git rev (committed + worktree + "
+        "untracked); CI keeps the whole-tree --strict gate",
+    )
     ap.add_argument("--json", action="store_true", help="JSON report")
     ap.add_argument(
         "--strict", action="store_true",
@@ -103,6 +144,11 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     root = os.path.abspath(args.root) if args.root else repo_root()
+    if args.since and args.paths:
+        print("dutlint: --since and explicit paths are mutually "
+              "exclusive (--since picks the file set itself)",
+              file=sys.stderr)
+        return 2
     rels = args.paths or default_targets(root)
     if args.rules:
         bad = [r for r in args.rules if r not in RULES]
@@ -110,16 +156,34 @@ def main(argv: list[str] | None = None) -> int:
             print(f"dutlint: unknown rule(s): {', '.join(bad)}",
                   file=sys.stderr)
             return 2
+    changed: set[str] | None = None
+    if args.since:
+        changed = changed_since(root, args.since)
+        if changed is None:
+            print(f"dutlint: --since {args.since}: not a resolvable "
+                  f"git rev in {root}", file=sys.stderr)
+            return 2
     try:
         corpus = load_corpus(root, rels)
     except OSError as e:
         print(f"dutlint: {e}", file=sys.stderr)
         return 2
     result = run_lint(corpus, ALLOWLIST, only_rules=args.rules)
+    if changed is not None:
+        # the registries were read from the FULL corpus above; only the
+        # reporting narrows. A finding in an unchanged file still means
+        # the tree is dirty — but that is CI's whole-tree job, not the
+        # fast local loop's.
+        result.findings = [f for f in result.findings if f.path in changed]
+        result.suppressed = [
+            (f, a) for f, a in result.suppressed if f.path in changed
+        ]
     # --strict folds allowlist staleness into the exit status, but only
-    # against the full default set (see the warning path below)
+    # against the full default set (see the warning path below);
+    # --since is a subset view, so staleness stays out of its verdict
     stale_fails = bool(
-        args.strict and not args.paths and result.unused_allowlist
+        args.strict and not args.paths and not args.since
+        and result.unused_allowlist
     )
 
     if args.json:
@@ -142,7 +206,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.verbose:
         for f, a in result.suppressed:
             print(f"allowed: {f.format()}\n         reason: {a.reason}")
-    if not args.paths:
+    if not args.paths and not args.since:
         # staleness is only meaningful against the full default set: an
         # explicit file subset legitimately misses most entries. Stale
         # suppressions are warnings here (failures under --strict — the

@@ -23,8 +23,12 @@ from duplexumiconsensusreads_tpu.analysis.engine import (
     call_name,
     enclosing_function,
     expr_path,
+    function_defs,
     guarded_not_none,
     inside_lock_body,
+    inside_named_lock,
+    literal_assign,
+    reachable_functions,
     register,
     str_const,
     str_dict_assign,
@@ -2215,169 +2219,655 @@ def check_host_locality(corpus: Corpus) -> Iterator[Finding]:
             )
 
 
-# -------------------------------------------- rule: ingest confinement
+# ----------------------------------------- rule: thread-confinement
 
-# everything the CONSUMER side of the streaming executor owns: the
-# drain/dispatch pipeline, the prefetch window, the checkpoint. The
-# byte-identity proof for --ingest-overlap rests on the producer thread
-# never touching any of it — the bounded handoff queue is the ONLY
-# seam between the threads, so the proof stays local to one queue.
-_CONSUMER_NAMES = {
-    "inflight", "done_q", "prefetch_sem", "drain", "ckpt",
-}
-
-# device/dispatch entry points: work that must stay on the main loop /
-# its worker pools (the producer is a pure host-prep thread — a device
-# call from it would race the mesh dispatch and void the ordering
-# argument)
+# device/dispatch entry points: work only roles holding the "device"
+# effect grant may perform (a device call from an ungranted thread
+# races the mesh dispatch and voids the single-dispatcher ordering
+# argument the byte-identity proofs rest on)
 _DEVICE_CALLS = {
     "device_put", "block_until_ready", "sharded_pipeline",
-    "start_fetch", "dispatch_chunk", "materialize", "materialise",
+    "presharded_pipeline", "start_fetch", "dispatch_chunk",
+    "materialize", "materialise", "fetch_outputs",
 }
 
-# durable-state moves the producer must never make: per-chunk
-# checkpoint marks, journal transactions, durable writes — exactly-once
-# resume is proven over MAIN-LOOP commit order, and a producer-side
-# mark would commit a chunk the consumer has not finished
-_DURABLE_CALLS = {
-    "mark", "save", "_txn", "write_durable", "replace_durable",
-    "rewrite_from",
+# durable-state moves requiring the "durable" grant: per-chunk
+# checkpoint marks and durable writes — exactly-once resume is proven
+# over declared-role commit order, and an ungranted thread's mark
+# would commit a chunk its owner has not finished
+_DURABLE_MOVE_CALLS = {
+    "mark", "save", "write_durable", "replace_durable", "rewrite_from",
 }
 
+# flock'd journal transactions require the "journal" grant (the serve
+# fleet's txn seam; rule 10 checks what happens INSIDE the txn body,
+# this rule checks WHO may open one)
+_JOURNAL_CALLS = {"_txn", "txn"}
 
-def _producer_scope(tree: ast.Module, root_name: str) -> list:
-    """The producer thread's static call scope: the ``root_name``
-    function plus every same-file function it (transitively) calls by
-    name — the closures the thread body actually runs (_q_put,
-    _prep_chunk, the retry helpers). Imported callees are out of scope;
-    they are the main loop's shared vocabulary and carry their own
-    rules."""
-    defs: dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defs.setdefault(node.name, node)
-    if root_name not in defs:
-        return []
-    scope = {root_name}
-    frontier = [defs[root_name]]
-    while frontier:
-        fn = frontier.pop()
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            name = call_name(node)
-            if name in defs and name not in scope:
-                scope.add(name)
-                frontier.append(defs[name])
-    return [defs[n] for n in sorted(scope)]
+
+def _thread_roles(corpus: Corpus):
+    """(knobs_path, THREAD_ROLES dict) read FROM THE CORPUS — never
+    imported, so fixture corpora declare their own miniature
+    registries. (None, None) when runtime/knobs.py is absent;
+    (path, None) when present but the literal is unreadable."""
+    path = corpus.find("runtime/knobs.py")
+    if path is None:
+        return None, None
+    roles = literal_assign(corpus.trees[path], "THREAD_ROLES")
+    if not isinstance(roles, dict) or not roles:
+        return path, None
+    return path, roles
 
 
 @register(
-    "ingest-confinement",
-    "the ingest producer thread makes no device calls, no durable "
-    "state moves, and hands off only through the bounded queue",
+    "thread-confinement",
+    "every declared thread role's transitive call scope stays inside "
+    "its allowed effects, shared structures and locks",
 )
-def check_ingest_confinement(corpus: Corpus) -> Iterator[Finding]:
-    """The pipelined-ingest thread contract (runtime/stream.py
-    ``_ingest_producer`` + the closures it calls): the producer is a
-    pure host-prep stage — read, inflate, parse, bucket — and the
-    depth-bounded handoff queue is its ONLY seam with the consumer.
-    Three drift classes, each of which would void the byte-identity /
-    exactly-once proofs silently:
+def check_thread_confinement(corpus: Corpus) -> Iterator[Finding]:
+    """The declared thread-confinement model: ``THREAD_ROLES`` in
+    runtime/knobs.py maps each thread-entry function (xfer/drain pool
+    bodies, the ``dut-ingest`` producer, heartbeat, the serve
+    watchdog/workers — PR 17's ingest-only rule is now the producer
+    row) to its allowed effects, and this rule walks each entry's
+    transitive same-file call scope against the row:
 
-    (a) a jax/device/dispatch call from the producer scope races the
-        main loop's mesh dispatch and breaks the single-dispatcher
-        ordering argument;
-    (b) a checkpoint mark / journal txn / durable write from the
-        producer commits state for a chunk the consumer has not
-        finished — resume would skip work that never happened;
-    (c) touching a consumer-owned structure (inflight window, drain
-        pool, prefetch semaphore, done_q, the checkpoint object) or
-        putting to any queue other than the handoff queue bypasses the
-        one audited seam.
+    (a) a device/dispatch call without the "device" grant, a durable
+        state move without "durable", a journal txn without "journal";
+    (b) touching a structure another role declared (the per-module
+        union of ``shared`` names is the watched set) without
+        declaring it, or touching a declared one outside its declared
+        ``with <lock>:`` body (lock "" = self-synchronizing);
+    (c) for roles with a declared ``handoff`` queue: putting to any
+        other queue bypasses the one audited seam.
 
-    The rule also pins the producer's existence: a stream.py that
-    still carries the overlap machinery (the ``dut-ingest`` thread
-    name or the ``ingest_stall`` phase) but no ``_ingest_producer``
-    function has renamed the anchor out from under this rule —
-    that is a finding, not a silent skip."""
-    stream_path = corpus.find("runtime/stream.py")
-    if stream_path is None:
-        return
-    tree = corpus.trees[stream_path]
-    scope_fns = _producer_scope(tree, "_ingest_producer")
-    if not scope_fns:
-        has_overlap_markers = any(
-            str_const(n) in ("dut-ingest", "ingest_stall")
-            for n in ast.walk(tree)
-        )
-        if has_overlap_markers:
+    Rename protection: a registry row whose entry function is gone
+    while its thread-name marker is still in the module has renamed
+    the anchor out from under the rule — a finding, not a skip. A
+    corpus with no THREAD_ROLES at all owes nothing (pre-registry
+    fixtures), unless a file still references the registry name."""
+    knobs_path, roles = _thread_roles(corpus)
+    if roles is None:
+        if knobs_path is not None and (
+            "THREAD_ROLES" in corpus.sources[knobs_path]
+        ):
             yield Finding(
-                rule="ingest-confinement",
-                path=stream_path,
+                rule="thread-confinement",
+                path=knobs_path,
                 line=1,
-                message="overlap machinery present ('dut-ingest'/"
-                "'ingest_stall') but no _ingest_producer function",
-                hint="keep the producer body in a function named "
-                "_ingest_producer — it anchors the thread-confinement "
-                "checks",
+                message="THREAD_ROLES is present but not a readable "
+                "literal dict",
+                hint="keep the registry a PURE literal — the rule reads "
+                "it from the parsed corpus, never by import",
             )
-        return
-    for fn in scope_fns:
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call):
-                name = call_name(node)
-                callee = expr_path(node.func) or name
-                if name in _DEVICE_CALLS or callee.startswith("jax."):
-                    yield Finding(
-                        rule="ingest-confinement",
-                        path=stream_path,
-                        line=node.lineno,
-                        message=f"device/dispatch call {callee}() in the "
-                        f"ingest producer scope ({fn.name})",
-                        hint="the producer is host-prep only; device "
-                        "work belongs to the main loop's dispatch "
-                        "pipeline (single-dispatcher ordering)",
-                    )
-                elif name in _DURABLE_CALLS:
-                    yield Finding(
-                        rule="ingest-confinement",
-                        path=stream_path,
-                        line=node.lineno,
-                        message=f"durable state move {callee}() in the "
-                        f"ingest producer scope ({fn.name})",
-                        hint="checkpoint marks / journal txns / durable "
-                        "writes commit on the MAIN loop after the chunk "
-                        "finishes — a producer-side commit breaks "
-                        "exactly-once resume",
-                    )
-                elif (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr in ("put", "put_nowait")
-                ):
-                    recv = expr_path(node.func.value) or ""
-                    if not recv.endswith("ingest_q"):
-                        yield Finding(
-                            rule="ingest-confinement",
-                            path=stream_path,
-                            line=node.lineno,
-                            message=f"producer puts to {recv or '?'!r} — "
-                            f"not the bounded handoff queue",
-                            hint="the handoff queue (ingest_q) is the "
-                            "producer's only legal output channel",
-                        )
-            elif isinstance(node, ast.Name) and node.id in _CONSUMER_NAMES:
+            return
+        # pre-registry corpora owe nothing; but a tree that still
+        # NAMES the registry while the literal is gone has deleted the
+        # model out from under its machinery
+        for path in sorted(corpus.trees):
+            if path == knobs_path:
+                continue
+            if "THREAD_ROLES" in corpus.sources[path]:
                 yield Finding(
-                    rule="ingest-confinement",
-                    path=stream_path,
-                    line=node.lineno,
-                    message=f"consumer-owned structure {node.id!r} "
-                    f"referenced in the ingest producer scope "
-                    f"({fn.name})",
-                    hint="the producer may only touch its own state and "
-                    "the bounded handoff queue; everything else is the "
-                    "consumer's (thread-confinement contract)",
+                    rule="thread-confinement",
+                    path=path,
+                    line=1,
+                    message="THREAD_ROLES is referenced but "
+                    "runtime/knobs.py declares no readable literal",
+                    hint="restore the THREAD_ROLES literal in "
+                    "runtime/knobs.py — the thread model must stay "
+                    "declared",
                 )
+        return
+
+    # per-module watched set: the union of every role's shared names —
+    # what ANY role owns, every other role in that module must declare
+    # before touching
+    watched_by_module: dict[str, set[str]] = {}
+    for role, row in roles.items():
+        if not isinstance(row, dict):
+            continue
+        for pair in row.get("shared", ()):
+            watched_by_module.setdefault(
+                str(row.get("module", "")), set()
+            ).add(str(pair[0]))
+
+    for role in sorted(roles):
+        row = roles[role]
+        if not (
+            isinstance(row, dict)
+            and isinstance(row.get("module"), str)
+            and row.get("module")
+            and "entry" in row
+        ):
+            yield Finding(
+                rule="thread-confinement",
+                path=knobs_path,
+                line=1,
+                message=f"THREAD_ROLES[{role!r}] is malformed "
+                f"(needs module/entry/may/shared)",
+                hint="see runtime/knobs.py's field contract",
+            )
+            continue
+        mod_path = corpus.find(row["module"])
+        if mod_path is None:
+            continue  # fixture corpora may carry a module subset
+        entry = str(row["entry"])
+        if not entry:
+            continue  # the main loop: an ownership row, not walked
+        tree = corpus.trees[mod_path]
+        defs = function_defs(tree)
+        marker = str(row.get("marker", ""))
+        if entry not in defs:
+            if marker and marker in corpus.sources[mod_path]:
+                yield Finding(
+                    rule="thread-confinement",
+                    path=mod_path,
+                    line=1,
+                    message=f"thread marker {marker!r} present but the "
+                    f"declared {role!r} entry {entry}() is gone",
+                    hint="keep the thread body in a function named as "
+                    "declared in THREAD_ROLES — it anchors the "
+                    "confinement walk",
+                )
+            continue
+        may = {str(m) for m in row.get("may", ())}
+        allowed = {
+            str(p[0]): (str(p[1]) if len(p) > 1 else "")
+            for p in row.get("shared", ())
+        }
+        handoff = str(row.get("handoff", ""))
+        watched = watched_by_module.get(row["module"], set())
+        for fn in reachable_functions(defs, entry):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    callee = expr_path(node.func) or name
+                    if (
+                        name in _DEVICE_CALLS or callee.startswith("jax.")
+                    ) and "device" not in may:
+                        yield Finding(
+                            rule="thread-confinement",
+                            path=mod_path,
+                            line=node.lineno,
+                            message=f"device/dispatch call {callee}() in "
+                            f"the {role!r} thread scope ({fn.name}) "
+                            f"without the 'device' grant",
+                            hint="device work belongs to roles declaring "
+                            "'device' in THREAD_ROLES (single-"
+                            "dispatcher ordering)",
+                        )
+                    elif name in _DURABLE_MOVE_CALLS and "durable" not in may:
+                        yield Finding(
+                            rule="thread-confinement",
+                            path=mod_path,
+                            line=node.lineno,
+                            message=f"durable state move {callee}() in "
+                            f"the {role!r} thread scope ({fn.name}) "
+                            f"without the 'durable' grant",
+                            hint="checkpoint marks / durable writes "
+                            "commit only from roles declaring 'durable' "
+                            "— anything else breaks exactly-once resume",
+                        )
+                    elif name in _JOURNAL_CALLS and "journal" not in may:
+                        yield Finding(
+                            rule="thread-confinement",
+                            path=mod_path,
+                            line=node.lineno,
+                            message=f"journal txn {callee}() in the "
+                            f"{role!r} thread scope ({fn.name}) without "
+                            f"the 'journal' grant",
+                            hint="only roles declaring 'journal' may "
+                            "open the flock'd journal transaction",
+                        )
+                    elif (
+                        handoff
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("put", "put_nowait")
+                    ):
+                        recv = expr_path(node.func.value) or ""
+                        if not recv.endswith(handoff):
+                            yield Finding(
+                                rule="thread-confinement",
+                                path=mod_path,
+                                line=node.lineno,
+                                message=f"{role!r} thread puts to "
+                                f"{recv or '?'!r} — not its declared "
+                                f"handoff queue ({handoff})",
+                                hint="the declared handoff queue is the "
+                                "role's only legal output channel",
+                            )
+                elif isinstance(node, ast.Name) and node.id in watched:
+                    if node.id not in allowed:
+                        yield Finding(
+                            rule="thread-confinement",
+                            path=mod_path,
+                            line=node.lineno,
+                            message=f"shared structure {node.id!r} "
+                            f"touched in the {role!r} thread scope "
+                            f"({fn.name}) but not declared in its "
+                            f"THREAD_ROLES row",
+                            hint="declare the (structure, lock) pair in "
+                            "the role's shared list — or keep the "
+                            "structure out of that thread's lane",
+                        )
+                    else:
+                        lock = allowed[node.id]
+                        if lock and not inside_named_lock(node, lock):
+                            yield Finding(
+                                rule="thread-confinement",
+                                path=mod_path,
+                                line=node.lineno,
+                                message=f"shared structure {node.id!r} "
+                                f"touched in the {role!r} thread scope "
+                                f"({fn.name}) outside its declared "
+                                f"lock ({lock})",
+                                hint=f"wrap the access in "
+                                f"`with {lock}:` — the registry says "
+                                f"that lock guards this structure",
+                            )
+
+
+# ------------------------------------------------- rule: knob-taint
+
+# the canonical surface vocabulary (mirrored from runtime/knobs.py
+# SURFACES; the corpus declaration wins when present)
+_KNOWN_SURFACES = (
+    "fingerprint", "spec_signature", "provenance", "job_config",
+    "streaming_only",
+)
+
+
+def _knob_table(corpus: Corpus):
+    """(knobs_path, KNOB_TABLE dict, assign lineno) read FROM THE
+    CORPUS — same contract as :func:`_thread_roles`."""
+    path = corpus.find("runtime/knobs.py")
+    if path is None:
+        return None, None, 0
+    tree = corpus.trees[path]
+    table = literal_assign(tree, "KNOB_TABLE")
+    line = 1
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "KNOB_TABLE"
+                for t in node.targets
+            )
+        ):
+            line = node.lineno
+            break
+    if not isinstance(table, dict) or not table:
+        return path, None, line
+    return path, table, line
+
+
+def _fn_scan(fn: ast.AST):
+    """(name_lines, literal_lines, kwarg_lines) for one function body:
+    every Name id, string literal, and keyword-argument name, each
+    mapped to its first line — the evidence a knob 'reaches' a
+    determinism-surface constructor."""
+    names: dict[str, int] = {}
+    lits: dict[str, int] = {}
+    kwargs: dict[str, int] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            names.setdefault(node.id, node.lineno)
+        s = str_const(node)
+        if s is not None:
+            lits.setdefault(s, node.lineno)
+        if isinstance(node, ast.Call):
+            for kw in node.keywords or ():
+                if kw.arg:
+                    kwargs.setdefault(kw.arg, node.lineno)
+    return names, lits, kwargs
+
+
+def _imports_knobs(tree: ast.Module) -> bool:
+    """Does this module import the knob registry (``from ...runtime
+    import knobs`` / ``from ...runtime.knobs import ...``)? The
+    evidence that a surface constructor is table-driven."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.endswith("runtime.knobs") or mod.endswith(".knobs"):
+                return True
+            if mod.endswith("runtime") and any(
+                a.name == "knobs" for a in node.names
+            ):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name.endswith(".knobs") for a in node.names):
+                return True
+    return False
+
+
+@register(
+    "knob-taint",
+    "every execution knob is declared in runtime/knobs.py and reaches "
+    "exactly its declared determinism surfaces",
+)
+def check_knob_taint(corpus: Corpus) -> Iterator[Finding]:
+    """The knob registry model-check: ``KNOB_TABLE`` declares every
+    execution knob's class (semantic | scheduling) and its membership
+    in each determinism surface; this rule walks the surface
+    constructors against it:
+
+    (a) table sanity — every row's class and surfaces are from the
+        declared vocabulary;
+    (b) the checkpoint fingerprint (runtime/stream.py
+        ``_fingerprint``): a knob reaching it must declare the
+        ``fingerprint`` surface (for a scheduling knob that is the
+        taint this rule exists to catch — resumes would refuse
+        byte-identical work); a declared knob must actually reach it
+        (by parameter name, or via ``dataclasses.asdict`` for
+        ``via: params`` knobs);
+    (c) the compile identity (serve/job.py ``spec_signature``): config
+        keys used == keys declared, both directions;
+    (d) the provenance line (serve/job.py ``serve_provenance``): any
+        knob special-cased by literal while its row excludes the
+        ``provenance`` surface is a hand-rolled exclusion — surface
+        membership lives in the registry, not in the constructor;
+    (e) the job config (serve/job.py ``CONFIG_DEFAULTS``): a literal
+        dict must match the declared ``job_config`` set exactly; a
+        derived one must come from the registry (knobs import);
+    (f) the CLI's resolution closed world: every ``opt("...")``
+        literal in cli/main.py is a declared knob, and the
+        streaming-only refusals are table-driven;
+    (g) coverage pin (TRANSITIONS-style): every declared scheduling
+        job knob is exercised by name in the linted test anchors —
+        the byte-identity matrix is the proof scheduling knobs are
+        byte-neutral, so an unexercised one is an unproved claim."""
+    knobs_path, table, table_line = _knob_table(corpus)
+    if table is None:
+        if knobs_path is not None and (
+            "KNOB_TABLE" in corpus.sources[knobs_path]
+        ):
+            yield Finding(
+                rule="knob-taint",
+                path=knobs_path,
+                line=table_line,
+                message="KNOB_TABLE is present but not a readable "
+                "literal dict",
+                hint="keep the registry a PURE literal — the rule reads "
+                "it from the parsed corpus, never by import",
+            )
+            return
+        for path in sorted(corpus.trees):
+            if path == knobs_path:
+                continue
+            if "KNOB_TABLE" in corpus.sources[path]:
+                yield Finding(
+                    rule="knob-taint",
+                    path=path,
+                    line=1,
+                    message="KNOB_TABLE is referenced but "
+                    "runtime/knobs.py declares no readable literal",
+                    hint="restore the KNOB_TABLE literal in "
+                    "runtime/knobs.py — the knob surfaces must stay "
+                    "declared",
+                )
+        return
+
+    surfaces_vocab = set(_KNOWN_SURFACES)
+    declared_vocab, _ = str_tuple_assign(
+        corpus.trees[knobs_path], "SURFACES"
+    )
+    if declared_vocab:
+        surfaces_vocab = set(declared_vocab)
+
+    # (a) table sanity
+    rows: dict[str, dict] = {}
+    for name in table:
+        row = table[name]
+        if not isinstance(row, dict) or row.get("class") not in (
+            "semantic", "scheduling"
+        ):
+            yield Finding(
+                rule="knob-taint",
+                path=knobs_path,
+                line=table_line,
+                message=f"knob {name!r} has no valid class "
+                f"(semantic | scheduling)",
+                hint="every knob declares its class — it decides which "
+                "surfaces the knob may legally reach",
+            )
+            continue
+        bad = set(row.get("surfaces", ())) - surfaces_vocab
+        if bad:
+            yield Finding(
+                rule="knob-taint",
+                path=knobs_path,
+                line=table_line,
+                message=f"knob {name!r} declares unknown surface(s) "
+                f"{sorted(bad)}",
+                hint=f"the surface vocabulary is {sorted(surfaces_vocab)}",
+            )
+            continue
+        rows[name] = row
+
+    def surf(name: str) -> set:
+        return set(rows[name].get("surfaces", ()))
+
+    # (b) the checkpoint fingerprint
+    stream_path = corpus.find("runtime/stream.py")
+    fp_fn = None
+    if stream_path is not None:
+        fp_fn = function_defs(corpus.trees[stream_path]).get("_fingerprint")
+    if fp_fn is not None:
+        names, lits, kwargs = _fn_scan(fp_fn)
+        asdict_line = 0
+        for node in ast.walk(fp_fn):
+            if isinstance(node, ast.Call) and call_name(node) == "asdict":
+                asdict_line = node.lineno
+                break
+        for name in rows:
+            key = rows[name].get("stream_kwarg") or name
+            declared = "fingerprint" in surf(name)
+            at = names.get(key) or lits.get(key) or kwargs.get(key)
+            if declared and rows[name].get("via") == "params":
+                if not asdict_line:
+                    yield Finding(
+                        rule="knob-taint",
+                        path=stream_path,
+                        line=fp_fn.lineno,
+                        message=f"knob {name!r} declares the fingerprint "
+                        f"surface via params but _fingerprint has no "
+                        f"dataclasses.asdict() evidence",
+                        hint="via:'params' knobs reach the fingerprint "
+                        "through asdict(GroupingParams/ConsensusParams) "
+                        "— keep that call, or redeclare the route",
+                    )
+            elif declared and at is None:
+                yield Finding(
+                    rule="knob-taint",
+                    path=stream_path,
+                    line=fp_fn.lineno,
+                    message=f"knob {name!r} declares the fingerprint "
+                    f"surface but never reaches _fingerprint",
+                    hint="thread it through _fingerprint (or drop the "
+                    "surface from its KNOB_TABLE row) — a declared-but-"
+                    "absent semantic knob lets resume splice shards "
+                    "computed under different semantics",
+                )
+            elif not declared and at is not None:
+                if rows[name]["class"] == "scheduling":
+                    yield Finding(
+                        rule="knob-taint",
+                        path=stream_path,
+                        line=at,
+                        message=f"scheduling knob {name!r} taints the "
+                        f"checkpoint fingerprint",
+                        hint="scheduling knobs are byte-neutral by "
+                        "contract — fingerprinting one makes resume "
+                        "refuse byte-identical work; drop it from "
+                        "_fingerprint",
+                    )
+                else:
+                    yield Finding(
+                        rule="knob-taint",
+                        path=stream_path,
+                        line=at,
+                        message=f"knob {name!r} reaches _fingerprint but "
+                        f"does not declare the fingerprint surface",
+                        hint="declare the surface in its KNOB_TABLE row "
+                        "— the registry states shipped behaviour",
+                    )
+
+    # (c)+(d)+(e): the serve-side surfaces
+    job_path = corpus.find("serve/job.py")
+    if job_path is not None:
+        job_tree = corpus.trees[job_path]
+        job_defs = function_defs(job_tree)
+        sig_fn = job_defs.get("spec_signature")
+        if sig_fn is not None:
+            _, lits, kwargs = _fn_scan(sig_fn)
+            for name in rows:
+                declared = "spec_signature" in surf(name)
+                at = lits.get(name) or kwargs.get(name)
+                if declared and at is None:
+                    yield Finding(
+                        rule="knob-taint",
+                        path=job_path,
+                        line=sig_fn.lineno,
+                        message=f"knob {name!r} declares the "
+                        f"spec_signature surface but spec_signature "
+                        f"never reads it",
+                        hint="geometry-bearing knobs must join the "
+                        "compile identity — two jobs differing in one "
+                        "must not share XLA programs",
+                    )
+                elif at is not None and not declared:
+                    yield Finding(
+                        rule="knob-taint",
+                        path=job_path,
+                        line=at,
+                        message=f"knob {name!r} joins spec_signature "
+                        f"without declaring the surface",
+                        hint="declare spec_signature in its KNOB_TABLE "
+                        "row — undeclared signature members split the "
+                        "compile cache silently",
+                    )
+        prov_fn = job_defs.get("serve_provenance")
+        if prov_fn is not None:
+            _, lits, kwargs = _fn_scan(prov_fn)
+            for name in rows:
+                at = lits.get(name) or kwargs.get(name)
+                if at is not None and "provenance" not in surf(name):
+                    yield Finding(
+                        rule="knob-taint",
+                        path=job_path,
+                        line=at,
+                        message=f"serve_provenance special-cases knob "
+                        f"{name!r}, whose row excludes the provenance "
+                        f"surface",
+                        hint="surface membership is declared in "
+                        "runtime/knobs.py — serve_provenance iterates "
+                        "the registry, it does not hand-roll knob "
+                        "exclusions",
+                    )
+        cd = literal_assign(job_tree, "CONFIG_DEFAULTS")
+        declared_jc = {n for n in rows if "job_config" in surf(n)}
+        if isinstance(cd, dict):
+            extra = set(cd) - declared_jc
+            missing = declared_jc - set(cd)
+            for name in sorted(extra):
+                yield Finding(
+                    rule="knob-taint",
+                    path=job_path,
+                    line=1,
+                    message=f"CONFIG_DEFAULTS carries {name!r}, which "
+                    f"does not declare the job_config surface",
+                    hint="declare job_config in its KNOB_TABLE row (or "
+                    "drop the key)",
+                )
+            for name in sorted(missing):
+                yield Finding(
+                    rule="knob-taint",
+                    path=job_path,
+                    line=1,
+                    message=f"knob {name!r} declares job_config but "
+                    f"CONFIG_DEFAULTS lacks the key",
+                    hint="derive CONFIG_DEFAULTS from the registry "
+                    "(knobs.job_config_defaults()) so the two cannot "
+                    "drift",
+                )
+        elif "CONFIG_DEFAULTS" in corpus.sources[job_path] and not (
+            _imports_knobs(job_tree)
+        ):
+            yield Finding(
+                rule="knob-taint",
+                path=job_path,
+                line=1,
+                message="CONFIG_DEFAULTS is neither a literal dict nor "
+                "derived from the knob registry",
+                hint="derive it with knobs.job_config_defaults() — the "
+                "registry is the single declaration",
+            )
+
+    # (f) the CLI's closed world
+    cli_path = corpus.find("cli/main.py")
+    if cli_path is not None:
+        cli_tree = corpus.trees[cli_path]
+        for node in ast.walk(cli_tree):
+            if not (isinstance(node, ast.Call) and call_name(node) == "opt"):
+                continue
+            if not node.args:
+                continue
+            lit = str_const(node.args[0])
+            if lit is not None and lit not in table:
+                yield Finding(
+                    rule="knob-taint",
+                    path=cli_path,
+                    line=node.lineno,
+                    message=f"opt({lit!r}) resolves an undeclared knob",
+                    hint="add a KNOB_TABLE row in runtime/knobs.py — "
+                    "adding a knob IS editing the registry; the linter "
+                    "enforces the rest",
+                )
+        streaming_only = [
+            n for n in rows if "streaming_only" in surf(n)
+        ]
+        if streaming_only and not _imports_knobs(cli_tree):
+            yield Finding(
+                rule="knob-taint",
+                path=cli_path,
+                line=1,
+                message="streaming-only knobs are declared but "
+                "cli/main.py does not resolve refusals through the "
+                "registry",
+                hint="route the whole-file refusals through "
+                "knobs.streaming_only_keys() — hand-copied refusal "
+                "blocks are how --trace got silently dropped once",
+            )
+
+    # (g) coverage pin: scheduling job knobs must appear in the linted
+    # test anchors (the byte-identity matrix is the proof they are
+    # byte-neutral)
+    test_paths = [p for p in corpus.trees if p.startswith("tests/")]
+    if test_paths:
+        exercised: set[str] = set()
+        for p in test_paths:
+            for fn_node in [corpus.trees[p]]:
+                names, lits, kwargs = _fn_scan(fn_node)
+                exercised |= set(names) | set(lits) | set(kwargs)
+        for name in sorted(
+            n for n in rows
+            if rows[n]["class"] == "scheduling" and "job_config" in surf(n)
+        ):
+            flag = str(rows[name].get("flag", ""))
+            if name in exercised or (flag and flag in exercised):
+                continue
+            yield Finding(
+                rule="knob-taint",
+                path=knobs_path,
+                line=table_line,
+                message=f"scheduling knob {name!r} has no byte-identity "
+                f"exercise in the linted test anchors",
+                hint="add it to the byte-identity matrix (tests/"
+                "test_knobs.py SCHEDULING_MATRIX) — an unexercised "
+                "scheduling knob's byte-neutrality is an unproved claim",
+            )
 
 
 # ------------------------------------------- rule: kernel-cost-registry

@@ -18,6 +18,8 @@ import argparse
 import json
 import sys
 
+from duplexumiconsensusreads_tpu.runtime import knobs
+
 CONFIG_PRESETS = {
     # 1. single-strand consensus, exact grouping (small amplicon)
     "config1": dict(grouping="exact", mode="ss", error_model="none"),
@@ -584,16 +586,10 @@ def _load_config_file(path: str) -> dict:
     else:
         with open(path) as f:
             conf = json.load(f)
-    allowed = {
-        "backend", "grouping", "mode", "error_model", "max_hamming",
-        "min_reads", "min_duplex_reads", "max_qual", "max_input_qual",
-        "min_input_qual", "capacity", "devices", "mesh", "cycle_shards",
-        "chunk_reads", "max_inflight", "drain_workers", "packed",
-        "prefetch_depth", "ingest_overlap", "bucket_ladder", "config",
-        "mate_aware", "max_reads",
-        "per_base_tags", "read_group_id", "write_index", "count_ratio",
-        "ref_projected", "umi_whitelist", "umi_max_mismatches",
-    }
+    # exactly the declared knobs (runtime/knobs.py): every execution
+    # knob is file-settable; run-control flags (--resume, --trace, …)
+    # are not knobs and not file keys
+    allowed = set(knobs.config_file_keys())
     unknown = set(conf) - allowed
     if unknown:
         raise SystemExit(
@@ -601,6 +597,39 @@ def _load_config_file(path: str) -> dict:
             f"(allowed: {sorted(allowed)})"
         )
     return conf
+
+
+def _refuse_streaming_only(args, resolved: dict) -> None:
+    """The whole-file path's refuse-don't-drop gate, table-driven: a
+    knob declaring the ``streaming_only`` surface in runtime/knobs.py
+    is refused when chunking is off — by its RESOLVED value, so a
+    config-file key is refused exactly like the flag, never silently
+    dropped. Grouped knobs share one message naming all their flags
+    (the wire-diet trio); ``refuse_alone`` knobs each carry their own
+    note (--mesh points at --devices)."""
+    grouped_flags = []
+    grouped_hit = False
+    for name in knobs.streaming_only_keys():
+        k = knobs.KNOBS[name]
+        if k.refuse_alone:
+            continue
+        grouped_flags.append(k.flag)
+        if getattr(args, name) is not None or resolved[name] != k.default:
+            grouped_hit = True
+    if grouped_hit:
+        raise SystemExit(
+            "/".join(grouped_flags)
+            + " require the streaming executor (--chunk-reads N)"
+        )
+    for name in knobs.streaming_only_keys():
+        k = knobs.KNOBS[name]
+        if not k.refuse_alone:
+            continue
+        if getattr(args, name) is not None or resolved[name] != k.default:
+            raise SystemExit(
+                f"{k.flag} requires the streaming executor "
+                f"(--chunk-reads N){k.refuse_note}"
+            )
 
 
 def _load_whitelist_or_exit(path: str):
@@ -971,41 +1000,20 @@ def _cmd_call(args) -> int:
         raise SystemExit(
             "--trace requires the streaming executor (--chunk-reads N)"
         )
-    if chunk_reads <= 0 and (
-        args.packed is not None or args.prefetch_depth is not None
-        or args.ingest_overlap is not None
-        or packed != "auto" or prefetch_depth != 2
-        or ingest_overlap != "auto"
-    ):
-        # only the streaming executor carries the wire-diet knobs; on
-        # the whole-file path they would be silently inert (a --submit
-        # job always streams, so the keys rode into its config above).
-        # The resolved values are checked too: a config-file
-        # packed/prefetch_depth/ingest_overlap must be refused exactly
-        # like the flag, not silently dropped
-        raise SystemExit(
-            "--packed/--prefetch-depth/--ingest-overlap require the "
-            "streaming executor (--chunk-reads N)"
-        )
-    if chunk_reads <= 0 and (args.mesh is not None or mesh != "auto"):
-        # the mesh knob steers the STREAMING dispatch path (per-device
-        # H2D lanes, per-shard D2H compaction); the whole-file executor
-        # has its own --devices — refuse-don't-drop, like --packed, and
-        # like there the RESOLVED value covers config-file keys
-        raise SystemExit(
-            "--mesh requires the streaming executor (--chunk-reads N); "
-            "whole-file runs size the mesh with --devices"
-        )
-    if chunk_reads <= 0 and (
-        args.bucket_ladder is not None or ladder_norm != "off"
-    ):
-        # the ladder is a streaming-bucketer concern; a whole-file run
-        # would silently ignore it (refuse-don't-drop, like --packed —
-        # and like there, the RESOLVED value covers config-file keys)
-        raise SystemExit(
-            "--bucket-ladder requires the streaming executor "
-            "(--chunk-reads N)"
-        )
+    if chunk_reads <= 0:
+        # only the streaming executor carries the streaming_only knobs;
+        # on the whole-file path they would be silently inert (a
+        # --submit job always streams, so the keys rode into its config
+        # above) — one registry-driven gate replaces the per-knob
+        # copies, bucket_ladder refused by its NORMALISED value so a
+        # cosmetic "OFF" cannot slip past
+        _refuse_streaming_only(args, {
+            "packed": packed,
+            "prefetch_depth": prefetch_depth,
+            "ingest_overlap": ingest_overlap,
+            "mesh": mesh,
+            "bucket_ladder": ladder_norm,
+        })
     if args.heartbeat:
         if args.heartbeat < 0:
             raise SystemExit(

@@ -146,7 +146,10 @@ def write_report(rep: "RunReport", path: str) -> None:
 # Transfer-pool size for the streaming executor (runtime/stream.py
 # builds its ThreadPoolExecutor from this, and the busy-wall canary
 # thresholds below must agree with the real pool — one constant, no
-# cross-module drift).
+# cross-module drift). The pool's threads run under the `xfer` row of
+# THREAD_ROLES (runtime/knobs.py): device grant only — the helpers in
+# this module that move durable state (write_report via its allowlist
+# entry aside) are called from the main/drain lanes, never from xfer.
 XFER_WORKERS = 4
 DRAIN_PHASES = ("device_wait_fetch", "scatter", "deflate", "shard_write")
 # rep.seconds entries that are not per-stage busy seconds

@@ -861,7 +861,15 @@ def _fingerprint(
     the resolved boolean: resolution is a deterministic function of the
     fingerprinted input file, and fingerprinting the setting lets the
     manifest be initialised before any input byte is read (the
-    stale-manifest-clearing guarantee)."""
+    stale-manifest-clearing guarantee).
+
+    This signature IS the checkpoint-fingerprint surface that
+    `runtime/knobs.py` declares per knob: dutlint's knob-taint rule
+    reads KNOB_TABLE and checks every parameter/literal here against
+    each knob's declared surfaces — a scheduling knob (max_inflight,
+    drain_workers, ...) added to this key would make resumability
+    depend on scheduling and is a lint finding; a semantic knob
+    REMOVED from it is one too."""
     st = os.stat(in_path)
     key = json.dumps(
         [
@@ -1289,7 +1297,10 @@ def _stream_call(
 
     # XFER_WORKERS transfer workers pipeline the tunnel's per-put RPC
     # gaps (measured r3: 1 worker 17.7k reads/s, 2 -> 19.6k, 4 -> ~21k
-    # on the 2M-read e2e); device_put releases the GIL on the wire wait
+    # on the 2M-read e2e); device_put releases the GIL on the wire wait.
+    # The dut-* prefixes below must stay STRING LITERALS: they are the
+    # THREAD_ROLES markers (runtime/knobs.py) that dutlint's
+    # thread-confinement rule and test_knobs' closed-world pin key on.
     xfer = ThreadPoolExecutor(
         max_workers=XFER_WORKERS, thread_name_prefix="dut-xfer"
     )

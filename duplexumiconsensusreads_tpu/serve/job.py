@@ -16,46 +16,18 @@ from __future__ import annotations
 
 import dataclasses
 
+from duplexumiconsensusreads_tpu.runtime import knobs
 from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
 
 # config keys a job may carry, with the SAME defaults as cli/main.py's
 # opt() resolution — a job submitted with an empty config must run the
-# identical workload as a bare `call --chunk-reads` would
-CONFIG_DEFAULTS = {
-    "grouping": "exact",
-    "mode": "ss",
-    "error_model": "none",
-    "max_hamming": 1,
-    "count_ratio": 2,
-    "min_reads": 1,
-    "min_duplex_reads": 1,
-    "max_qual": 90,
-    "max_input_qual": 50,
-    "min_input_qual": 0,
-    "capacity": 2048,
-    "chunk_reads": 500_000,
-    "max_inflight": 4,
-    "drain_workers": 2,
-    "packed": "auto",
-    "prefetch_depth": 2,
-    "ingest_overlap": "auto",
-    "bucket_ladder": "off",
-    "mesh": "auto",
-    "mate_aware": "auto",
-    "max_reads": 0,
-    "per_base_tags": False,
-    "read_group_id": "A",
-    "write_index": False,
-}
+# identical workload as a bare `call --chunk-reads` would. Derived from
+# the knob registry (runtime/knobs.py): the job_config surface IS the
+# declaration, so job.py and main.py cannot drift. Table order is the
+# canonical @PG CL flag order serve_provenance emits.
+CONFIG_DEFAULTS = knobs.job_config_defaults()
 
-_CHOICES = {
-    "grouping": {"exact", "adjacency", "cluster"},
-    "mode": {"ss", "duplex"},
-    "error_model": {"none", "cycle"},
-    "mate_aware": {"auto", "on", "off"},
-    "packed": {"auto", "byte", "off"},
-    "ingest_overlap": {"auto", "on", "off"},
-}
+_CHOICES = knobs.job_choice_map()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,8 +105,7 @@ def validate_spec(d: dict) -> JobSpec:
             "jobs run on the streaming executor: config chunk_reads "
             f"must be an int >= 1 (got {merged['chunk_reads']!r})"
         )
-    for key in ("capacity", "drain_workers", "max_inflight",
-                "prefetch_depth"):
+    for key in knobs.job_min_int_keys():
         if not isinstance(merged[key], int) or merged[key] < 1:
             raise ValueError(f"config {key} must be an int >= 1")
     mesh = merged["mesh"]
@@ -304,31 +275,13 @@ def serve_provenance(config: dict) -> str:
         val = merged[key]
         if val == default:
             continue
-        if key == "mesh":
-            # device count provably cannot change output bytes (the
-            # mesh byte-identity contract: chunk order is commit order
-            # and pad buckets emit nothing), and the daemon may resolve
-            # it against ITS device pool — embedding it in the @PG CL
-            # would make job bytes depend on serving topology, breaking
-            # bytes == f(input, config). Excluded like bucket_ladder.
-            continue
-        if key == "ingest_overlap":
-            # the producer pipeline is a SCHEDULING knob that provably
-            # cannot change output bytes (the producer emits in chunk
-            # order, so the consumer sees the sync path's exact
-            # sequence) — embedding it in the @PG CL would make job
-            # bytes depend on how a daemon chose to overlap its host
-            # work. Excluded like mesh, for the same reason.
-            continue
-        if key == "bucket_ladder":
-            # the ladder is a SHAPE knob that provably cannot change
-            # output bytes (the executors' final sort makes bytes a
-            # pure function of the read set), and the serve layer may
-            # override it per slice from a tuner verdict — embedding it
-            # in the @PG CL would make job bytes depend on the tuner's
-            # state, breaking bytes == f(input, config). Excluded like
-            # the daemon's argv, for the same reason. (It is also the
-            # only list-capable config key, so every value below is a
+        if "provenance" not in knobs.KNOBS[key].surfaces:
+            # surface membership is DECLARED, not hand-rolled here:
+            # mesh / ingest_overlap / bucket_ladder carry their
+            # why-excluded rationale on their KNOB_TABLE rows in
+            # runtime/knobs.py, and the knob-taint rule holds this
+            # loop to the declaration. (bucket_ladder is the only
+            # list-capable config key, so every value below is a
             # scalar.)
             continue
         flag = "--" + key.replace("_", "-")

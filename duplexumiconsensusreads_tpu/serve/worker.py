@@ -17,6 +17,15 @@ is durable, so a preempted slice leaves exactly the state a resumed
 slice needs and nothing else. Fault-site scoping: a job carrying a
 ``chaos`` schedule gets its own FaultPlan installed for its slices only
 (counters live across the job's slices, not across jobs).
+
+Thread model: this code runs on the daemon's ``dut-serve`` worker
+thread — the ``serve-worker`` row of THREAD_ROLES in
+``runtime/knobs.py``, which grants it all three effects (device,
+durable, journal) because a slice IS a full streaming run plus its
+lease bookkeeping. Job-config resolution is registry-driven too: the
+defaults/choices the slices run under come from the same KNOB_TABLE
+(via serve/job.py), so a knob edit lands here without touching this
+file.
 """
 
 from __future__ import annotations

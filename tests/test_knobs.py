@@ -1,0 +1,278 @@
+"""Registry-pin tests for the knob/thread declarations
+(runtime/knobs.py) — the single source dutlint's knob-taint and
+thread-confinement rules model-check the tree against.
+
+Three kinds of pin:
+
+- table pins: the registry's defaults/choices/surfaces match what the
+  CLI and the serve layer actually ship (a registry edit that would
+  change resolved behaviour fails HERE, before the linter even runs);
+- closed-world pins: every ``call`` flag on the real argparse parser
+  maps to a declared knob (or an explicitly exempt run-control flag),
+  and every thread the tree starts maps to a declared THREAD_ROLES
+  row;
+- the byte-identity matrix (``SCHEDULING_MATRIX``): each scheduling
+  job knob names the test proving it is byte-neutral — dutlint's
+  knob-taint coverage leg reads this file, so dropping a knob from the
+  matrix (or declaring a new scheduling knob without an exercise) is a
+  lint failure, TRANSITIONS-style.
+
+This file is a dutlint TEST_ANCHOR: it is linted like the package.
+"""
+
+import ast
+import os
+
+import pytest
+
+from duplexumiconsensusreads_tpu.io import simulated_bam
+from duplexumiconsensusreads_tpu.runtime import knobs
+from duplexumiconsensusreads_tpu.simulate import SimConfig
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "duplexumiconsensusreads_tpu")
+
+# the byte-identity matrix: scheduling job knob -> the test proving a
+# value change cannot change output bytes. dutlint's knob-taint
+# coverage leg requires every scheduling job_config knob to appear
+# here (the keys are the exercise evidence); test_matrix_targets_exist
+# keeps the values honest.
+SCHEDULING_MATRIX = {
+    "max_inflight": "tests/test_knobs.py::test_max_inflight_ab_byte_identical",
+    "drain_workers": "tests/test_stream.py::test_drain_workers_ab_byte_identical",
+    "packed": "tests/test_stream.py::TestWireDietMatrix",
+    "prefetch_depth": "tests/test_stream.py::TestWireDietMatrix",
+    "ingest_overlap": "tests/test_stream.py::TestIngestOverlap",
+    "mesh": "tests/test_mesh.py::test_cli_mesh_flag_streams_byte_identical",
+    "bucket_ladder": "tests/test_tuning.py::TestLadderMatrix",
+}
+
+# `call` parser dests that are deliberately NOT knobs: run-control and
+# service-client plumbing (paths, handles, liveness) — they steer THE
+# RUN, not the result function, and are refused on --submit where they
+# would be silently dropped
+RUN_CONTROL_DESTS = {
+    "cmd", "help", "input", "output", "index",
+    "checkpoint", "resume", "report", "profile", "trace", "heartbeat",
+    "chaos", "n_hosts", "host_id",
+    "submit", "spool", "priority", "status", "wait", "wait_timeout",
+    "json", "deadline", "shards", "shard_bytes", "config_file",
+}
+
+
+def _call_parser_dests():
+    from duplexumiconsensusreads_tpu.cli.main import build_parser
+
+    p = build_parser()
+    sub = next(
+        a for a in p._actions
+        if getattr(a, "choices", None) and "call" in a.choices
+    )
+    call = sub.choices["call"]
+    return {a.dest for a in call._actions}
+
+
+class TestKnobTable:
+    def test_classes_and_surfaces_are_closed(self):
+        for name, k in knobs.KNOBS.items():
+            assert k.knob_class in ("semantic", "scheduling"), name
+            assert set(k.surfaces) <= set(knobs.SURFACES), name
+
+    def test_job_defaults_pin(self):
+        """The resolved job defaults, pinned literally: an empty-config
+        job must run the identical workload as a bare
+        `call --chunk-reads` — editing a KNOB_TABLE default is a
+        behaviour change and must fail here, not ship silently."""
+        assert knobs.job_config_defaults() == {
+            "grouping": "exact", "mode": "ss", "error_model": "none",
+            "max_hamming": 1, "count_ratio": 2, "min_reads": 1,
+            "min_duplex_reads": 1, "max_qual": 90, "max_input_qual": 50,
+            "min_input_qual": 0, "capacity": 2048,
+            "chunk_reads": 500_000, "max_inflight": 4,
+            "drain_workers": 2, "packed": "auto", "prefetch_depth": 2,
+            "ingest_overlap": "auto", "mesh": "auto",
+            "bucket_ladder": "off", "mate_aware": "auto", "max_reads": 0,
+            "per_base_tags": False, "read_group_id": "A",
+            "write_index": False,
+        }
+
+    def test_job_choices_pin(self):
+        assert knobs.job_choice_map() == {
+            "grouping": {"exact", "adjacency", "cluster"},
+            "mode": {"ss", "duplex"},
+            "error_model": {"none", "cycle"},
+            "mate_aware": {"auto", "on", "off"},
+            "packed": {"auto", "byte", "off"},
+            "ingest_overlap": {"auto", "on", "off"},
+        }
+
+    def test_serve_layer_is_registry_derived(self):
+        from duplexumiconsensusreads_tpu.serve import job
+
+        assert job.CONFIG_DEFAULTS == knobs.job_config_defaults()
+        assert list(job.CONFIG_DEFAULTS) == list(knobs.job_config_defaults())
+        assert job._CHOICES == knobs.job_choice_map()
+        assert set(knobs.job_min_int_keys()) == {
+            "capacity", "max_inflight", "drain_workers", "prefetch_depth",
+        }
+
+    def test_streaming_only_set_pin(self):
+        assert knobs.streaming_only_keys() == (
+            "packed", "prefetch_depth", "ingest_overlap", "mesh",
+            "bucket_ladder",
+        )
+
+    def test_every_cli_flag_maps_to_a_declared_knob(self):
+        """The closed world: a new `call` flag is either a KNOB_TABLE
+        row or an explicit RUN_CONTROL_DESTS entry — never a third
+        thing that slips both the registry and the linter."""
+        dests = _call_parser_dests()
+        knob_dests = dests - RUN_CONTROL_DESTS
+        undeclared = knob_dests - set(knobs.KNOBS)
+        assert not undeclared, (
+            f"parser flags without a KNOB_TABLE row: {sorted(undeclared)}"
+        )
+        # and the registry carries no phantom CLI rows: every declared
+        # knob resolves from the parser (config-file keys included —
+        # they share the dest namespace)
+        phantom = {
+            n for n in knobs.KNOBS if n not in dests
+        }
+        assert not phantom, (
+            f"KNOB_TABLE rows with no parser flag: {sorted(phantom)}"
+        )
+
+    def test_config_file_keys_are_exactly_the_knobs(self):
+        assert knobs.config_file_keys() == frozenset(knobs.KNOBS)
+
+
+def _thread_name_literals():
+    """(path, name-or-prefix) for every thread the package starts:
+    threading.Thread(name=...) and ThreadPoolExecutor
+    thread_name_prefix=... literals/f-string prefixes."""
+    found = []
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = node.func
+                cname = (
+                    callee.attr if isinstance(callee, ast.Attribute)
+                    else callee.id if isinstance(callee, ast.Name) else ""
+                )
+                if cname not in ("Thread", "ThreadPoolExecutor"):
+                    continue
+                for kw in node.keywords or ():
+                    if kw.arg not in ("name", "thread_name_prefix"):
+                        continue
+                    v = kw.value
+                    if isinstance(v, ast.Constant) and isinstance(
+                        v.value, str
+                    ):
+                        found.append((os.path.relpath(path, REPO), v.value))
+                    elif isinstance(v, ast.JoinedStr) and v.values:
+                        head = v.values[0]
+                        if isinstance(head, ast.Constant):
+                            found.append(
+                                (os.path.relpath(path, REPO),
+                                 str(head.value))
+                            )
+    return found
+
+
+class TestThreadRoles:
+    def test_every_started_thread_maps_to_a_declared_role(self):
+        """Closed world for threads: a Thread/pool the tree starts
+        carries a name, and that name is a declared THREAD_ROLES
+        marker — a new thread without a registry row fails here even
+        before the confinement rule has an entry to walk. The bench
+        harness is exempt: its threads drive the system under test,
+        they are not part of it."""
+        markers = sorted(
+            (str(row.get("marker", "")) for row in
+             knobs.THREAD_ROLES.values() if row.get("marker")),
+            key=len, reverse=True,
+        )
+        assert markers
+        for path, name in _thread_name_literals():
+            if os.path.basename(path) == "benchmark.py":
+                continue
+            assert any(name.startswith(m) for m in markers), (
+                f"{path}: thread name {name!r} matches no THREAD_ROLES "
+                f"marker — declare the role in runtime/knobs.py"
+            )
+
+    def test_declared_entries_exist(self):
+        for role, row in knobs.THREAD_ROLES.items():
+            entry = str(row["entry"])
+            if not entry:
+                continue
+            mod = os.path.join(PKG, *str(row["module"]).split("/"))
+            with open(mod) as f:
+                tree = ast.parse(f.read())
+            names = {
+                n.name for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            assert entry in names, (
+                f"THREAD_ROLES[{role!r}] entry {entry}() not found in "
+                f"{row['module']}"
+            )
+
+
+class TestSchedulingMatrix:
+    def test_every_scheduling_job_knob_is_in_the_matrix(self):
+        declared = {
+            n for n, k in knobs.KNOBS.items()
+            if k.knob_class == "scheduling" and "job_config" in k.surfaces
+        }
+        assert declared == set(SCHEDULING_MATRIX)
+
+    def test_matrix_targets_exist(self):
+        for knob_name, target in SCHEDULING_MATRIX.items():
+            rel, _, obj = target.partition("::")
+            path = os.path.join(REPO, rel)
+            assert os.path.exists(path), target
+            with open(path) as f:
+                tree = ast.parse(f.read())
+            names = {
+                n.name for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.ClassDef))
+            }
+            assert obj.split("::")[0] in names, (
+                f"{knob_name}: {target} names no test in {rel}"
+            )
+
+
+def test_max_inflight_ab_byte_identical(tmp_path):
+    """The missing rung of the byte-identity matrix: the in-flight
+    window depth is a scheduling knob (it bounds how many chunks the
+    dispatch pipeline overlaps), so a serial window (1) and a wide one
+    must produce byte-identical output."""
+    from duplexumiconsensusreads_tpu.runtime.stream import (
+        stream_call_consensus,
+    )
+
+    path = str(tmp_path / "in.bam")
+    cfg = SimConfig(n_molecules=80, n_positions=8, umi_error=0.02, seed=29)
+    simulated_bam(cfg, path=path, sort=True)
+    gp = GroupingParams(strategy="adjacency", paired=True)
+    cp = ConsensusParams(mode="duplex")
+    outs = {}
+    for n in (1, 4):
+        out = str(tmp_path / f"mi{n}.bam")
+        stream_call_consensus(
+            path, out, gp, cp, capacity=256, chunk_reads=120,
+            max_inflight=n,
+        )
+        with open(out, "rb") as f:
+            outs[n] = f.read()
+    assert outs[1] == outs[4]

@@ -47,10 +47,16 @@ class TestEngine:
             "lease-discipline", "deadline-discipline", "host-locality",
             # the protocol model-checker passes
             "state-machine", "txn-discipline", "fence-dominance",
-            "exception-contract", "ingest-confinement",
+            "exception-contract",
+            # the declared thread model (subsumes PR 17's
+            # ingest-confinement as the producer row)
+            "thread-confinement",
             # the device ledger's FLOP-cost registry closure
             "kernel-cost-registry",
+            # the knob registry's determinism-surface model-check
+            "knob-taint",
         } <= set(RULES)
+        assert "ingest-confinement" not in RULES
         for rule in RULES.values():
             assert rule.title
 
@@ -1695,12 +1701,40 @@ class TestExceptionContract:
         assert res.ok  # scope is runtime/ + serve/ only
 
 
-class TestIngestConfinement:
+class TestThreadConfinement:
+    # a miniature declared thread model: the main loop owns the
+    # consumer structures, the producer row mirrors PR 17's contract
+    KNOBS_ROLES = """
+        THREAD_ROLES = {
+            "main": {
+                "module": "runtime/stream.py",
+                "entry": "",
+                "marker": "",
+                "may": ("device", "durable", "journal"),
+                "shared": (
+                    ("inflight", ""), ("done_q", ""),
+                    ("prefetch_sem", ""), ("ckpt", ""),
+                ),
+            },
+            "ingest": {
+                "module": "runtime/stream.py",
+                "entry": "_ingest_producer",
+                "marker": "dut-ingest",
+                "may": (),
+                "handoff": "ingest_q",
+                "shared": (
+                    ("ingest_q", ""),
+                    ("phase", "phase_lock"),
+                ),
+            },
+        }
+        """
+
     # a confined producer: pure host prep handed off through the
-    # bounded queue only, consumer structures untouched
+    # bounded queue only, declared shared state under its declared lock
     STREAM_OK = """
         import queue as _queue
-        def _stream_call(chunk_iter, prefetch_depth):
+        def _stream_call(chunk_iter, prefetch_depth, phase, phase_lock):
             ingest_q = _queue.Queue(maxsize=prefetch_depth)
             def _prep_chunk(k, batch):
                 return [batch]
@@ -1710,46 +1744,72 @@ class TestIngestConfinement:
                 for k, item in enumerate(chunk_iter):
                     prep = _prep_chunk(k, item)
                     _q_put(("item", (k, item, prep)))
+                with phase_lock:
+                    phase["producer"] = "done"
                 _q_put(("done", None))
         """
 
-    def base(self, src=STREAM_OK):
-        return lint(
-            {"pkg/runtime/stream.py": src}, rules=["ingest-confinement"]
-        )
+    def base(self, src=STREAM_OK, roles=KNOBS_ROLES):
+        files = {"pkg/runtime/stream.py": src}
+        if roles is not None:
+            files["pkg/runtime/knobs.py"] = roles
+        return lint(files, rules=["thread-confinement"])
 
     def test_passes_on_a_confined_producer(self):
         assert self.base().ok
 
-    def test_passes_when_no_overlap_machinery_exists(self):
-        # pre-overlap corpora (the other fixture corpora here) owe
-        # nothing to this rule
-        assert self.base("def _stream_call():\n    pass\n").ok
+    def test_passes_on_a_pre_registry_corpus(self):
+        # corpora predating the thread model (the other fixture corpora
+        # here) owe nothing to this rule
+        assert self.base(roles=None).ok
 
-    def test_fires_on_device_call_from_producer(self):
+    def test_fires_on_device_call_without_the_grant(self):
         res = self.base(self.STREAM_OK.replace(
             "return [batch]", "return device_put(batch)"
         ))
         assert not res.ok
-        assert any("device" in f.message for f in res.findings)
+        assert any("'device' grant" in f.message for f in res.findings)
 
-    def test_fires_on_checkpoint_mark_from_producer(self):
+    def test_fires_on_durable_write_without_the_grant(self):
+        # the acceptance case: a producer-thread checkpoint mark
         res = self.base(self.STREAM_OK.replace(
             "return [batch]", "ckpt.mark(k)\n                return [batch]"
         ))
         assert not res.ok
-        assert any("durable" in f.message or "ckpt" in f.message
+        assert any("'durable' grant" in f.message for f in res.findings)
+        # and ckpt itself is another role's structure
+        assert any("ckpt" in f.message and "not declared" in f.message
                    for f in res.findings)
 
-    def test_fires_on_consumer_structure_reference(self):
+    def test_fires_on_journal_txn_without_the_grant(self):
+        res = self.base(self.STREAM_OK.replace(
+            "return [batch]", "_txn(k)\n                return [batch]"
+        ))
+        assert not res.ok
+        assert any("'journal' grant" in f.message for f in res.findings)
+
+    def test_fires_on_undeclared_shared_structure(self):
         res = self.base(self.STREAM_OK.replace(
             "_q_put((\"done\", None))",
             "prefetch_sem.release()",
         ))
         assert rules_of(res) == [
-            ("ingest-confinement", "pkg/runtime/stream.py")
+            ("thread-confinement", "pkg/runtime/stream.py")
         ]
         assert "prefetch_sem" in res.findings[0].message
+
+    def test_fires_on_declared_structure_outside_its_lock(self):
+        res = self.base(self.STREAM_OK.replace(
+            "                with phase_lock:\n"
+            "                    phase[\"producer\"] = \"done\"",
+            "                phase[\"producer\"] = \"done\"",
+        ))
+        assert not res.ok
+        assert any(
+            "outside its declared lock" in f.message
+            and "phase_lock" in f.message
+            for f in res.findings
+        )
 
     def test_fires_on_put_to_a_foreign_queue(self):
         res = self.base(self.STREAM_OK.replace(
@@ -1760,9 +1820,9 @@ class TestIngestConfinement:
         assert any("handoff" in f.message or "handoff" in f.hint
                    for f in res.findings)
 
-    def test_fires_when_the_anchor_function_is_renamed_away(self):
-        # overlap machinery present (thread name literal) but no
-        # _ingest_producer: the rule must refuse to silently skip
+    def test_fires_when_the_entry_function_is_renamed_away(self):
+        # thread marker present but the declared entry is gone: the
+        # rule must refuse to silently skip
         res = self.base("""
             import threading
             def _stream_call():
@@ -1770,6 +1830,196 @@ class TestIngestConfinement:
             """)
         assert not res.ok
         assert "_ingest_producer" in res.findings[0].message
+
+    def test_fires_when_the_registry_is_deleted_but_referenced(self):
+        res = self.base(
+            "# confined per THREAD_ROLES\ndef _stream_call():\n    pass\n",
+            roles=None,
+        )
+        assert not res.ok
+        assert "THREAD_ROLES" in res.findings[0].message
+
+    def test_fires_on_an_unreadable_registry_literal(self):
+        res = self.base(roles="THREAD_ROLES = _build_roles()\n")
+        assert not res.ok
+        assert "readable literal" in res.findings[0].message
+
+
+# ------------------------------------------------------------ knob-taint
+
+KNOBS_TABLE_OK = """
+    SURFACES = (
+        "fingerprint", "spec_signature", "provenance", "job_config",
+        "streaming_only",
+    )
+    KNOB_TABLE = {
+        "capacity": {
+            "flag": "--capacity", "class": "semantic",
+            "surfaces": ("fingerprint", "spec_signature", "provenance",
+                         "job_config"),
+            "default": 2048,
+        },
+        "drain_workers": {
+            "flag": "--drain-workers", "class": "scheduling",
+            "surfaces": ("provenance", "job_config"),
+            "default": 2,
+        },
+        "packed": {
+            "flag": "--packed", "class": "scheduling",
+            "surfaces": ("job_config", "streaming_only"),
+            "default": "auto",
+        },
+    }
+"""
+
+FP_STREAM_OK = """
+    def _fingerprint(path, capacity):
+        return {"path": path, "capacity": capacity}
+"""
+
+JOB_OK = """
+    from pkg.runtime import knobs
+    CONFIG_DEFAULTS = {
+        "capacity": 2048, "drain_workers": 2, "packed": "auto",
+    }
+    def spec_signature(spec):
+        return "|".join(str(spec[k]) for k in ("capacity",))
+    def serve_provenance(config):
+        parts = []
+        for key, default in CONFIG_DEFAULTS.items():
+            if "provenance" not in knobs.KNOBS[key].surfaces:
+                continue
+            parts.append(key)
+        return " ".join(parts)
+"""
+
+CLI_OK = """
+    from pkg.runtime import knobs
+    def resolve(args, opt):
+        capacity = opt("capacity", 2048)
+        drain_workers = opt("drain_workers", 2)
+        packed = opt("packed", "auto")
+        return capacity, drain_workers, packed
+"""
+
+TESTS_OK = """
+    SCHEDULING_MATRIX = {
+        "drain_workers": "tests/test_stream.py::test_dw_ab",
+        "packed": "tests/test_stream.py::TestWireDietMatrix",
+    }
+"""
+
+
+class TestKnobTaint:
+    def base(self, **over):
+        files = {
+            "pkg/runtime/knobs.py": KNOBS_TABLE_OK,
+            "pkg/runtime/stream.py": FP_STREAM_OK,
+            "pkg/serve/job.py": JOB_OK,
+            "pkg/cli/main.py": CLI_OK,
+            "tests/test_knobs.py": TESTS_OK,
+        }
+        files.update(over)
+        files = {k: v for k, v in files.items() if v is not None}
+        return lint(files, rules=["knob-taint"])
+
+    def test_passes_when_surfaces_match_declarations(self):
+        assert self.base().ok
+
+    def test_passes_on_a_pre_registry_corpus(self):
+        res = lint(
+            {"pkg/runtime/stream.py": FP_STREAM_OK}, rules=["knob-taint"]
+        )
+        assert res.ok
+
+    def test_fires_on_scheduling_knob_in_the_fingerprint(self):
+        # the acceptance case: seeding a scheduling knob into the
+        # fingerprint dict is caught at the seeded line
+        res = self.base(**{"pkg/runtime/stream.py": """
+            def _fingerprint(path, capacity, drain_workers):
+                return {
+                    "path": path, "capacity": capacity,
+                    "drain_workers": drain_workers,
+                }
+            """})
+        assert not res.ok
+        assert any(
+            "taints the checkpoint fingerprint" in f.message
+            and "drain_workers" in f.message
+            for f in res.findings
+        )
+
+    def test_fires_on_declared_knob_missing_from_the_fingerprint(self):
+        res = self.base(**{"pkg/runtime/stream.py": """
+            def _fingerprint(path):
+                return {"path": path}
+            """})
+        assert not res.ok
+        assert any(
+            "never reaches _fingerprint" in f.message
+            and "capacity" in f.message
+            for f in res.findings
+        )
+
+    def test_fires_on_undeclared_opt_literal(self):
+        res = self.base(**{"pkg/cli/main.py": CLI_OK.replace(
+            'packed = opt("packed", "auto")',
+            'packed = opt("packed", "auto")\n'
+            '        turbo = opt("turbo_mode", 1)',
+        )})
+        assert not res.ok
+        assert any(
+            "opt('turbo_mode')" in f.message.replace('"', "'")
+            for f in res.findings
+        )
+
+    def test_fires_on_hand_rolled_provenance_exclusion(self):
+        res = self.base(**{"pkg/serve/job.py": JOB_OK.replace(
+            "parts.append(key)",
+            'if key == "packed":\n'
+            "                continue\n"
+            "            parts.append(key)",
+        )})
+        assert not res.ok
+        assert any(
+            "serve_provenance special-cases" in f.message
+            and "packed" in f.message
+            for f in res.findings
+        )
+
+    def test_fires_on_config_defaults_drift(self):
+        res = self.base(**{"pkg/serve/job.py": JOB_OK.replace(
+            '"capacity": 2048, "drain_workers": 2, "packed": "auto",',
+            '"capacity": 2048, "drain_workers": 2,',
+        )})
+        assert not res.ok
+        assert any(
+            "CONFIG_DEFAULTS lacks the key" in f.message
+            and "packed" in f.message
+            for f in res.findings
+        )
+
+    def test_fires_on_unexercised_scheduling_knob(self):
+        res = self.base(**{"tests/test_knobs.py": TESTS_OK.replace(
+            '"packed": "tests/test_stream.py::TestWireDietMatrix",', ""
+        )})
+        assert not res.ok
+        assert any(
+            "no byte-identity exercise" in f.message
+            and "packed" in f.message
+            for f in res.findings
+        )
+
+    def test_coverage_leg_skips_corpora_without_tests(self):
+        assert self.base(**{"tests/test_knobs.py": None}).ok
+
+    def test_fires_when_the_registry_is_deleted_but_referenced(self):
+        res = lint(
+            {"pkg/serve/job.py": "# derived from KNOB_TABLE\nX = 1\n"},
+            rules=["knob-taint"],
+        )
+        assert not res.ok
+        assert "KNOB_TABLE" in res.findings[0].message
 
 
 # ------------------------------------------------- kernel-cost-registry
@@ -1973,6 +2223,134 @@ class TestCli:
         assert p.returncode == 2
         assert "unknown rule" in p.stderr
 
+    def test_knob_taint_violation_exits_1_naming_rule_and_line(
+        self, tmp_path
+    ):
+        # the acceptance case end-to-end: seeding a scheduling knob
+        # into the fingerprint dict in a scratch corpus exits 1 and
+        # names rule + file:line
+        knobs_py = tmp_path / "pkg" / "runtime" / "knobs.py"
+        knobs_py.parent.mkdir(parents=True)
+        knobs_py.write_text(textwrap.dedent(KNOBS_TABLE_OK))
+        stream = tmp_path / "pkg" / "runtime" / "stream.py"
+        stream.write_text(textwrap.dedent("""
+            def _fingerprint(path, capacity, drain_workers):
+                return {
+                    "path": path, "capacity": capacity,
+                    "drain_workers": drain_workers,
+                }
+            """))
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+             "--root", str(tmp_path), "--rule", "knob-taint",
+             "pkg/runtime/knobs.py", "pkg/runtime/stream.py"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 1
+        assert "[knob-taint]" in p.stdout
+        assert "pkg/runtime/stream.py:" in p.stdout
+        assert "drain_workers" in p.stdout
+
+    def test_thread_confinement_violation_exits_1_naming_rule_and_line(
+        self, tmp_path
+    ):
+        # the acceptance case end-to-end: a producer-thread durable
+        # write in a scratch corpus exits 1 and names rule + file:line
+        knobs_py = tmp_path / "pkg" / "runtime" / "knobs.py"
+        knobs_py.parent.mkdir(parents=True)
+        knobs_py.write_text(
+            textwrap.dedent(TestThreadConfinement.KNOBS_ROLES)
+        )
+        stream = tmp_path / "pkg" / "runtime" / "stream.py"
+        stream.write_text(textwrap.dedent("""
+            def _stream_call(chunk_iter, ingest_q, ckpt):
+                def _ingest_producer():
+                    for k, item in enumerate(chunk_iter):
+                        ckpt.mark(k)
+                        ingest_q.put((k, item))
+            """))
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+             "--root", str(tmp_path), "--rule", "thread-confinement",
+             "pkg/runtime/knobs.py", "pkg/runtime/stream.py"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 1
+        assert "pkg/runtime/stream.py:5: [thread-confinement]" in p.stdout
+        assert "'durable' grant" in p.stdout
+
+    def _since_repo(self, tmp_path):
+        """A throwaway git repo whose default lint set holds one file."""
+        pkg = tmp_path / "duplexumiconsensusreads_tpu" / "runtime"
+        pkg.mkdir(parents=True)
+        hot = pkg / "hot.py"
+        hot.write_text("def f():\n    return 0\n")
+        env = {**os.environ,
+               "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+        for cmd in (["git", "init", "-q"],
+                    ["git", "add", "-A"],
+                    ["git", "commit", "-qm", "seed"]):
+            subprocess.run(cmd, cwd=tmp_path, env=env, check=True,
+                           capture_output=True, timeout=60)
+        return hot
+
+    def test_since_reports_only_changed_files(self, tmp_path):
+        hot = self._since_repo(tmp_path)
+        base = [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+                "--root", str(tmp_path)]
+        # clean worktree vs HEAD: nothing to report, even though the
+        # default-set run would flag nothing here anyway
+        p = subprocess.run(base + ["--since", "HEAD"],
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stdout + p.stderr
+        # introduce a violation in the worktree: --since HEAD sees it
+        hot.write_text("import time\ndef f():\n    return time.time()\n")
+        p = subprocess.run(base + ["--since", "HEAD"],
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 1
+        assert "hot.py:3: [clock-discipline]" in p.stdout
+
+    def test_since_hides_findings_in_unchanged_files(self, tmp_path):
+        # a COMMITTED violation with a clean worktree: the fast local
+        # loop reports nothing (that dirt is CI's whole-tree job)
+        hot = self._since_repo(tmp_path)
+        env = {**os.environ,
+               "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+        hot.write_text("import time\ndef f():\n    return time.time()\n")
+        for cmd in (["git", "add", "-A"],
+                    ["git", "commit", "-qm", "dirty"]):
+            subprocess.run(cmd, cwd=tmp_path, env=env, check=True,
+                           capture_output=True, timeout=60)
+        base = [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+                "--root", str(tmp_path)]
+        p = subprocess.run(base + ["--since", "HEAD"],
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stdout + p.stderr
+        # ... while the full default-set run still fails
+        p = subprocess.run(base, capture_output=True, text=True,
+                           timeout=120)
+        assert p.returncode == 1
+
+    def test_since_usage_errors(self, tmp_path):
+        self._since_repo(tmp_path)
+        base = [sys.executable, os.path.join(REPO, "tools", "dutlint.py"),
+                "--root", str(tmp_path)]
+        bad_rev = subprocess.run(
+            base + ["--since", "no-such-rev"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert bad_rev.returncode == 2
+        assert "not a resolvable git rev" in bad_rev.stderr
+        both = subprocess.run(
+            base + ["--since", "HEAD",
+                    "duplexumiconsensusreads_tpu/runtime/hot.py"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert both.returncode == 2
+        assert "mutually exclusive" in both.stderr
+
     def test_strict_fails_on_stale_allowlist_entries(self, tmp_path):
         # an empty root's default set suppresses nothing, so every real
         # allowlist entry is stale there: --strict turns the warning
@@ -1989,6 +2367,46 @@ class TestCli:
         )
         assert strict.returncode == 1
         assert "error: unused allowlist entry" in strict.stderr
+
+
+# ---------------------------------------------------------- AST cache
+
+class TestAstCache:
+    """Satellite: each corpus file parses ONCE per process (engine
+    _AST_CACHE keyed by path+mtime+size) — the lint suite and the CLI
+    load the same ~95-file corpus many times, and 16 rules never
+    re-parse at all (they share Corpus.trees)."""
+
+    def test_reloading_the_corpus_reparses_nothing(self):
+        from duplexumiconsensusreads_tpu.analysis.engine import (
+            CACHE_STATS, load_corpus,
+        )
+
+        rels = default_targets(REPO)
+        c1 = load_corpus(REPO, rels)  # warm (may hit or miss)
+        misses0 = CACHE_STATS["misses"]
+        hits0 = CACHE_STATS["hits"]
+        c2 = load_corpus(REPO, rels)
+        assert CACHE_STATS["misses"] == misses0  # zero new parses
+        assert CACHE_STATS["hits"] >= hits0 + len(c2.trees)
+        # the cached trees are SHARED objects, not re-parses
+        for p in list(c1.trees)[:5]:
+            assert c2.trees[p] is c1.trees[p]
+
+    def test_lint_suite_runtime_budget(self):
+        from duplexumiconsensusreads_tpu.analysis.engine import load_corpus
+        import time
+
+        rels = default_targets(REPO)
+        load_corpus(REPO, rels)  # warm the cache
+        t0 = time.monotonic()
+        for _ in range(3):
+            corpus = load_corpus(REPO, rels)
+            run_lint(corpus, ALLOWLIST)
+        dt = time.monotonic() - t0
+        # generous even for a loaded CI box: 3 full 16-rule passes over
+        # the whole corpus without the cache would re-parse ~285 files
+        assert dt < 30.0, f"3 warm lint passes took {dt:.1f}s"
 
 
 # ------------------------------------------------------------ CI gate script
@@ -2008,6 +2426,17 @@ class TestCiCheck:
         )
         assert p.returncode == 0, p.stdout + p.stderr
         assert "[ci_check] OK" in p.stderr
+
+    def test_readme_rule_table_matches_registry(self):
+        # the drift the gate's counting leg catches, pinned by NAME
+        # here: the documented table is exactly the registered rules
+        readme = open(os.path.join(REPO, "README.md")).read()
+        block = readme.split("<!-- dutlint-rule-table -->")[1].split(
+            "<!-- /dutlint-rule-table -->"
+        )[0]
+        rows = [ln for ln in block.splitlines() if ln.startswith("| `")]
+        names = {ln.split("`")[1] for ln in rows}
+        assert names == set(RULES)
 
     def test_fixture_capture_is_complete_and_pinned(self, tmp_path):
         # the committed capture must carry its terminal summary — and
@@ -2069,6 +2498,12 @@ class TestShippedTree:
             # the serving suite anchors the lease-discipline rule's
             # serve.*-site coverage check
             "tests/test_serve.py",
+            # the byte-identity matrix anchoring knob-taint's coverage
+            # leg (SCHEDULING_MATRIX)
+            "tests/test_knobs.py",
+            # the knob/thread registries both new rules read
+            os.path.join("duplexumiconsensusreads_tpu", "runtime",
+                         "knobs.py"),
             os.path.join("duplexumiconsensusreads_tpu", "runtime",
                          "stream.py"),
             os.path.join("duplexumiconsensusreads_tpu", "serve",

@@ -8,7 +8,11 @@
 #   1. dutlint --strict over the whole default set (package + tools/ +
 #      test anchors): every invariant rule active, zero non-allowlisted
 #      findings, AND zero stale allowlist entries — a suppression whose
-#      finding was fixed must be pruned in the same change.
+#      finding was fixed must be pruned in the same change. The JSON
+#      report is archived to bench_logs/dutlint.json, and the active
+#      rule count must match README.md's documented rule table
+#      (between the dutlint-rule-table markers) — adding a rule
+#      without documenting it is itself a gate failure.
 #   2. check_trace --require-summary over the committed fixture capture
 #      (tests/data/run.fixture.trace.jsonl): the telemetry schema
 #      validator itself must accept a known-good, COMPLETE capture —
@@ -35,7 +39,25 @@ root="$(cd "$(dirname "$0")/.." && pwd)"
 py="${PYTHON:-python}"
 
 echo "[ci_check] dutlint --strict (all rules, stale-allowlist fatal)" >&2
-"$py" "$root/tools/dutlint.py" --strict
+mkdir -p "$root/bench_logs"
+if ! "$py" "$root/tools/dutlint.py" --strict --json \
+        > "$root/bench_logs/dutlint.json"; then
+    cat "$root/bench_logs/dutlint.json" >&2
+    echo "[ci_check] dutlint --strict failed (report archived to" \
+         "bench_logs/dutlint.json)" >&2
+    exit 1
+fi
+
+echo "[ci_check] dutlint rule count vs README table" >&2
+n_rules="$("$py" "$root/tools/dutlint.py" --list-rules | grep -c .)"
+n_doc="$(sed -n '/<!-- dutlint-rule-table -->/,/<!-- \/dutlint-rule-table -->/p' \
+    "$root/README.md" | grep -c '^| `' || true)"
+if [ "$n_rules" != "$n_doc" ]; then
+    echo "[ci_check] rule-count drift: dutlint registers $n_rules" \
+         "rules but README.md's table documents $n_doc — update the" \
+         "table between the dutlint-rule-table markers" >&2
+    exit 1
+fi
 
 echo "[ci_check] check_trace --require-summary (fixture capture)" >&2
 "$py" "$root/tools/check_trace.py" \
