@@ -97,6 +97,11 @@ CANONICAL_METRICS = (
     # devices share the host's cores; judge scaling on real silicon)
     ("e2e_mesh_devices", False, False),
     ("e2e_mesh_scaling", True, False),
+    # live follow-mode (live/): first-snapshot latency and steady lag
+    # behind the paced synthetic writer — informational, never gated
+    # (both numbers follow the writer's slab cadence, not the pipeline)
+    ("live_first_snapshot_latency_s", False, False),
+    ("live_steady_lag_chunks", False, False),
 )
 
 _NUM = r"-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?"

@@ -36,6 +36,9 @@ DUT_BENCH_SERVE_READS (reads per serve job, default 120000),
 DUT_BENCH_SERVE_DAEMONS (serve_fleet leg: in-process daemons sharing
 one spool, daemon 0 killed mid-job to measure takeover latency and
 per-class queue-wait; default 2, <2 disables),
+DUT_BENCH_LIVE_READS (live_follow leg: reads in the paced growing-BAM
+follow run, default 120000; 0 disables) and DUT_BENCH_LIVE_SLAB_S (the
+synthetic writer's slab cadence, default 0.2),
 DUT_BENCH_TRACE (1: every e2e leg records a span capture next to the
 cache and the JSON carries per-chunk latency percentiles plus the
 byte-ledger wire model — measured floor frac and effective bandwidth;
@@ -89,6 +92,7 @@ COMPACT_KEYS = (
     "serve_shard_speedup", "serve_shard_merge_s",
     "serve_xhost_takeover_latency_s", "serve_xhost_recovered",
     "fleet_e2e_p95_s", "fleet_takeover_gap_s",
+    "live_first_snapshot_latency_s", "live_steady_lag_chunks",
 )
 
 
@@ -606,6 +610,109 @@ print(json.dumps({{"wall": time.monotonic() - t0, "reads": rep.n_records}}))
         ) if walls else 0.0,
         "serve_trace": trace_path,
     })
+    return out
+
+
+def run_live_follow_bench() -> dict:
+    """The ``live_follow`` leg (informational, non-gating): the
+    streaming executor tailing a BAM while a paced writer is still
+    appending it — the `sequencer-is-running` serving shape the live/
+    subsystem exists for.
+
+    Two canonical numbers:
+
+    - ``live_first_snapshot_latency_s``: wall from follower start to
+      the first published indexed snapshot — how long before a
+      downstream consumer can open SOMETHING valid;
+    - ``live_steady_lag_chunks``: mean follower lag behind the writer
+      over the second half of the run, in committed-chunk units — the
+      steady-state distance between the instrument and the consensus.
+
+    Non-gating on purpose: both numbers are paced by the synthetic
+    writer's slab cadence (DUT_BENCH_LIVE_SLAB_S), not by the pipeline
+    alone, so they are a serving-shape observation, not a regression
+    oracle. DUT_BENCH_LIVE_READS=0 disables the leg."""
+    import threading
+
+    from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+
+    cache = os.environ.get("DUT_BENCH_CACHE", ".bench_cache")
+    n_reads = int(os.environ.get("DUT_BENCH_LIVE_READS", 120_000))
+    src_path, _ = _e2e_input(n_reads)
+    with open(src_path, "rb") as f:
+        raw = f.read()
+    in_path = os.path.join(cache, "live_in.bam")
+    out_path = os.path.join(cache, "live_out.bam")
+    trace_path = None
+    if int(os.environ.get("DUT_BENCH_TRACE", 1)):
+        trace_path = os.path.join(cache, "live_trace.jsonl")
+    gp, cp = _e2e_params()
+    chunk_reads = max(n_reads // 8, 10_000)
+    n_slabs = 20
+    slab_s = float(os.environ.get("DUT_BENCH_LIVE_SLAB_S", 0.2))
+    slab = max(1, (len(raw) + n_slabs - 1) // n_slabs)
+    written = {"bytes": 0}
+
+    def writer():
+        with open(in_path, "wb") as f:
+            for off in range(0, len(raw), slab):
+                f.write(raw[off:off + slab])
+                f.flush()
+                written["bytes"] = off + len(raw[off:off + slab])
+                time.sleep(slab_s)
+
+    with open(in_path, "wb"):
+        pass  # the follower may open before the first slab lands
+    commits: list = []  # (chunks_done, writer_frac, t_since_start)
+    first_snap = [0.0]
+    t0 = time.monotonic()
+
+    def progress(_k, _rep):
+        now = time.monotonic() - t0
+        if _rep.snapshot_seq >= 1 and not first_snap[0]:
+            first_snap[0] = now
+        commits.append((len(commits) + 1, written["bytes"] / len(raw), now))
+
+    wt = threading.Thread(target=writer)
+    wt.start()
+    try:
+        rep = stream_call_consensus(
+            in_path, out_path, gp, cp,
+            capacity=int(os.environ.get("DUT_BENCH_CAPACITY", 2048)),
+            chunk_reads=chunk_reads,
+            follow=True, live_poll_s=0.05, snapshot_chunks=1,
+            progress=progress, trace_path=trace_path,
+        )
+    finally:
+        wt.join()
+    wall = time.monotonic() - t0
+    for p in (out_path, in_path):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    # lag in chunk units at each commit: where the writer was (as a
+    # fraction of the final chunk grid) minus where the follower was —
+    # averaged over the run's second half, after the warm-up commits
+    lags = [
+        max(frac * rep.n_chunks - done, 0.0)
+        for done, frac, _ in commits[len(commits) // 2:]
+    ]
+    out = {
+        "live_follow_reads": int(rep.n_records),
+        "live_follow_wall_s": round(wall, 2),
+        "live_follow_chunks": int(rep.n_chunks),
+        "live_snapshots_published": int(rep.snapshot_seq),
+        "live_first_snapshot_latency_s": round(first_snap[0], 3),
+        "live_steady_lag_chunks": round(
+            sum(lags) / len(lags), 3
+        ) if lags else 0.0,
+        # the follower's own idle accounting, from the phase ledger
+        "live_poll_s": round(rep.seconds.get("live_poll", 0.0), 2),
+        "live_wait_s": round(rep.seconds.get("live_wait", 0.0), 2),
+    }
+    if trace_path:
+        out["live_trace"] = trace_path
     return out
 
 
@@ -1676,6 +1783,11 @@ def main() -> None:
             # epochs; detection is translated lease expiry, never a
             # pid probe (informational, non-gating)
             result.update(run_serve_xhost_bench())
+        # live_follow: the follower tailing a BAM a paced writer is
+        # still appending — first-snapshot latency + steady lag
+        # (informational, non-gating; DUT_BENCH_LIVE_READS=0 disables)
+        if int(os.environ.get("DUT_BENCH_LIVE_READS", 120_000)) > 0:
+            result.update(run_live_follow_bench())
         # same pipeline end-to-end on XLA-CPU: the wall-clock >=50x
         # denominator (DUT_BENCH_CPU_E2E_READS=0 disables); runs after
         # every TPU leg so the 1-core box is never shared

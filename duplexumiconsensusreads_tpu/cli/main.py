@@ -279,6 +279,43 @@ def build_parser() -> argparse.ArgumentParser:
         "(device FLOPs + wire bytes). Requires --chunk-reads",
     )
     c.add_argument(
+        "--follow",
+        action="store_true",
+        default=None,
+        help="follow-mode ingest (live/): tail a GROWING input — a "
+        "regular file another process appends to, or a FIFO — admitting "
+        "only complete-BGZF-block byte runs, and finalise when the "
+        "input finishes (see --finalize-on). A follow run over the "
+        "finished file is byte-identical to the batch run. Requires "
+        "--chunk-reads",
+    )
+    c.add_argument(
+        "--finalize-on",
+        default=None,
+        metavar="{eof,idle:N,marker}",
+        help="follow termination rule: 'eof' waits for the 28-byte BGZF "
+        "EOF block (the BAM spec's terminator; default), 'idle:N' "
+        "finalises after the input stops growing for N seconds, "
+        "'marker' when <input>.done appears. Requires --chunk-reads",
+    )
+    c.add_argument(
+        "--live-poll-s",
+        type=float,
+        default=None,
+        help="follow poll cadence: seconds the tailer sleeps when its "
+        "read has caught up with the writer (default 0.25; requires "
+        "--chunk-reads)",
+    )
+    c.add_argument(
+        "--snapshot-chunks",
+        type=int,
+        default=None,
+        help="publish an indexed partial snapshot (a valid BAM prefix + "
+        "index at OUT.snapshot.bam) every N committed chunks; 0 "
+        "disables (default). Output-bytes-neutral side artifact; "
+        "requires --chunk-reads",
+    )
+    c.add_argument(
         "--read-group-id",
         default=None,
         help="output consensus read group id (fgbio-style single @RG on "
@@ -682,6 +719,17 @@ def _cmd_call(args) -> int:
                 "failed", "rejected", "expired", "quarantined", "unknown"
             ) else 0
         print(json.dumps(st, sort_keys=True))
+        if "snapshot_seq" in st:
+            # follow-mode jobs: the journal carries the per-chunk live
+            # counters (stamped through the fenced renewal), so watching
+            # a follower is one --status away even mid-slice
+            import sys as _sys
+
+            print(
+                f"[duplexumi] live: snapshot_seq={st['snapshot_seq']} "
+                f"reads_emitted={st.get('reads_emitted', 0)}",
+                file=_sys.stderr,
+            )
         if state in ("rejected", "expired", "quarantined") and st.get("error"):
             # the reason a job never ran (or was given up on) must be
             # one --status away, not buried in the daemon's journal:
@@ -811,6 +859,25 @@ def _cmd_call(args) -> int:
         raise SystemExit(
             f"invalid ingest_overlap value {ingest_overlap!r} "
             f"(allowed: ['auto', 'on', 'off'])"
+        )
+    follow = bool(opt("follow", False))
+    finalize_on = str(opt("finalize_on", "eof"))
+    # the structured domain (eof | idle:<seconds> | marker) is hand-
+    # validated like --mesh/--bucket-ladder — config-file values bypass
+    # argparse and must fail loudly here, before the run
+    from duplexumiconsensusreads_tpu.live import parse_finalize_on
+
+    try:
+        parse_finalize_on(finalize_on)
+    except ValueError as e:
+        raise SystemExit(f"--finalize-on: {e}")
+    live_poll_s = float(opt("live_poll_s", 0.25))
+    if live_poll_s <= 0:
+        raise SystemExit(f"--live-poll-s must be > 0 (got {live_poll_s})")
+    snapshot_chunks = int(opt("snapshot_chunks", 0))
+    if snapshot_chunks < 0:
+        raise SystemExit(
+            f"--snapshot-chunks must be >= 0 (got {snapshot_chunks})"
         )
     mate_aware = opt("mate_aware", "auto")
     max_reads = opt("max_reads", 0)
@@ -956,6 +1023,10 @@ def _cmd_call(args) -> int:
             "per_base_tags": per_base_tags,
             "read_group_id": read_group,
             "write_index": write_index,
+            "follow": follow,
+            "finalize_on": finalize_on,
+            "live_poll_s": live_poll_s,
+            "snapshot_chunks": snapshot_chunks,
         }
         try:
             job_id = client.submit(
@@ -1013,6 +1084,10 @@ def _cmd_call(args) -> int:
             "ingest_overlap": ingest_overlap,
             "mesh": mesh,
             "bucket_ladder": ladder_norm,
+            "follow": follow,
+            "finalize_on": finalize_on,
+            "live_poll_s": live_poll_s,
+            "snapshot_chunks": snapshot_chunks,
         })
     if args.heartbeat:
         if args.heartbeat < 0:
@@ -1167,6 +1242,10 @@ def _cmd_call(args) -> int:
             per_base_tags=per_base_tags,
             read_group=read_group,
             write_index=write_index,
+            follow=follow,
+            finalize_on=finalize_on,
+            live_poll_s=live_poll_s,
+            snapshot_chunks=snapshot_chunks,
             trace_path=args.trace,
             heartbeat_s=args.heartbeat,
         )

@@ -24,6 +24,17 @@ BGZF_EOF = bytes.fromhex(
     "1f8b08040000000000ff0600424302001b0003000000000000000000"
 )
 
+
+def has_eof_block(buf: bytes) -> bool:
+    """True iff ``buf`` ends with the 28-byte BGZF EOF marker.
+
+    The single definition of "this BGZF stream is finished" — the
+    stream reader, the shard merger, and the live tailer all route
+    their EOF comparisons through here so the answer cannot drift
+    between consumers.
+    """
+    return len(buf) >= len(BGZF_EOF) and buf[-len(BGZF_EOF):] == BGZF_EOF
+
 # Max uncompressed payload per block. The format caps the *compressed*
 # block at 65536; 65280 uncompressed leaves headroom like htslib does.
 MAX_BLOCK_UNCOMPRESSED = 65280

@@ -110,6 +110,10 @@ class RunReport:
     # resolved bucket ladder of the run ([] = single-capacity): explicit
     # rungs verbatim, or the tuner verdict an auto run settled on
     bucket_ladder: list = dataclasses.field(default_factory=list)
+    # follow mode (live/): number of indexed partial snapshots this run
+    # has published so far (monotone across kill/resume — the admission
+    # watermark carries the series); 0 when snapshots are off
+    snapshot_seq: int = 0
     seconds: dict = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:

@@ -93,6 +93,12 @@ KNOWN_SITES = (
     # shard splice commits, merged-output publish)
     "serve.split",  # shard-plan journal txn: children registered + fanned
     "serve.merge",  # parent advance sweep + shard splice/publish commits
+    # live follow-mode ingest (live/): the tailing producer's poll cycle
+    # (stat + incremental read of the growing input) and the indexed
+    # partial-snapshot publish at checkpoint marks — the two I/O steps a
+    # follower adds on top of the batch spine
+    "live.poll",  # tail poll: stat/read of the growing input
+    "live.snapshot",  # partial-snapshot publish (BAM prefix + BAI)
 )
 
 _EXC_ERRNO = {

@@ -278,6 +278,47 @@ KNOB_TABLE = {
         "surfaces": ("provenance", "job_config"),
         "default": False,
     },
+    # ---- live follow-mode knobs (live/): ALL scheduling-class and
+    # fingerprint/spec_signature/provenance-EXCLUDED on purpose — they
+    # steer WHEN input bytes become visible to the executor, never what
+    # the executor computes from them. The chunk grid is pinned by
+    # chunk_reads + the hold-back rule, so a follow run over the
+    # finished file is byte-identical to the batch run (the A/B matrix
+    # proves it), and a @PG CL carrying them would make job bytes
+    # depend on how the input happened to arrive.
+    "follow": {
+        "flag": "--follow",
+        "class": "scheduling",
+        "surfaces": ("job_config", "streaming_only"),
+        "default": False,
+        "refuse_alone": True,
+        "refuse_note": "; tailing a growing input requires the "
+                       "streaming executor's chunk grid",
+    },
+    "finalize_on": {
+        # structured domain (eof | idle:<seconds> | marker) hand-
+        # validated like mesh/bucket_ladder — no closed choices tuple
+        "flag": "--finalize-on",
+        "class": "scheduling",
+        "surfaces": ("job_config", "streaming_only"),
+        "default": "eof",
+    },
+    "live_poll_s": {
+        "flag": "--live-poll-s",
+        "class": "scheduling",
+        "surfaces": ("job_config", "streaming_only"),
+        "default": 0.25,
+    },
+    "snapshot_chunks": {
+        # 0 = no partial snapshots; N>0 publishes an indexed BAM
+        # prefix every N committed chunks. Output-bytes-neutral: the
+        # snapshot is a SIDE artifact (out + ".snapshot.bam"), the
+        # final output bytes never depend on it
+        "flag": "--snapshot-chunks",
+        "class": "scheduling",
+        "surfaces": ("job_config", "streaming_only"),
+        "default": 0,
+    },
     # ---- CLI-only execution knobs: resolvable via opt()/config file
     # but never part of a serve job (refused at --submit); empty
     # surface sets are the honest declaration, not an omission.
@@ -420,6 +461,22 @@ THREAD_ROLES = {
         "entry": "_run",
         "marker": "dut-heartbeat",
         "may": (),
+        "shared": (),
+    },
+    "live-tail": {
+        # the follow-mode tailing producer (live/tail.py): pure host
+        # I/O against the growing input — no device, no durable state
+        # (the admission watermark is persisted by the main loop at
+        # commit time), and the bounded admission queue is its only
+        # output seam. Poll timing accrues in TailSource's own
+        # lock-guarded counters; the consumer drains them into the
+        # phase dict at chunk boundaries, so the tailer never touches
+        # stream.py's shared state
+        "module": "live/tail.py",
+        "entry": "_tail_loop",
+        "marker": "dut-live-tail",
+        "may": (),
+        "handoff": "_q",
         "shared": (),
     },
     "watchdog": {

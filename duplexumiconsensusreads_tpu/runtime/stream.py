@@ -257,10 +257,17 @@ class BamStreamReader:
         read_size: int = 8 << 20,
         use_native: bool = True,
         start: tuple[int, int] | None = None,
+        open_fn=None,
     ):
         """start=(coffset, uoffset): begin the record stream at that
         BGZF virtual offset (from a BamLinearIndex entry) instead of the
-        first record; the header is still parsed from the file start."""
+        first record; the header is still parsed from the file start.
+
+        open_fn(path) -> file-like overrides the plain open — the
+        follow-mode tailer (live/tail.py) injects its TailSource here
+        so the reader consumes a growing input through the exact same
+        read/seek/tell surface. Forward-only sources refuse ``start``.
+        """
         native_lib = None
         n_threads = 0
         if use_native:
@@ -269,7 +276,7 @@ class BamStreamReader:
             native_lib = get_lib()
             n_threads = min(os.cpu_count() or 1, 16)
         self._native_lib = native_lib
-        self._f = open(path, "rb")
+        self._f = open_fn(path) if open_fn is not None else open(path, "rb")
         self._buf = bytearray()
         self._eof = False
         self._consumed = 0  # decompressed bytes consumed (header incl.)
@@ -472,7 +479,7 @@ def _resolve_chunk_boundary(keys: np.ndarray, prev_last):
     return cut, keys[cut - 1]
 
 
-def iter_record_chunks(path: str, chunk_reads: int):
+def iter_record_chunks(path: str, chunk_reads: int, open_fn=None):
     """Yield (header, BamRecords) chunks; the trailing pos_key group of
     each chunk is held back and prepended to the next so no molecule's
     reads are split across chunks.
@@ -485,7 +492,7 @@ def iter_record_chunks(path: str, chunk_reads: int):
     that input needs template-coordinate sorting, exactly as the
     reference domain's duplex tools require.
     """
-    reader = BamStreamReader(path)
+    reader = BamStreamReader(path, open_fn=open_fn)
     header = reader.header
     carry: BamRecords | None = None
     prev_last = None
@@ -537,6 +544,7 @@ def iter_batch_chunks(
     key_hi=None,
     warn_mixed: bool = True,
     first_read: int | None = None,
+    open_fn=None,
 ):
     """Yield (header, ReadBatch, info) chunks with the family-integrity
     hold-back of iter_record_chunks, but parsed NATIVELY: record fields
@@ -575,7 +583,7 @@ def iter_batch_chunks(
     if lib is None:
         # portable fallback: full scan with host-range filtering (the
         # `start` seek is an optimisation the Python path skips)
-        for header, recs in iter_record_chunks(path, chunk_reads):
+        for header, recs in iter_record_chunks(path, chunk_reads, open_fn=open_fn):
             keys = _rec_pos_keys(recs)
             a, b = 0, len(recs)
             if key_lo is not None:
@@ -602,7 +610,7 @@ def iter_batch_chunks(
     )
 
     nt = min(os.cpu_count() or 1, 16)
-    reader = BamStreamReader(path, start=start)
+    reader = BamStreamReader(path, start=start, open_fn=open_fn)
     header = reader.header
     shell = _header_shell(header)
     carry = b""
@@ -856,6 +864,7 @@ def _fingerprint(
     in_path: str, grouping, consensus, capacity, chunk_reads, input_range=None,
     mate_aware: str = "auto", max_reads: int = 0, per_base_tags: bool = False,
     read_group: str = "A", chunk_base: int = 0, first_read: int | None = None,
+    stat_sig: str | None = None,
 ) -> str:
     """The mate_aware SETTING (auto/on/off) joins the key rather than
     the resolved boolean: resolution is a deterministic function of the
@@ -869,13 +878,20 @@ def _fingerprint(
     each knob's declared surfaces — a scheduling knob (max_inflight,
     drain_workers, ...) added to this key would make resumability
     depend on scheduling and is a lint finding; a semantic knob
-    REMOVED from it is one too."""
+    REMOVED from it is one too.
+
+    ``stat_sig`` replaces the input's (size, mtime) pair: a follow run
+    tails a GROWING file, whose size and mtime change every poll, so
+    the live watermark (live/watermark.py) pins a per-run token instead
+    — kill/resume mid-tail keeps one fingerprint while two different
+    follow runs still get distinct ones. Not a knob: it is run identity
+    (like the input path), never user-steerable scheduling."""
     st = os.stat(in_path)
     key = json.dumps(
         [
             os.path.abspath(in_path),
-            st.st_size,
-            int(st.st_mtime),
+            *([st.st_size, int(st.st_mtime)] if stat_sig is None
+              else ["live", stat_sig]),
             dataclasses.asdict(grouping),
             dataclasses.asdict(consensus),
             capacity,
@@ -1026,6 +1042,22 @@ def stream_call_consensus(
     # identical for any subset/count — device count is a wire/compute
     # topology knob, never a result knob (the mesh byte-identity
     # contract, A/B-tested like --drain-workers).
+    follow: bool = False,  # follow-mode ingest (live/): tail a GROWING
+    # input — regular file or FIFO — admitting only complete-BGZF-block
+    # byte runs, and finalise when the input is finished (see
+    # finalize_on). Scheduling-class like the mesh: a follow run over
+    # the finished file is byte-identical to the batch run, so the knob
+    # stays OUT of the checkpoint fingerprint and @PG provenance.
+    finalize_on: str = "eof",  # follow termination rule: "eof" (the
+    # 28-byte BGZF EOF block — the BAM spec's own terminator),
+    # "idle:<seconds>" (no growth for N seconds), or "marker"
+    # (<input>.done exists). See live.tail.parse_finalize_on.
+    live_poll_s: float = 0.25,  # follow poll cadence: how long the
+    # tailer sleeps when the read has caught up with the writer
+    snapshot_chunks: int = 0,  # >0: publish an indexed partial
+    # snapshot (a valid BAM prefix + BAI at out+".snapshot.bam") every
+    # N committed chunks. Output-bytes-neutral: the snapshot is a side
+    # artifact, the final output never depends on it.
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -1079,6 +1111,8 @@ def stream_call_consensus(
             provenance_cl=provenance_cl,
             chunk_base=chunk_base, first_read=first_read,
             devices=devices,
+            follow=follow, finalize_on=finalize_on,
+            live_poll_s=live_poll_s, snapshot_chunks=snapshot_chunks,
         )
     finally:
         for hb in hb_box:
@@ -1126,6 +1160,10 @@ def _stream_call(
     chunk_base: int = 0,
     first_read: int | None = None,
     devices=None,
+    follow: bool = False,
+    finalize_on: str = "eof",
+    live_poll_s: float = 0.25,
+    snapshot_chunks: int = 0,
 ) -> RunReport:
     """Chunked, async-pipelined consensus calling (TPU backend).
 
@@ -1185,6 +1223,30 @@ def _stream_call(
     # input-dependent for "auto" to resolve — it exists so callers can
     # express "the default" without pinning today's default
     overlap_on = ingest_overlap != "off"
+    if snapshot_chunks < 0:
+        raise ValueError(
+            f"snapshot_chunks must be >= 0 (got {snapshot_chunks})"
+        )
+    live_src = None  # the follow-mode TailSource (live/tail.py)
+    live_mark: dict | None = None  # its durable admission watermark
+    if follow:
+        from duplexumiconsensusreads_tpu.live import (
+            parse_finalize_on as _parse_finalize_on,
+        )
+
+        _parse_finalize_on(finalize_on)  # validate the domain up front
+        if live_poll_s <= 0:
+            raise ValueError(f"live_poll_s must be > 0 (got {live_poll_s})")
+        if input_range is not None:
+            raise ValueError(
+                "follow mode cannot combine with an input range: a "
+                "growing input has no random access"
+            )
+        if chunk_base or first_read:
+            raise ValueError(
+                "follow mode cannot run as a shard sub-job: the chunk "
+                "grid of a growing input is not plannable up front"
+            )
     from duplexumiconsensusreads_tpu import tuning
 
     # bucket-ladder resolution: an explicit ladder is known now (its
@@ -1219,6 +1281,16 @@ def _stream_call(
     auto_ckpt = checkpoint_path is None
     if auto_ckpt:
         checkpoint_path = out_path + ".ckpt"
+    if follow:
+        # pin the follow-run identity BEFORE fingerprinting: a growing
+        # input's (size, mtime) change every poll, so the fingerprint
+        # substitutes the watermark's stat_sig — kill/resume mid-tail
+        # keeps one fingerprint and converges exactly once
+        from duplexumiconsensusreads_tpu.live import watermark as _watermark
+
+        live_mark = _watermark.load_or_create(out_path, in_path, resume=resume)
+        # a resumed follower continues the published-snapshot series
+        rep.snapshot_seq = int(live_mark.get("snapshot_seq", 0))
     ckpt = None
     if checkpoint_path:
         fp = _fingerprint(
@@ -1226,6 +1298,7 @@ def _stream_call(
             mate_aware=mate_aware, max_reads=max_reads,
             per_base_tags=per_base_tags, read_group=read_group,
             chunk_base=chunk_base, first_read=first_read,
+            stat_sig=live_mark["stat_sig"] if live_mark else None,
         )
         # resume=False discards `done` just below — skip the per-shard
         # CRC re-read (it would read ~ the whole prior output for
@@ -1250,11 +1323,25 @@ def _stream_call(
     # canonical fragment pos_key, so any chunk holding paired templates
     # holds both their mates; the resolved mode is stable for the run) ----
     rng_start, rng_lo, rng_hi = input_range or (None, None, None)
+    live_open = None
+    if follow:
+        from duplexumiconsensusreads_tpu.live import TailSource
+
+        # ONE forward-only source for the whole run: the stream reader
+        # opens it through open_fn and closes it with the reader
+        live_src = TailSource(
+            in_path, poll_s=live_poll_s, finalize_on=finalize_on
+        )
+
+        def live_open(_path):
+            return live_src
+
     chunk_iter = iter_batch_chunks(
         in_path, chunk_reads, duplex,
         start=rng_start, key_lo=rng_lo, key_hi=rng_hi,
         warn_mixed=False,  # warning responsibility moves to the chunk loop
         first_read=first_read,
+        open_fn=live_open,
     )
     first = next(chunk_iter, None)
     grouping = resolve_mate_aware(
@@ -1334,6 +1421,7 @@ def _stream_call(
         "shard_write": 0.0, "ckpt": 0.0, "finalise": 0.0,
         "main_loop_stall": 0.0, "prefetch_stall": 0.0,
         "ingest_stall": 0.0, "ingest_backpressure": 0.0,
+        "live_poll": 0.0, "live_wait": 0.0,
     }
     # byte-ledger running totals (telemetry/ledger.py), maintained only
     # while tracing: every `led[...] +=` below pairs with a tr.xfer()
@@ -1995,6 +2083,85 @@ def _stream_call(
             with phase_lock:
                 led["output_overhead_bytes"] += len(shell_c)
 
+    snap_path = out_path + ".snapshot.bam"
+
+    def _publish_snapshot(k):
+        """Indexed partial snapshot at a checkpoint mark: the committed
+        tmp assembly so far — a VALID BAM prefix of the final output
+        (header shell + committed shards + EOF block) — published
+        atomically at ``out + ".snapshot.bam"`` with its own index.
+        Main-thread only, straight after chunk k's durable commit, so
+        every snapshot is exactly a committed-chunk prefix; a side
+        artifact by contract — the final output bytes never depend on
+        whether (or how often) snapshots were taken."""
+        from duplexumiconsensusreads_tpu.io.durable import unique_tmp
+
+        f = fin["f"]
+        end = f.tell()
+        t0 = time.monotonic()
+
+        def _snap():
+            f.flush()
+            stage = unique_tmp(snap_path)
+            done = False
+            try:
+                with open(tmp_path, "rb") as src, open(stage, "wb") as dst:
+                    left = end
+                    while left > 0:
+                        block = src.read(min(4 << 20, left))
+                        if not block:
+                            raise ValueError(
+                                f"{tmp_path}: truncated under the "
+                                f"snapshot copy"
+                            )
+                        dst.write(block)
+                        left -= len(block)
+                    dst.write(bgzf.BGZF_EOF)
+                    fsync_file(dst)
+                replace_durable(stage, snap_path)
+                done = True
+            finally:
+                if not done:
+                    try:
+                        os.remove(stage)
+                    except OSError:
+                        pass
+            # the unsharded finalise's index choice, over the prefix
+            if max(header_out.ref_lengths, default=0) > (1 << 29):
+                from duplexumiconsensusreads_tpu.io.csi import build_csi
+
+                build_csi(snap_path)
+            else:
+                from duplexumiconsensusreads_tpu.io.bai import build_bai
+
+                build_bai(snap_path)
+
+        _io_retry("live.snapshot", _snap, "snapshot publish")
+        rep.snapshot_seq += 1
+        if live_mark is not None:
+            # persist the series position so a resumed follower
+            # continues it (main thread: the tailer role holds no
+            # durable grant)
+            from duplexumiconsensusreads_tpu.live import watermark as _wm
+
+            live_mark["snapshot_seq"] = rep.snapshot_seq
+            if live_src is not None:
+                live_mark["admitted_bytes"] = live_src.admitted_bytes()
+            _io_retry(
+                "live.snapshot", _wm.save, "watermark save",
+                out_path, live_mark,
+            )
+        dt = time.monotonic() - t0
+        phase["finalise"] += dt
+        if tr is not None:
+            tr.span("finalise", t0, dt, chunk=k)
+            tr.event(
+                "snapshot_published", chunk=k,
+                snapshot_seq=rep.snapshot_seq,
+                chunks_done=k + 1 - chunk_base,
+                reads=rep.n_consensus,
+            )
+
     def _commit(k, payload):
         """Main-thread commit of a drained chunk: durable mark first,
         then the idempotent append into the tmp assembly. The mark is
@@ -2042,6 +2209,8 @@ def _stream_call(
         phase["finalise"] += dt
         if tr is not None:
             tr.span("finalise", t0, dt, chunk=k)
+        if snapshot_chunks and (k + 1 - chunk_base) % snapshot_chunks == 0:
+            _publish_snapshot(k)
         if progress:
             progress(k, rep)
 
@@ -2140,6 +2309,26 @@ def _stream_call(
             tr.span("bucketing", t0, dt, chunk=k, n_buckets=len(buckets))
         return buckets, alpha, fb, n_down
 
+    def _drain_live(chunk=None):
+        # follow mode: pull the tailer's idle-poll time and the
+        # reader's blocked-on-tailer time into the phase ledger at
+        # chunk boundaries. Pull-based on purpose — the dut-live-tail
+        # role's shared set is empty, so the tailing thread never
+        # touches this module's state; whichever thread runs ingest
+        # (main when sync, dut-ingest when overlapped) does the accrual
+        # under the declared lock
+        if live_src is None:
+            return
+        poll_s, wait_s = live_src.take_phase_seconds()
+        now = time.monotonic()
+        for stage, dt in (("live_poll", poll_s), ("live_wait", wait_s)):
+            if dt <= 0:
+                continue
+            with phase_lock:
+                phase[stage] += dt
+            if tr is not None:
+                tr.span(stage, now - dt, dt, chunk=chunk)
+
     def timed_chunks(it):
         i = chunk_base
         while True:
@@ -2151,6 +2340,7 @@ def _stream_call(
                 # the final (None-returning) read keeps its span too —
                 # chunkless, so the per-stage sums still match phase
                 tr.span("ingest", t0, dt, chunk=i if item is not None else None)
+            _drain_live(chunk=i if item is not None else None)
             if item is None:
                 return
             i += 1
@@ -2168,6 +2358,7 @@ def _stream_call(
                 stall = phase["main_loop_stall"]
                 drain_busy = sum(phase[k] for k in DRAIN_PHASES)
                 retries = rep.n_retries
+                snap_seq = rep.snapshot_seq
             return {
                 "elapsed_s": round(elapsed, 1),
                 "chunks_done": frontier - chunk_base,
@@ -2177,6 +2368,9 @@ def _stream_call(
                 "drain_util": round(
                     min(drain_busy / (drain_workers * elapsed), 1.0), 3
                 ),
+                # follow-mode subscribers (call --wait, serve clients)
+                # read snapshot progress off this stream
+                "snapshot_seq": snap_seq,
             }
 
         hb = Heartbeat(heartbeat_s, _hb_stats, recorder=tr).start()
@@ -2250,6 +2444,7 @@ def _stream_call(
                             "ingest", t0, dt,
                             chunk=k if item is not None else None,
                         )
+                    _drain_live(chunk=k if item is not None else None)
                     if item is None:
                         _q_put(("done", None), None)
                         return
@@ -2469,6 +2664,15 @@ def _stream_call(
                 pass
         raise
     finally:
+        if live_src is not None:
+            # stop the tailer on EVERY exit path: a killed run must not
+            # leave a daemon thread polling the input behind the error
+            # (close is idempotent; the reader's own close also routes
+            # here when the iterator winds down normally)
+            try:
+                live_src.close()
+            except OSError:
+                pass
         if ingest_thread is not None and ingest_thread.is_alive():
             # normal exit: the producer already returned after "done";
             # error exit: aborting is set (above), so a producer
@@ -2557,6 +2761,20 @@ def _stream_call(
             os.remove(checkpoint_path)
         except OSError:
             pass
+    if live_mark is not None or snapshot_chunks:
+        # the finished output supersedes every partial snapshot, and a
+        # finished follow run must resume like any batch output (the
+        # watermark pin is follow-run identity, not output state)
+        from duplexumiconsensusreads_tpu.live import watermark as _wm
+
+        for leftover in (
+            snap_path, snap_path + ".bai", snap_path + ".csi",
+        ):
+            try:
+                os.remove(leftover)
+            except OSError:
+                pass
+        _wm.clear(out_path)
     if write_index:
         # BAI unless a header contig exceeds its 2^29 coordinate space,
         # then the CSI generalization (depth sized to the contig)
@@ -2575,6 +2793,9 @@ def _stream_call(
         tr.span("finalise", t_fin, dt_fin)
     rep.n_chunks_skipped = n_skipped
     rep.n_pipeline_compiles = len(spec_cache)
+    # follow residue: poll/wait accrued after the last chunk boundary
+    # (the tailer's final EOF-detection cycles) still joins the ledger
+    _drain_live()
     total = time.monotonic() - t_start
     for pk, pv in phase.items():
         rep.seconds[pk] = round(pv, 3)

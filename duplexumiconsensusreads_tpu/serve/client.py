@@ -116,7 +116,7 @@ def status_document(st: dict) -> dict:
     for key in ("priority", "slices", "chunks_done", "token",
                 "crash_count", "shed", "compacted", "timed_out",
                 "phase", "parent", "shard_idx", "n_shards", "shards",
-                "result"):
+                "snapshot_seq", "reads_emitted", "result"):
         if key in st:
             doc[key] = st[key]
     ts: dict = {}

@@ -132,6 +132,25 @@ def validate_spec(d: dict) -> JobSpec:
             f"config capacity {merged['capacity']} (the top rung IS the "
             f"job's capacity; set them consistently)"
         )
+    from duplexumiconsensusreads_tpu.live.tail import parse_finalize_on
+
+    try:
+        # structured domain (eof | idle:<seconds> | marker), hand-
+        # validated like mesh/bucket_ladder; the parser is shared with
+        # the CLI so both surfaces reject exactly the same strings
+        parse_finalize_on(merged["finalize_on"])
+    except ValueError as e:
+        raise ValueError(f"config finalize_on: {e}")
+    lp = merged["live_poll_s"]
+    if not isinstance(lp, (int, float)) or isinstance(lp, bool) or lp <= 0:
+        raise ValueError(
+            f"config live_poll_s must be a number > 0 (got {lp!r})"
+        )
+    sc = merged["snapshot_chunks"]
+    if not isinstance(sc, int) or isinstance(sc, bool) or sc < 0:
+        raise ValueError(
+            f"config snapshot_chunks must be an int >= 0 (got {sc!r})"
+        )
     chaos = d.get("chaos")
     if chaos is not None:
         if not isinstance(chaos, str) or not chaos:
@@ -182,6 +201,16 @@ def validate_spec(d: dict) -> JobSpec:
             raise ValueError(
                 f"job shard metadata lacks required keys: {sorted(missing)}"
             )
+    if merged["follow"] and (
+        shards is not None or shard_bytes is not None or shard is not None
+    ):
+        # shard planning walks the finished file to place byte-range
+        # cut points; a growing input has no finished length to plan
+        # over and no random access for sub-jobs to seek into
+        raise ValueError(
+            "a follow job cannot be sharded: byte-range planning "
+            "requires the finished input file"
+        )
     return JobSpec(
         job_id=d["job_id"],
         input=d["input"],
@@ -254,6 +283,10 @@ def job_params(spec: JobSpec):
         per_base_tags=bool(c["per_base_tags"]),
         read_group=str(c["read_group_id"]),
         write_index=bool(c["write_index"]),
+        follow=bool(c["follow"]),
+        finalize_on=str(c["finalize_on"]),
+        live_poll_s=float(c["live_poll_s"]),
+        snapshot_chunks=int(c["snapshot_chunks"]),
     )
     return gp, cp, kwargs
 

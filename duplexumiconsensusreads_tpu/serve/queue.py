@@ -755,7 +755,7 @@ class SpoolQueue:
 
     def renew_lease(
         self, job_id: str, daemon_id: str, token: int,
-        lease_s: float = LEASE_DEFAULT_S,
+        lease_s: float = LEASE_DEFAULT_S, progress: dict | None = None,
     ) -> None:
         """Extend the lease (fault site ``serve.renew``), fenced: a
         zombie must not be able to resurrect a reclaimed lease.
@@ -766,13 +766,23 @@ class SpoolQueue:
         :meth:`renew_all` deliberately does not: a wedged device step
         keeps the heartbeat (liveness) alive while committing nothing,
         and conflating the two is exactly the hang this distinction
-        exists to catch."""
+        exists to catch.
+
+        ``progress`` (optional) merges observable per-chunk counters
+        into the journal entry inside the SAME fenced transaction —
+        follow-mode jobs ride this to publish ``snapshot_seq`` /
+        ``reads_emitted`` (a follow job can run for hours between slice
+        boundaries, so ``--status`` must not have to wait for one). A
+        fenced write on purpose: a zombie must not be able to stamp
+        stale progress over the journal any more than a stale lease."""
         with self._txn():
             entry = self._check_fence(job_id, daemon_id, token)
             entry["lease"]["expires_m"] = round(
                 self.store.now() + lease_s, 3
             )
             entry["progress_m"] = round(self.store.now(), 3)
+            if progress:
+                entry.update(progress)
             self.save()
 
     def renew_all(self, daemon_id: str, lease_s: float = LEASE_DEFAULT_S) -> int:

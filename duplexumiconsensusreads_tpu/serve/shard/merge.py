@@ -61,7 +61,7 @@ def member_spans(path: str) -> tuple[int, int]:
         raise ValueError(f"{path}: too small to be a finalised BAM")
     with open(path, "rb") as f:
         f.seek(size - len(BGZF_EOF))
-        if f.read(len(BGZF_EOF)) != BGZF_EOF:
+        if not bgzf.has_eof_block(f.read(len(BGZF_EOF))):
             raise ValueError(
                 f"{path}: missing the BGZF EOF block — not a finalised "
                 f"output (torn or still being written?)"

@@ -107,7 +107,8 @@ class LeaseContext:
 
 
 def fenced_renew(queue: SpoolQueue, job_id: str, daemon_id: str,
-                 token: int, lease_s: float) -> None:
+                 token: int, lease_s: float,
+                 progress: dict | None = None) -> None:
     """THE fenced-renewal guard, shared by every stage that commits
     under a lease (the per-chunk commit guard here, the service's
     split/merge stages): one flock'd transaction — renew_lease verifies
@@ -125,7 +126,9 @@ def fenced_renew(queue: SpoolQueue, job_id: str, daemon_id: str,
         "serve.fence",
         lambda: _io_retry(
             "serve.renew",
-            lambda: queue.renew_lease(job_id, daemon_id, token, lease_s),
+            lambda: queue.renew_lease(
+                job_id, daemon_id, token, lease_s, progress=progress
+            ),
             f"job {job_id} lease renewal",
         ),
         f"job {job_id} fence check",
@@ -383,6 +386,14 @@ class WarmWorker:
         # must survive preemption for the same traffic-attributed reason
         slice_bytes = {"h2d_bytes": 0, "d2h_bytes": 0, "reads": 0,
                        "device_flops": 0.0, "device_s": 0.0}
+        # follow-mode observability: a follow job can run for hours
+        # between slice boundaries, so its snapshot/emission counters
+        # piggyback on the per-chunk fenced renewal instead of waiting
+        # for a preemption requeue. The progress callback (post-commit,
+        # chunk k) fills this; the commit guard (pre-commit, chunk k+1)
+        # ships it — one chunk of lag, zero extra journal transactions
+        live_progress: dict = {}
+        live_run = bool(kwargs.get("follow") or kwargs.get("snapshot_chunks"))
 
         commit_guard = None
         if lease is not None:
@@ -393,6 +404,7 @@ class WarmWorker:
                 fenced_renew(
                     lease.queue, spec.job_id, lease.daemon_id,
                     lease.token, lease.lease_s,
+                    progress=dict(live_progress) if live_progress else None,
                 )
 
         def progress(_k, _rep):
@@ -408,6 +420,9 @@ class WarmWorker:
             ladder_seen["ladder"] = list(_rep.bucket_ladder)
             ladder_seen["rows_real"] = _rep.n_rows_real
             ladder_seen["rows_pad"] = _rep.n_rows_padded
+            if live_run:
+                live_progress["snapshot_seq"] = int(_rep.snapshot_seq)
+                live_progress["reads_emitted"] = int(_rep.n_consensus)
             fresh = commits[0] - n_resumed
             if lease is not None and lease.on_chunk is not None:
                 lease.on_chunk()

@@ -355,15 +355,19 @@ def stage_stats(records: list[dict]) -> dict[str, dict]:
 
 def lane_utilization(records: list[dict]) -> dict[str, dict]:
     """Busy seconds and busy/wall per lane. ``main_loop_stall``,
-    ``ingest_stall`` and ``ingest_backpressure`` spans are excluded —
-    the thread is BLOCKED there, and counting blocked time as busy
-    would hide exactly the condition the stall metrics exist to
-    expose. A drain lane near 1.0 while main sits low reads as 'the
-    drain pool is the critical path'."""
+    ``ingest_stall``, ``ingest_backpressure`` and the follow-mode
+    ``live_poll``/``live_wait`` spans are excluded — the thread is
+    BLOCKED there, and counting blocked time as busy would hide
+    exactly the condition the stall metrics exist to expose. A drain
+    lane near 1.0 while main sits low reads as 'the drain pool is the
+    critical path'."""
     wall = wall_seconds(records)
     busy: dict[str, float] = {}
     stalled: dict[str, float] = {}
-    _stall_stages = ("main_loop_stall", "ingest_stall", "ingest_backpressure")
+    _stall_stages = (
+        "main_loop_stall", "ingest_stall", "ingest_backpressure",
+        "live_poll", "live_wait",
+    )
     for rec in records:
         if rec.get("type") != "span":
             continue

@@ -89,6 +89,12 @@ KNOWN_STAGES = (
     "ingest_backpressure",  # overlap mode: the ingest producer blocked
     # on the full bounded handoff queue (ingest lane) — ingest running
     # AHEAD of the pipeline, the healthy steady state
+    "live_poll",  # follow mode: tailer poll cycles against the growing
+    # input — stat + incremental read + complete-block scan (accrued on
+    # the consumer side at chunk boundaries from the tailer's clock)
+    "live_wait",  # follow mode: ingest blocked waiting for the tailer
+    # to admit more bytes — the instrument-is-slower-than-us residue,
+    # distinct from ingest_stall (pipeline slower than ingest)
 )
 
 # Structured point events. Attrs are per-name (see the emitting sites);
@@ -153,6 +159,10 @@ KNOWN_EVENTS = (
     # output_bytes); the parent still gets the standard job_completed
     "job_split",  # planner fanned the parent out into K sub-jobs
     "job_merged",  # shard outputs spliced + indexed into one output
+    # live follow-mode ingest (live/ + runtime/stream.py): an indexed
+    # partial snapshot (valid BAM prefix + BAI) was durably published
+    # at a checkpoint mark (attrs: snapshot_seq, chunks_done, reads)
+    "snapshot_published",
 )
 
 # Byte-ledger directions (the third record kind, ``xfer`` — see

@@ -158,6 +158,21 @@ def test_transient_fault_at_each_site_byte_identical(
             with open(o, "rb") as f:
                 assert f.read() == serve_ref
         return
+    if site.startswith("live."):
+        # live.* sites exist only on the follow path: tail the already-
+        # finished input (the tailer terminates on its BGZF EOF block)
+        # with a snapshot every chunk, so live.snapshot publishes — and
+        # absorbs its transient — on every commit, not just at the end.
+        # The follow A/B contract makes the batch reference the oracle.
+        out = str(tmp_path / "live.bam")
+        stream_call_consensus(
+            path, out, GP, CP, follow=True, live_poll_s=0.01,
+            snapshot_chunks=1, **KW
+        )
+        assert plan.n_fired >= 1  # the schedule really injected
+        with open(out, "rb") as f:
+            assert f.read() == ref_bytes
+        return
     out = str(tmp_path / "out.bam")
     stream_call_consensus(path, out, GP, CP, **KW)
     assert plan.n_fired >= 1  # the schedule really injected
@@ -209,21 +224,33 @@ BOUNDARY_KILLS = [
     # chunks the consumer never committed, so resume recomputes exactly
     # the missing suffix
     ("ingest.queue", 2),
+    # live-snapshot site: killed publishing the first partial snapshot
+    # (the publish runs AFTER the chunk's checkpoint mark is durable,
+    # so resume skips the chunk and republishes the snapshot)
+    ("live.snapshot", 1),
 ]
+
+# per-site kwargs that make a boundary site reachable at all: snapshot
+# publishing only happens when snapshot_chunks > 0 (applied to the kill
+# run AND the resume, which must also clean the snapshot artifacts up)
+_BOUNDARY_KILL_KW = {
+    "live.snapshot": {"snapshot_chunks": 1},
+}
 
 
 @pytest.mark.parametrize("site,nth", BOUNDARY_KILLS)
 def test_kill_at_phase_boundary_then_resume_converges(site, nth, sim, tmp_path):
     path, ref_bytes = sim
     out = str(tmp_path / "k.bam")
+    kw = {**KW, **_BOUNDARY_KILL_KW.get(site, {})}
     faults.install(faults.FaultPlan.parse(f"{site}:{nth}:kill"))
     with pytest.raises(faults.InjectedKill):
-        stream_call_consensus(path, out, GP, CP, **KW)
+        stream_call_consensus(path, out, GP, CP, **kw)
     faults.uninstall()
     # atomic finalise: no half-written BAM may be visible at the real
     # path after ANY kill — resume decides from the manifest alone
     assert not os.path.exists(out)
-    rep = stream_call_consensus(path, out, GP, CP, resume=True, **KW)
+    rep = stream_call_consensus(path, out, GP, CP, resume=True, **kw)
     if site == "finalise.write":
         # finalise.write fires only at commit time, and the commit
         # marks BEFORE it appends — so at least the frontier chunk was
@@ -232,6 +259,10 @@ def test_kill_at_phase_boundary_then_resume_converges(site, nth, sim, tmp_path):
     with open(out, "rb") as f:
         assert f.read() == ref_bytes
     assert not os.path.exists(out + ".ckpt")  # auto-ckpt cleaned on success
+    if site == "live.snapshot":
+        # snapshot side artifacts are working state, cleaned with the ckpt
+        assert not os.path.exists(out + ".snapshot.bam")
+        assert not os.path.exists(out + ".snapshot.bam.bai")
 
 
 def test_resume_refuses_runtime_codec_fallback_shards(sim, tmp_path, monkeypatch):

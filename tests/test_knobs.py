@@ -46,6 +46,10 @@ SCHEDULING_MATRIX = {
     "ingest_overlap": "tests/test_stream.py::TestIngestOverlap",
     "mesh": "tests/test_mesh.py::test_cli_mesh_flag_streams_byte_identical",
     "bucket_ladder": "tests/test_tuning.py::TestLadderMatrix",
+    "follow": "tests/test_live.py::TestFollowByteIdentity",
+    "finalize_on": "tests/test_live.py::TestFollowByteIdentity",
+    "live_poll_s": "tests/test_live.py::TestFollowByteIdentity",
+    "snapshot_chunks": "tests/test_live.py::test_snapshot_chunks_ab_byte_identical",
 }
 
 # `call` parser dests that are deliberately NOT knobs: run-control and
@@ -94,7 +98,8 @@ class TestKnobTable:
             "ingest_overlap": "auto", "mesh": "auto",
             "bucket_ladder": "off", "mate_aware": "auto", "max_reads": 0,
             "per_base_tags": False, "read_group_id": "A",
-            "write_index": False,
+            "write_index": False, "follow": False, "finalize_on": "eof",
+            "live_poll_s": 0.25, "snapshot_chunks": 0,
         }
 
     def test_job_choices_pin(self):
@@ -120,7 +125,8 @@ class TestKnobTable:
     def test_streaming_only_set_pin(self):
         assert knobs.streaming_only_keys() == (
             "packed", "prefetch_depth", "ingest_overlap", "mesh",
-            "bucket_ladder",
+            "bucket_ladder", "follow", "finalize_on", "live_poll_s",
+            "snapshot_chunks",
         )
 
     def test_every_cli_flag_maps_to_a_declared_knob(self):

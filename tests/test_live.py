@@ -1,0 +1,371 @@
+"""Live follow-mode suite (live/): the streaming executor as a
+follower of a growing BAM.
+
+The load-bearing contract is the A/B byte-identity matrix
+(``TestFollowByteIdentity``): a follow run — over a finished file, a
+file that grows while we read it, or a FIFO, at every ``finalize_on``
+mode — must produce output (BAI included) byte-identical to the plain
+batch run over the same final bytes. That is what makes every live
+knob scheduling-class: they steer WHEN input bytes become visible,
+never what is computed from them. ``SCHEDULING_MATRIX`` in
+tests/test_knobs.py points dutlint's knob-taint coverage leg here.
+
+The other pillars: every published partial snapshot is a valid,
+indexed BAM prefix of the final output; a kill mid-tail resumes
+exactly-once through the durable admission watermark; a truncated
+input at a non-EOF finalisation refuses loudly instead of silently
+dropping the torn trailing block.
+"""
+
+import os
+import shutil
+import threading
+import time
+
+import pytest
+
+from duplexumiconsensusreads_tpu.io import read_bam, simulated_bam
+from duplexumiconsensusreads_tpu.io.bam import parse_bam
+from duplexumiconsensusreads_tpu.live import (
+    TailSource,
+    parse_finalize_on,
+    watermark,
+)
+from duplexumiconsensusreads_tpu.runtime import faults
+from duplexumiconsensusreads_tpu.runtime.stream import stream_call_consensus
+from duplexumiconsensusreads_tpu.simulate import SimConfig
+from duplexumiconsensusreads_tpu.types import ConsensusParams, GroupingParams
+
+GP = GroupingParams(strategy="adjacency", paired=True)
+CP = ConsensusParams(mode="duplex")
+# write_index=True throughout: the A/B contract includes the BAI bytes
+KW = dict(capacity=128, chunk_reads=80, write_index=True)
+
+
+@pytest.fixture(scope="module")
+def sim(tmp_path_factory):
+    """(input path, reference output bytes, reference BAI bytes) from a
+    plain batch run — the oracle every follow run must reproduce."""
+    d = tmp_path_factory.mktemp("live")
+    path = str(d / "in.bam")
+    cfg = SimConfig(n_molecules=60, n_positions=8, umi_error=0.02, seed=37)
+    simulated_bam(cfg, path=path, sort=True)
+    ref = str(d / "ref.bam")
+    rep = stream_call_consensus(path, ref, GP, CP, **KW)
+    assert rep.n_chunks >= 3  # several commit points for snapshots/kills
+    with open(ref, "rb") as f:
+        ref_bytes = f.read()
+    with open(ref + ".bai", "rb") as f:
+        ref_bai = f.read()
+    return path, ref_bytes, ref_bai
+
+
+def _follow(path, out, **kw):
+    merged = {**KW, "follow": True, "live_poll_s": 0.01, **kw}
+    return stream_call_consensus(path, out, GP, CP, **merged)
+
+
+def _out_files(out):
+    with open(out, "rb") as f:
+        b = f.read()
+    with open(out + ".bai", "rb") as f:
+        bai = f.read()
+    return b, bai
+
+
+def _assert_no_live_residue(out):
+    # a successful follow run finishes as a plain batch output: no
+    # watermark, no snapshot side artifacts, no checkpoint
+    for suffix in (".livemark", ".snapshot.bam", ".snapshot.bam.bai",
+                   ".snapshot.bam.csi", ".ckpt"):
+        assert not os.path.exists(out + suffix), out + suffix
+
+
+class TestFollowByteIdentity:
+    """The A/B matrix: follow output == batch output, bytes and BAI,
+    at every finalize_on mode and input arrival shape."""
+
+    def test_eof_mode_over_finished_file(self, sim, tmp_path):
+        path, ref_bytes, ref_bai = sim
+        out = str(tmp_path / "f.bam")
+        rep = _follow(path, out)  # finalize_on default: "eof"
+        assert _out_files(out) == (ref_bytes, ref_bai)
+        assert rep.snapshot_seq == 0  # no snapshots unless asked
+        _assert_no_live_residue(out)
+
+    def test_idle_mode(self, sim, tmp_path):
+        path, ref_bytes, ref_bai = sim
+        out = str(tmp_path / "f.bam")
+        _follow(path, out, finalize_on="idle:0.3")
+        assert _out_files(out) == (ref_bytes, ref_bai)
+        _assert_no_live_residue(out)
+
+    def test_marker_mode(self, sim, tmp_path):
+        path, ref_bytes, ref_bai = sim
+        inp = str(tmp_path / "in.bam")
+        shutil.copy(path, inp)
+        with open(inp + ".done", "w") as f:
+            f.write("done\n")
+        out = str(tmp_path / "f.bam")
+        _follow(inp, out, finalize_on="marker")
+        assert _out_files(out) == (ref_bytes, ref_bai)
+        _assert_no_live_residue(out)
+
+    def test_growing_file_converges(self, sim, tmp_path):
+        """The real case: a writer appends in arbitrary slabs (torn
+        mid-block on purpose) while the follower runs; the follower's
+        output must still match the batch run over the final bytes."""
+        path, ref_bytes, ref_bai = sim
+        with open(path, "rb") as f:
+            raw = f.read()
+        inp = str(tmp_path / "growing.bam")
+        slab = max(1, len(raw) // 23)  # prime-ish slab: tears blocks
+
+        def writer():
+            with open(inp, "wb") as f:
+                for off in range(0, len(raw), slab):
+                    f.write(raw[off:off + slab])
+                    f.flush()
+                    time.sleep(0.01)
+
+        with open(inp, "wb"):
+            pass  # the follower may open before the writer's first slab
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            out = str(tmp_path / "f.bam")
+            _follow(inp, out)
+        finally:
+            t.join()
+        assert _out_files(out) == (ref_bytes, ref_bai)
+        _assert_no_live_residue(out)
+
+    def test_fifo_input(self, sim, tmp_path):
+        """A pipe has no size, no mtime and no second read — the
+        harshest arrival shape, and exactly what `sequencer | duplexumi
+        call --follow` is."""
+        path, ref_bytes, ref_bai = sim
+        with open(path, "rb") as f:
+            raw = f.read()
+        fifo = str(tmp_path / "in.fifo")
+        os.mkfifo(fifo)
+
+        def writer():
+            with open(fifo, "wb") as f:
+                f.write(raw)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            out = str(tmp_path / "f.bam")
+            _follow(fifo, out)
+        finally:
+            t.join()
+        assert _out_files(out) == (ref_bytes, ref_bai)
+        _assert_no_live_residue(out)
+
+
+def test_snapshot_chunks_ab_byte_identical(sim, tmp_path):
+    """snapshot_chunks is scheduling-class: publishing partial
+    snapshots must not change a single byte of the final output."""
+    path, ref_bytes, ref_bai = sim
+    out = str(tmp_path / "f.bam")
+    rep = _follow(path, out, snapshot_chunks=1)
+    assert rep.snapshot_seq == rep.n_chunks  # one publish per commit
+    assert _out_files(out) == (ref_bytes, ref_bai)
+    _assert_no_live_residue(out)
+
+
+def test_every_snapshot_is_a_valid_indexed_bam_prefix(sim, tmp_path):
+    """Captured at each commit (the progress callback runs right after
+    the publish): every snapshot parses as a complete BAM, carries its
+    own index, and its compressed payload is a byte prefix of the
+    final output."""
+    path, _, _ = sim
+    out = str(tmp_path / "f.bam")
+    snap_path = out + ".snapshot.bam"
+    seen = []
+
+    def progress(_k, _rep):
+        with open(snap_path, "rb") as f:
+            snap = f.read()
+        with open(snap_path + ".bai", "rb") as f:
+            bai = f.read()
+        seen.append((snap, bai, _rep.snapshot_seq))
+
+    rep = stream_call_consensus(
+        path, out, GP, CP, follow=True, live_poll_s=0.01,
+        snapshot_chunks=1, progress=progress, **KW
+    )
+    assert len(seen) == rep.n_chunks >= 3
+    final_bytes, _ = _out_files(out)
+    n_final = len(read_bam(out)[1].names)
+    prev_reads = -1
+    for i, (snap, bai, seq) in enumerate(seen):
+        assert seq == i + 1  # the published series is dense
+        assert bai.startswith(b"BAI\1") and len(bai) > 8
+        # the snapshot is literally a committed prefix of the final
+        # file: same bytes up to its own EOF block
+        assert snap[:-28] == final_bytes[:len(snap) - 28]
+        header, recs = parse_bam(snap)  # parses as a complete BAM
+        assert prev_reads < len(recs.names) <= n_final
+        prev_reads = len(recs.names)
+    assert prev_reads == n_final  # the last snapshot is the whole run
+    _assert_no_live_residue(out)
+
+
+def test_kill_mid_tail_then_resume_exactly_once(sim, tmp_path):
+    """SIGKILL-equivalent (InjectedKill) while the tailer polls: the
+    admission watermark pins the run identity, so resume=True accepts
+    its own checkpoint over the 'growing' input and converges to the
+    batch bytes — snapshot series continuing, not restarting."""
+    path, ref_bytes, ref_bai = sim
+    with open(path, "rb") as f:
+        raw = f.read()
+    inp = str(tmp_path / "growing.bam")
+    slab = max(1, len(raw) // 23)
+
+    def writer():
+        with open(inp, "wb") as f:
+            for off in range(0, len(raw), slab):
+                f.write(raw[off:off + slab])
+                f.flush()
+                time.sleep(0.02)
+
+    with open(inp, "wb"):
+        pass
+    out = str(tmp_path / "k.bam")
+    t = threading.Thread(target=writer)
+    t.start()
+    faults.install(faults.FaultPlan.parse("live.poll:4:kill"))
+    try:
+        with pytest.raises(faults.InjectedKill):
+            _follow(inp, out, snapshot_chunks=1)
+    finally:
+        faults.uninstall()
+        t.join()  # the writer finishes the input regardless of our death
+    assert not os.path.exists(out)  # atomic finalise held
+    mark = watermark.load(out)
+    assert mark is not None  # the durable identity survived the kill
+    pre_seq = int(mark["snapshot_seq"])
+    rep = _follow(inp, out, snapshot_chunks=1, resume=True)
+    assert rep.snapshot_seq >= max(pre_seq, 1)  # monotone across the kill
+    assert _out_files(out) == (ref_bytes, ref_bai)
+    _assert_no_live_residue(out)
+
+
+def test_truncated_input_refuses_loudly(sim, tmp_path):
+    """A non-EOF finalisation reached with a torn trailing block means
+    the writer died mid-record: the run must fail naming the
+    truncation, never publish an output silently missing reads."""
+    path, _, _ = sim
+    with open(path, "rb") as f:
+        raw = f.read()
+    inp = str(tmp_path / "torn.bam")
+    with open(inp, "wb") as f:
+        f.write(raw[:-40])  # tears the trailing EOF block
+    out = str(tmp_path / "f.bam")
+    with pytest.raises(ValueError, match="truncated trailing BGZF block"):
+        _follow(inp, out, finalize_on="idle:0.2")
+    assert not os.path.exists(out)
+
+
+class TestTailSource:
+    def test_parse_finalize_on(self):
+        assert parse_finalize_on("eof") == ("eof", None)
+        assert parse_finalize_on("marker") == ("marker", None)
+        assert parse_finalize_on("idle:2.5") == ("idle", 2.5)
+        for bad in ("idle:0", "idle:-1", "idle:", "idle:x", "never", ""):
+            with pytest.raises(ValueError):
+                parse_finalize_on(bad)
+
+    def test_reads_complete_blocks_and_finishes_on_eof(self, sim):
+        path, _, _ = sim
+        with open(path, "rb") as f:
+            raw = f.read()
+        src = TailSource(path, poll_s=0.01)
+        try:
+            got = b""
+            while True:
+                b = src.read(1 << 16)
+                if not b:
+                    break
+                got += b
+            assert got == raw
+            assert src.finish_reason == "eof"
+            assert src.tell() == len(raw) == src.admitted_bytes()
+        finally:
+            src.close()
+
+    def test_forward_only_seek(self, sim):
+        path, _, _ = sim
+        src = TailSource(path, poll_s=0.01)
+        try:
+            first = src.read(1 << 14)
+            assert src.seek(len(first)) == len(first)  # current pos: ok
+            with pytest.raises(ValueError, match="forward-only"):
+                src.seek(0)
+        finally:
+            src.close()
+
+    def test_phase_seconds_drain(self, sim, tmp_path):
+        """take_phase_seconds is a drain: accumulated poll/wait time is
+        handed over once, then starts from zero (the executor folds it
+        into the live_poll/live_wait phase ledger at chunk boundaries)."""
+        path, _, _ = sim
+        inp = str(tmp_path / "slow.bam")
+        with open(inp, "wb"):
+            pass  # empty: the tailer can only poll and the reader wait
+        src = TailSource(inp, poll_s=0.01, finalize_on="idle:10")
+        try:
+            time.sleep(0.15)  # the tailer can only idle-poll
+            poll_s, _ = src.take_phase_seconds()
+            assert poll_s > 0  # the tailer really idled
+            again, _ = src.take_phase_seconds()
+            assert again < poll_s  # drained: the clock restarted
+        finally:
+            src.close()
+
+
+class TestWatermark:
+    def test_reuse_and_head_invalidation(self, sim, tmp_path):
+        path, _, _ = sim
+        out = str(tmp_path / "o.bam")
+        m1 = watermark.load_or_create(out, path)
+        m2 = watermark.load_or_create(out, path)
+        assert m1["stat_sig"] == m2["stat_sig"]  # same run resumes itself
+        # resume=False always re-pins
+        m3 = watermark.load_or_create(out, path, resume=False)
+        assert m3["stat_sig"] != m1["stat_sig"]
+        # a rewritten head is a different run: the mark is discarded
+        # (work on a copy — the shared sim input must stay intact)
+        inp = str(tmp_path / "in.bam")
+        shutil.copy(path, inp)
+        ma = watermark.load_or_create(out, inp, resume=False)
+        with open(inp, "r+b") as f:
+            f.write(b"XXXX")
+        mb = watermark.load_or_create(out, inp)
+        assert mb["stat_sig"] != ma["stat_sig"]
+        watermark.clear(out)
+        assert watermark.load(out) is None
+
+    def test_fifo_resume_refused(self, tmp_path):
+        fifo = str(tmp_path / "p.fifo")
+        os.mkfifo(fifo)
+        out = str(tmp_path / "o.bam")
+        watermark.load_or_create(out, fifo)  # fresh: fine
+        with pytest.raises(ValueError, match="FIFO"):
+            watermark.load_or_create(out, fifo)  # the bytes are gone
+
+
+def test_status_document_passes_live_counters():
+    """call --status/--wait --json: the journal's live counters (stamped
+    through the fenced per-chunk renewal) reach the normalized document."""
+    from duplexumiconsensusreads_tpu.serve.client import status_document
+
+    doc = status_document({
+        "job_id": "j", "state": "running",
+        "snapshot_seq": 3, "reads_emitted": 120,
+    })
+    assert doc["snapshot_seq"] == 3
+    assert doc["reads_emitted"] == 120

@@ -1106,7 +1106,7 @@ class TestReportShape:
             "bytes_h2d", "bytes_d2h", "n_rows_real", "n_rows_padded",
             "n_mesh_pad_buckets", "bucket_ladder",
             # the device ledger's run totals (telemetry/devledger.py)
-            "device_flops", "device_seconds", "seconds",
+            "device_flops", "device_seconds", "snapshot_seq", "seconds",
         }
         assert {f.name for f in dataclasses.fields(RunReport)} == golden
 
@@ -1121,6 +1121,7 @@ class TestReportShape:
             "scatter", "deflate", "shard_write", "ckpt", "finalise",
             "main_loop_stall", "prefetch_stall", "ingest_stall",
             "ingest_backpressure", "drain_utilization",
+            "live_poll", "live_wait",
             "total",
         }
 

@@ -3,7 +3,7 @@
 #
 #   sh tools/ci_check.sh
 #
-# Three legs, all exit-1 on violation:
+# Five legs, all exit-1 on violation:
 #
 #   1. dutlint --strict over the whole default set (package + tools/ +
 #      test anchors): every invariant rule active, zero non-allowlisted
@@ -29,6 +29,12 @@
 #      analyser must accept a known-good capture with its dev
 #      sum-check green (vacuously green on a pre-devledger fixture) —
 #      the FLOP twin of leg 2.
+#   5. check_trace --require-summary over the committed FOLLOW-mode
+#      fixture (tests/data/live.fixture.trace.jsonl, a traced
+#      --follow --snapshot-chunks run): the live stages
+#      (live_poll/live_wait) and the snapshot_published event ride the
+#      same schema registry, so a telemetry change that would reject a
+#      healthy follow run fails here, not while tailing a sequencer.
 #
 # tests/test_lint.py runs this script as a tier-1 test, so the gate
 # cannot rot out of CI.
@@ -71,5 +77,9 @@ echo "[ci_check] fleet_report (2-daemon fixture captures, sum-check)" >&2
 echo "[ci_check] devstat (fixture capture, dev sum-check)" >&2
 "$py" "$root/tools/devstat.py" \
     "$root/tests/data/run.fixture.trace.jsonl" >/dev/null
+
+echo "[ci_check] check_trace --require-summary (live follow fixture)" >&2
+"$py" "$root/tools/check_trace.py" \
+    "$root/tests/data/live.fixture.trace.jsonl" --require-summary
 
 echo "[ci_check] OK" >&2
